@@ -1,0 +1,64 @@
+"""kolibrie_tpu — a TPU-native SPARQL/RDF + RSP streaming + probabilistic Datalog +
+neurosymbolic ML framework.
+
+A ground-up, TPU-first rebuild of the capabilities of StreamIntelligenceLab/Kolibrie
+(Rust, single-node Rayon/SIMD).  Design stance (see SURVEY.md §7):
+
+- Strings live on host; the device sees only dense u32/u64 ID columns.
+- The triple store is columnar (SoA ``subj[]/pred[]/obj[]``) kept in sorted orders
+  (SPO/POS/OSP) — the XLA-friendly equivalent of the reference's six-permutation
+  HashMap index (``shared/src/index_manager.rs``).
+- Joins are sort-merge / hash joins over ID columns executed through JAX/XLA
+  (``kolibrie_tpu.ops``); filters/aggregates are vectorized VPU ops.
+- Fixpoints (semi-naive, provenance) are host-driven loops over jitted bodies.
+- Distribution shards triple columns across a ``jax.sharding.Mesh`` with
+  all-to-all exchange over ICI (``kolibrie_tpu.parallel``).
+"""
+
+from kolibrie_tpu.core.dictionary import Dictionary
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.core.rule import Rule, FilterCondition
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "SparqlDatabase": ("kolibrie_tpu.query.sparql_database", "SparqlDatabase"),
+    "execute_query": ("kolibrie_tpu.query.executor", "execute_query"),
+    "execute_query_volcano": ("kolibrie_tpu.query.executor", "execute_query_volcano"),
+    "Reasoner": ("kolibrie_tpu.reasoner.reasoner", "Reasoner"),
+    "QueryBuilder": ("kolibrie_tpu.query.builder", "QueryBuilder"),
+    "QueryEngine": ("kolibrie_tpu.query.engine", "QueryEngine"),
+    "RSPBuilder": ("kolibrie_tpu.rsp.builder", "RSPBuilder"),
+    "RSPEngine": ("kolibrie_tpu.rsp.engine", "RSPEngine"),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    try:
+        mod = importlib.import_module(target[0])
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"{name!r} is not available yet ({target[0]} missing)"
+        ) from e
+    val = getattr(mod, target[1])
+    globals()[name] = val
+    return val
+
+__all__ = [
+    "Dictionary",
+    "Triple",
+    "Term",
+    "TriplePattern",
+    "Rule",
+    "FilterCondition",
+    "SparqlDatabase",
+    "Reasoner",
+    "execute_query",
+    "execute_query_volcano",
+]

@@ -1,0 +1,4 @@
+"""Core data model: dictionary encoding, triples, terms, rules, columnar store.
+
+Parity target: the reference's ``shared/`` crate (shared/src/lib.rs:11-24).
+"""

@@ -1,0 +1,328 @@
+"""Columnar triple store with sorted orders — the TPU-native index.
+
+The reference keeps all six permutation indexes as nested HashMaps
+(``shared/src/index_manager.rs:18-26``) plus a ``BTreeSet<Triple>``
+(``kolibrie/src/sparql_database.rs:44-60``).  HashMaps are pointer-chasing and
+have no device analogue, so this rebuild replaces them with **sorted columnar
+arrays** (SoA ``subj[]/pred[]/obj[]``): three lexicographic sort orders —
+SPO, POS, OSP — cover every bound-variable combination of a triple pattern
+(the hexastore insight: 3 orders suffice for all 8 prefix shapes when the
+third column is sorted within each prefix group).  Point/prefix lookups are
+``searchsorted`` range queries (``index_manager.rs:253-340`` ``query()``
+dispatch parity); bulk build is one ``lexsort`` + ``unique`` (parity with the
+rayon ``build_from_triples`` at ``index_manager.rs:83-136``).
+
+Columns are numpy on host; :meth:`device_columns` mirrors them to the JAX
+device (HBM) for kernel-side joins.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from kolibrie_tpu.core.triple import Triple
+
+_EMPTY = np.empty(0, dtype=np.uint32)
+
+
+def _lex_sort_rows(s: np.ndarray, p: np.ndarray, o: np.ndarray):
+    """Return row permutation sorting lexicographically by (s, p, o)."""
+    return np.lexsort((o, p, s))
+
+
+def _pack2(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pack two u32 columns into one u64 sort/search key."""
+    return (a.astype(np.uint64) << np.uint64(32)) | b.astype(np.uint64)
+
+
+class SortedOrder:
+    """One lexicographic sort order over the triple columns.
+
+    ``perm`` names the column priority, e.g. ("s","p","o") or ("p","o","s").
+    Materializes reordered copies c0,c1,c2 plus the packed (c0,c1) key for
+    two-level prefix range queries.
+    """
+
+    __slots__ = ("perm", "c0", "c1", "c2", "key01")
+
+    def __init__(self, perm: Tuple[str, str, str], cols: dict):
+        self.perm = perm
+        a, b, c = (cols[perm[0]], cols[perm[1]], cols[perm[2]])
+        order = _lex_sort_rows(a, b, c)
+        self.c0 = a[order]
+        self.c1 = b[order]
+        self.c2 = c[order]
+        self.key01 = _pack2(self.c0, self.c1)
+
+    def __len__(self) -> int:
+        return len(self.c0)
+
+    def range0(self, v0: int) -> Tuple[int, int]:
+        lo = int(np.searchsorted(self.c0, v0, side="left"))
+        hi = int(np.searchsorted(self.c0, v0, side="right"))
+        return lo, hi
+
+    def range01(self, v0: int, v1: int) -> Tuple[int, int]:
+        k = (np.uint64(v0) << np.uint64(32)) | np.uint64(v1)
+        lo = int(np.searchsorted(self.key01, k, side="left"))
+        hi = int(np.searchsorted(self.key01, k, side="right"))
+        return lo, hi
+
+    def range012(self, v0: int, v1: int, v2: int) -> Tuple[int, int]:
+        lo, hi = self.range01(v0, v1)
+        sub = self.c2[lo:hi]
+        l2 = int(np.searchsorted(sub, v2, side="left"))
+        h2 = int(np.searchsorted(sub, v2, side="right"))
+        return lo + l2, lo + h2
+
+    def slice_rows(self, lo: int, hi: int) -> dict:
+        """Columns for rows [lo, hi) keyed by canonical column name."""
+        return {
+            self.perm[0]: self.c0[lo:hi],
+            self.perm[1]: self.c1[lo:hi],
+            self.perm[2]: self.c2[lo:hi],
+        }
+
+
+class ColumnarTripleStore:
+    """Deduplicated triple set stored as sorted u32 columns.
+
+    Mutations buffer host-side; any read compacts (merge + lexsort + unique).
+    Mirrors the role of ``UnifiedIndex`` + ``BTreeSet<Triple>`` in the
+    reference, in columnar form.
+    """
+
+    _ORDER_PERMS = {
+        "spo": ("s", "p", "o"),
+        "pos": ("p", "o", "s"),
+        "osp": ("o", "s", "p"),
+    }
+
+    def __init__(self) -> None:
+        self._s = _EMPTY
+        self._p = _EMPTY
+        self._o = _EMPTY
+        self._pending_add: list = []  # list of (s,p,o) tuples or (N,3) arrays
+        self._pending_del: set = set()
+        self._orders: dict = {}
+        self._device_cols = None
+        self._version = 0  # bumped on every compaction that changed data
+
+    # ------------------------------------------------------------- mutation
+
+    def add(self, s: int, p: int, o: int) -> None:
+        self._pending_add.append((int(s), int(p), int(o)))
+        self._pending_del.discard((int(s), int(p), int(o)))
+
+    def add_triple(self, t: Triple) -> None:
+        self.add(t.subject, t.predicate, t.object)
+
+    def add_batch(self, s: np.ndarray, p: np.ndarray, o: np.ndarray) -> None:
+        if self._pending_del:
+            # apply outstanding deletes first so a remove-then-readd via batch
+            # honors mutation order (deletes run after adds inside compact)
+            self.compact()
+        arr = np.stack(
+            [
+                np.asarray(s, dtype=np.uint32),
+                np.asarray(p, dtype=np.uint32),
+                np.asarray(o, dtype=np.uint32),
+            ],
+            axis=1,
+        )
+        self._pending_add.append(arr)
+
+    def remove(self, s: int, p: int, o: int) -> None:
+        key = (int(s), int(p), int(o))
+        self._pending_del.add(key)
+
+    def clear(self) -> None:
+        self._s = self._p = self._o = _EMPTY
+        self._pending_add = []
+        self._pending_del = set()
+        self._invalidate()
+
+    # ------------------------------------------------------------ compaction
+
+    def _invalidate(self) -> None:
+        self._orders = {}
+        self._device_cols = None
+        self._version += 1
+
+    def compact(self) -> None:
+        if not self._pending_add and not self._pending_del:
+            return
+        parts_s = [self._s]
+        parts_p = [self._p]
+        parts_o = [self._o]
+        singles = []
+        for item in self._pending_add:
+            if isinstance(item, tuple):
+                singles.append(item)
+            else:
+                parts_s.append(item[:, 0])
+                parts_p.append(item[:, 1])
+                parts_o.append(item[:, 2])
+        if singles:
+            arr = np.asarray(singles, dtype=np.uint32)
+            parts_s.append(arr[:, 0])
+            parts_p.append(arr[:, 1])
+            parts_o.append(arr[:, 2])
+        s = np.concatenate(parts_s)
+        p = np.concatenate(parts_p)
+        o = np.concatenate(parts_o)
+        self._pending_add = []
+        if len(s):
+            order = _lex_sort_rows(s, p, o)
+            s, p, o = s[order], p[order], o[order]
+            # unique: drop consecutive duplicate rows
+            if len(s) > 1:
+                dup = (s[1:] == s[:-1]) & (p[1:] == p[:-1]) & (o[1:] == o[:-1])
+                keep = np.concatenate(([True], ~dup))
+                s, p, o = s[keep], p[keep], o[keep]
+        if self._pending_del and len(s):
+            # per-row binary search on the sorted columns; delete sets are small
+            key01 = _pack2(s, p)
+            drop = np.zeros(len(s), dtype=bool)
+            for ds, dp, do_ in self._pending_del:
+                k = (np.uint64(ds) << np.uint64(32)) | np.uint64(dp)
+                lo = int(np.searchsorted(key01, k, side="left"))
+                hi = int(np.searchsorted(key01, k, side="right"))
+                sub = o[lo:hi]
+                l2 = lo + int(np.searchsorted(sub, do_, side="left"))
+                h2 = lo + int(np.searchsorted(sub, do_, side="right"))
+                drop[l2:h2] = True
+            if drop.any():
+                keep = ~drop
+                s, p, o = s[keep], p[keep], o[keep]
+        self._pending_del = set()
+        if (
+            len(s) == len(self._s)
+            and np.array_equal(s, self._s)
+            and np.array_equal(p, self._p)
+            and np.array_equal(o, self._o)
+        ):
+            return  # no-op mutation batch: keep caches and version
+        self._s, self._p, self._o = s, p, o
+        self._invalidate()
+
+    # --------------------------------------------------------------- access
+
+    def __len__(self) -> int:
+        self.compact()
+        return len(self._s)
+
+    @property
+    def version(self) -> int:
+        self.compact()
+        return self._version
+
+    def columns(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Canonical SPO-sorted unique columns (s, p, o)."""
+        self.compact()
+        return self._s, self._p, self._o
+
+    def device_columns(self):
+        """JAX device mirror of the SPO columns (cached per compaction)."""
+        self.compact()
+        if self._device_cols is None:
+            import jax.numpy as jnp
+
+            self._device_cols = (
+                jnp.asarray(self._s),
+                jnp.asarray(self._p),
+                jnp.asarray(self._o),
+            )
+        return self._device_cols
+
+    def order(self, name: str) -> SortedOrder:
+        self.compact()
+        so = self._orders.get(name)
+        if so is None:
+            so = SortedOrder(
+                self._ORDER_PERMS[name], {"s": self._s, "p": self._p, "o": self._o}
+            )
+            self._orders[name] = so
+        return so
+
+    def contains(self, s: int, p: int, o: int) -> bool:
+        self.compact()
+        spo = self.order("spo")
+        lo, hi = spo.range012(s, p, o)
+        return hi > lo
+
+    def __iter__(self) -> Iterator[Triple]:
+        s, p, o = self.columns()
+        for i in range(len(s)):
+            yield Triple(int(s[i]), int(p[i]), int(o[i]))
+
+    def triples_set(self) -> set:
+        s, p, o = self.columns()
+        return set(zip(s.tolist(), p.tolist(), o.tolist()))
+
+    # ---------------------------------------------------------------- match
+
+    def match(
+        self,
+        s: Optional[int] = None,
+        p: Optional[int] = None,
+        o: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Pattern scan: None = wildcard.  Returns (s, p, o) column arrays of
+        matching triples.  Dispatch by bound combination mirrors
+        ``UnifiedIndex::query`` (``index_manager.rs:253-340``)."""
+        self.compact()
+        if s is not None and p is not None and o is not None:
+            order = self.order("spo")
+            lo, hi = order.range012(s, p, o)
+        elif s is not None and p is not None:
+            order = self.order("spo")
+            lo, hi = order.range01(s, p)
+        elif s is not None and o is not None:
+            order = self.order("osp")
+            lo, hi = order.range01(o, s)
+        elif s is not None:
+            order = self.order("spo")
+            lo, hi = order.range0(s)
+        elif p is not None and o is not None:
+            order = self.order("pos")
+            lo, hi = order.range01(p, o)
+        elif p is not None:
+            order = self.order("pos")
+            lo, hi = order.range0(p)
+        elif o is not None:
+            order = self.order("osp")
+            lo, hi = order.range0(o)
+        else:
+            return self._s, self._p, self._o
+        cols = order.slice_rows(lo, hi)
+        return cols["s"], cols["p"], cols["o"]
+
+    def count(self, s=None, p=None, o=None) -> int:
+        ms, _, _ = self.match(s, p, o)
+        return len(ms)
+
+    def clone(self) -> "ColumnarTripleStore":
+        self.compact()
+        c = ColumnarTripleStore()
+        c._s, c._p, c._o = self._s.copy(), self._p.copy(), self._o.copy()
+        return c
+
+    # ----------------------------------------------------------- serialization
+
+    def save_npz(self, path: str) -> None:
+        s, p, o = self.columns()
+        np.savez_compressed(path, s=s, p=p, o=o)
+
+    @staticmethod
+    def load_npz(path: str) -> "ColumnarTripleStore":
+        data = np.load(path)
+        st = ColumnarTripleStore()
+        st._s = data["s"].astype(np.uint32)
+        st._p = data["p"].astype(np.uint32)
+        st._o = data["o"].astype(np.uint32)
+        return st
+
+

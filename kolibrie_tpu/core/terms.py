@@ -1,0 +1,129 @@
+"""Terms, triple patterns, and bindings — the atoms of the query/rule ASTs.
+
+Parity: ``shared/src/terms.rs:14-43`` — ``Term::{Variable, Constant, QuotedTriple}``
+(RDF-star: a pattern position may hold a nested triple pattern), ``TriplePattern``,
+``Bindings`` (variable name -> term ID).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Set, Union
+
+
+class Term:
+    """Tagged union: Variable(name) | Constant(u32 id) | QuotedTriple(pattern)."""
+
+    __slots__ = ("kind", "value")
+
+    VARIABLE = "var"
+    CONSTANT = "const"
+    QUOTED = "quoted"
+
+    def __init__(self, kind: str, value):
+        self.kind = kind
+        self.value = value
+
+    @staticmethod
+    def variable(name: str) -> "Term":
+        return Term(Term.VARIABLE, name)
+
+    @staticmethod
+    def constant(term_id: int) -> "Term":
+        return Term(Term.CONSTANT, term_id)
+
+    @staticmethod
+    def quoted(pattern: "TriplePattern") -> "Term":
+        return Term(Term.QUOTED, pattern)
+
+    @property
+    def is_variable(self) -> bool:
+        return self.kind == Term.VARIABLE
+
+    @property
+    def is_constant(self) -> bool:
+        return self.kind == Term.CONSTANT
+
+    @property
+    def is_quoted(self) -> bool:
+        return self.kind == Term.QUOTED
+
+    def variables(self) -> Set[str]:
+        if self.kind == Term.VARIABLE:
+            return {self.value}
+        if self.kind == Term.QUOTED:
+            return self.value.variables()
+        return set()
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Term)
+            and self.kind == other.kind
+            and self.value == other.value
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.value))
+
+    def __repr__(self):
+        if self.kind == Term.VARIABLE:
+            return f"?{self.value}"
+        if self.kind == Term.CONSTANT:
+            return f"#{self.value}"
+        return f"<<{self.value!r}>>"
+
+
+class TriplePattern(NamedTuple):
+    subject: Term
+    predicate: Term
+    object: Term
+
+    def variables(self) -> Set[str]:
+        return self.subject.variables() | self.predicate.variables() | self.object.variables()
+
+    def terms(self):
+        return (self.subject, self.predicate, self.object)
+
+
+# Bindings: variable name -> u32 term ID (quoted-triple IDs allowed).
+Bindings = Dict[str, int]
+
+
+class UnresolvedTerm:
+    """A term whose string has not yet been dictionary-encoded (parser output).
+
+    Parity: ``shared/src/terms.rs`` ``UnresolvedTerm``.
+    """
+
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind: str, value: Union[str, tuple]):
+        self.kind = kind  # "var" | "const" | "quoted"
+        self.value = value
+
+    def resolve(self, dictionary, quoted_store=None) -> Term:
+        if self.kind == "var":
+            return Term.variable(self.value)  # type: ignore[arg-type]
+        if self.kind == "quoted":
+            s, p, o = self.value  # type: ignore[misc]
+            rs = s.resolve(dictionary, quoted_store)
+            rp = p.resolve(dictionary, quoted_store)
+            ro = o.resolve(dictionary, quoted_store)
+            return Term.quoted(TriplePattern(rs, rp, ro))
+        return Term.constant(dictionary.encode(self.value))  # type: ignore[arg-type]
+
+
+def resolve_quoted_pattern_id(pattern: TriplePattern, quoted_store) -> Optional[int]:
+    """If ``pattern`` is fully constant (possibly nested), intern it and return
+    the quoted-triple ID; None if it contains variables."""
+    ids = []
+    for t in pattern.terms():
+        if t.is_constant:
+            ids.append(t.value)
+        elif t.is_quoted:
+            inner = resolve_quoted_pattern_id(t.value, quoted_store)
+            if inner is None:
+                return None
+            ids.append(inner)
+        else:
+            return None
+    return quoted_store.intern(*ids)

@@ -1,0 +1,24 @@
+"""RDF triple as three u32 dictionary IDs.
+
+Parity: ``shared/src/triple.rs:14-31``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from kolibrie_tpu.core.terms import Term, TriplePattern
+
+
+class Triple(NamedTuple):
+    subject: int
+    predicate: int
+    object: int
+
+    def to_pattern(self) -> TriplePattern:
+        """Constant-only pattern for this triple (``triple.rs:20-30``)."""
+        return TriplePattern(
+            Term.constant(self.subject),
+            Term.constant(self.predicate),
+            Term.constant(self.object),
+        )

@@ -1,0 +1,15 @@
+"""Vectorized compute kernels for the query engine and reasoner.
+
+This is the rebuild's replacement for the reference's hand-written SSE2/NEON
+SIMD joins/filters (``kolibrie/src/sparql_database.rs:1497-1785,2168-2967``)
+and rayon parallel join kernels (``shared/src/join_algorithm.rs``): everything
+operates on dense u32/u64/f64 ID columns, expressed as numpy (host) and
+jax.numpy (device) array programs.  The device path is what runs on the TPU's
+VPU/MXU; the host path mirrors its semantics exactly for small inputs and for
+environments without a device.
+"""
+
+from kolibrie_tpu.ops.join import equi_join_tables, multi_key_pack
+from kolibrie_tpu.ops.unique import unique_rows
+
+__all__ = ["equi_join_tables", "multi_key_pack", "unique_rows"]
