@@ -67,16 +67,7 @@ def equi_join_tables(
         li = np.repeat(np.arange(ln), rn)
         ri = np.tile(np.arange(rn), ln)
     else:
-        if len(shared) <= 2:
-            lkey = multi_key_pack([left[v] for v in shared])
-            rkey = multi_key_pack([right[v] for v in shared])
-        else:
-            # 3+ shared vars: rank-composition keys are only comparable when
-            # built over the CONCATENATED columns, so pack jointly.
-            joint = multi_key_pack(
-                [np.concatenate([left[v], right[v]]) for v in shared]
-            )
-            lkey, rkey = joint[:ln], joint[ln:]
+        lkey, rkey = _pack_shared_keys(left, right, shared, ln)
         li, ri = join_indices(lkey, rkey)
     out = {}
     for k, col in left.items():
@@ -119,6 +110,69 @@ def semi_join_mask(lkey: np.ndarray, rkey: np.ndarray) -> np.ndarray:
 def anti_join_mask(lkey: np.ndarray, rkey: np.ndarray) -> np.ndarray:
     """Boolean mask over left rows with NO match in rkey (negation-as-failure)."""
     return ~semi_join_mask(lkey, rkey)
+
+
+UNBOUND = 0  # dictionary NULL sentinel doubles as the unbound marker
+
+
+def _pack_shared_keys(
+    left: BindingTable, right: BindingTable, shared: List[str], ln: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Comparable join keys for both sides.  <=2 u32 columns pack exactly into
+    u64 per side; 3+ columns use rank composition, which is only comparable
+    when built over the CONCATENATED columns, hence the joint pack + split."""
+    if len(shared) <= 2:
+        return (
+            multi_key_pack([left[v] for v in shared]),
+            multi_key_pack([right[v] for v in shared]),
+        )
+    joint = multi_key_pack([np.concatenate([left[v], right[v]]) for v in shared])
+    return joint[:ln], joint[ln:]
+
+
+def left_outer_join_tables(left: BindingTable, right: BindingTable) -> BindingTable:
+    """OPTIONAL semantics: keep unmatched left rows, right-only columns get
+    the UNBOUND (0) sentinel."""
+    shared = sorted(set(left.keys()) & set(right.keys()))
+    ln, rn = table_len(left), table_len(right)
+    right_only = [k for k in right if k not in left]
+    if ln == 0:
+        out = {k: v.copy() for k, v in left.items()}
+        for k in right_only:
+            out[k] = np.empty(0, dtype=np.uint32)
+        return out
+    if rn == 0 or not shared:
+        if rn == 0:
+            out = {k: v.copy() for k, v in left.items()}
+            for k in right_only:
+                out[k] = np.full(ln, UNBOUND, dtype=np.uint32)
+            return out
+        return equi_join_tables(left, right)  # no shared vars: cross join
+    lkey, rkey = _pack_shared_keys(left, right, shared, ln)
+    li, ri = join_indices(lkey, rkey)
+    matched = np.zeros(ln, dtype=bool)
+    matched[li] = True
+    unmatched = np.nonzero(~matched)[0]
+    out: BindingTable = {}
+    for k, col in left.items():
+        out[k] = np.concatenate([col[li], col[unmatched]])
+    for k in right_only:
+        out[k] = np.concatenate(
+            [right[k][ri], np.full(len(unmatched), UNBOUND, dtype=right[k].dtype)]
+        )
+    return out
+
+
+def anti_join_tables(left: BindingTable, right: BindingTable) -> BindingTable:
+    """MINUS / NAF semantics: left rows with NO matching right row on the
+    shared variables.  No shared variables ⇒ left unchanged."""
+    shared = sorted(set(left.keys()) & set(right.keys()))
+    ln, rn = table_len(left), table_len(right)
+    if ln == 0 or rn == 0 or not shared:
+        return left
+    lkey, rkey = _pack_shared_keys(left, right, shared, ln)
+    mask = anti_join_mask(lkey, rkey)
+    return {k: v[mask] for k, v in left.items()}
 
 
 def concat_tables(tables: List[BindingTable]) -> BindingTable:

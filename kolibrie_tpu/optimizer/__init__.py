@@ -1,0 +1,20 @@
+"""Streamertail — the cost-based Volcano optimizer and ID-space execution
+engine.
+
+Parity: ``kolibrie/src/streamertail_optimizer/`` (4k LoC): logical → physical
+plan enumeration with memoization, star-join detection, join reordering,
+cardinality estimation from sampled stats, and an execution engine that
+interprets the physical plan entirely in dictionary-ID space (strings decoded
+only at the very end — ``execution/engine.rs:27-57``).
+
+TPU-first difference: physical operators do not pull tuples Volcano-style;
+each operator evaluates to a whole **binding table** (columnar u32 arrays) so
+the hot joins/filters run as vectorized array programs (host numpy or device
+XLA), not per-row loops.
+"""
+
+from kolibrie_tpu.optimizer.planner import Streamertail
+from kolibrie_tpu.optimizer.stats import DatabaseStats
+from kolibrie_tpu.optimizer.engine import ExecutionEngine
+
+__all__ = ["Streamertail", "DatabaseStats", "ExecutionEngine"]

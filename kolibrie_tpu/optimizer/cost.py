@@ -1,0 +1,93 @@
+"""Cost model.
+
+Parity: ``streamertail_optimizer/cost/estimator.rs:20-29`` constants —
+table scan 100/row, index scan 1/row with a discount per bound position,
+hash join 2/row, nested-loop 10/row — and cardinality estimation (:194+).
+"""
+
+from __future__ import annotations
+
+from kolibrie_tpu.optimizer import plan as P
+
+TABLE_SCAN_COST_PER_ROW = 100.0
+INDEX_SCAN_COST_PER_ROW = 1.0
+HASH_JOIN_COST_PER_ROW = 2.0
+NESTED_LOOP_COST_PER_ROW = 10.0
+BOUND_POSITION_DISCOUNT = 10.0  # 10x per bound position (index prefix)
+PARALLEL_SPEEDUP = 4.0
+
+
+class CostEstimator:
+    def __init__(self, stats):
+        self.stats = stats
+
+    # -------------------------------------------------------- cardinalities
+
+    def cardinality(self, op) -> float:
+        if isinstance(op, (P.PhysIndexScan, P.PhysTableScan)):
+            return self.stats.pattern_cardinality(op.pattern)
+        if isinstance(op, (P.PhysHashJoin, P.PhysMergeJoin, P.PhysParallelJoin)):
+            cl = self.cardinality(op.left)
+            cr = self.cardinality(op.right)
+            if not op.join_vars:
+                return cl * cr
+            sel = self.stats.join_selectivity(cl, cr)
+            return max(cl * cr * sel, 1.0)
+        if isinstance(op, P.PhysNestedLoopJoin):
+            return self.cardinality(op.left) * self.cardinality(op.right)
+        if isinstance(op, P.PhysStarJoin):
+            cards = sorted(self.cardinality(s) for s in op.scans)
+            est = cards[0] if cards else 1.0
+            for c in cards[1:]:
+                est = max(est * self.stats.join_selectivity(est, c) * c, 1.0)
+            return est
+        if isinstance(op, P.PhysFilter):
+            return self.cardinality(op.child) * 0.5
+        if isinstance(op, P.PhysBind):
+            return self.cardinality(op.child)
+        if isinstance(op, P.PhysValues):
+            return float(len(op.values.rows))
+        if isinstance(op, P.PhysProjection):
+            return self.cardinality(op.child)
+        if isinstance(op, P.PhysSubquery):
+            return 1000.0
+        return 1.0
+
+    # ---------------------------------------------------------------- costs
+
+    def estimate_cost(self, op) -> float:
+        if isinstance(op, P.PhysTableScan):
+            return self.stats.total_triples * TABLE_SCAN_COST_PER_ROW
+        if isinstance(op, P.PhysIndexScan):
+            bound = sum(
+                1
+                for t in (op.pattern.subject, op.pattern.predicate, op.pattern.object)
+                if t.kind == "id"
+            )
+            rows = self.stats.pattern_cardinality(op.pattern)
+            return max(
+                rows * INDEX_SCAN_COST_PER_ROW / (BOUND_POSITION_DISCOUNT**bound),
+                0.1,
+            )
+        if isinstance(op, (P.PhysHashJoin, P.PhysMergeJoin)):
+            cl, cr = self.cardinality(op.left), self.cardinality(op.right)
+            child_cost = self.estimate_cost(op.left) + self.estimate_cost(op.right)
+            return child_cost + (cl + cr) * HASH_JOIN_COST_PER_ROW
+        if isinstance(op, P.PhysParallelJoin):
+            cl, cr = self.cardinality(op.left), self.cardinality(op.right)
+            child_cost = self.estimate_cost(op.left) + self.estimate_cost(op.right)
+            return child_cost + (cl + cr) * HASH_JOIN_COST_PER_ROW / PARALLEL_SPEEDUP
+        if isinstance(op, P.PhysNestedLoopJoin):
+            cl, cr = self.cardinality(op.left), self.cardinality(op.right)
+            child_cost = self.estimate_cost(op.left) + self.estimate_cost(op.right)
+            return child_cost + cl * cr * NESTED_LOOP_COST_PER_ROW
+        if isinstance(op, P.PhysStarJoin):
+            total = sum(self.estimate_cost(s) for s in op.scans)
+            return total + self.cardinality(op) * HASH_JOIN_COST_PER_ROW
+        if isinstance(op, (P.PhysFilter, P.PhysBind, P.PhysProjection)):
+            return self.estimate_cost(op.child) + self.cardinality(op.child) * 0.1
+        if isinstance(op, P.PhysValues):
+            return float(len(op.values.rows))
+        if isinstance(op, P.PhysSubquery):
+            return 1000.0
+        return 1.0

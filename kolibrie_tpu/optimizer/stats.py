@@ -1,0 +1,74 @@
+"""Sampled database statistics for cardinality estimation.
+
+Parity: ``streamertail_optimizer/stats/database_stats.rs:18-105`` —
+``gather_stats_fast``: ≤100k step-sampled triples, scaled-up per-term
+cardinality maps, and a join-selectivity cache.  Counting is vectorized
+(np.unique) rather than rayon-folded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+SAMPLE_CAP = 100_000
+
+
+class DatabaseStats:
+    def __init__(self) -> None:
+        self.total_triples = 0
+        self.distinct_subjects = 0
+        self.distinct_predicates = 0
+        self.distinct_objects = 0
+        self.predicate_counts: Dict[int, float] = {}
+        self.subject_counts: Dict[int, float] = {}
+        self.object_counts: Dict[int, float] = {}
+        self.join_selectivity_cache: Dict[Tuple[int, int], float] = {}
+
+    @staticmethod
+    def gather_stats_fast(db) -> "DatabaseStats":
+        st = DatabaseStats()
+        s, p, o = db.store.columns()
+        n = len(s)
+        st.total_triples = n
+        if n == 0:
+            return st
+        if n > SAMPLE_CAP:
+            step = n // SAMPLE_CAP
+            idx = np.arange(0, n, step)
+            scale = n / len(idx)
+            s, p, o = s[idx], p[idx], o[idx]
+        else:
+            scale = 1.0
+        us, cs = np.unique(s, return_counts=True)
+        up, cp = np.unique(p, return_counts=True)
+        uo, co = np.unique(o, return_counts=True)
+        st.distinct_subjects = int(len(us) * scale) if scale > 1 else len(us)
+        st.distinct_predicates = len(up)
+        st.distinct_objects = int(len(uo) * scale) if scale > 1 else len(uo)
+        st.subject_counts = dict(zip(us.tolist(), (cs * scale).tolist()))
+        st.predicate_counts = dict(zip(up.tolist(), (cp * scale).tolist()))
+        st.object_counts = dict(zip(uo.tolist(), (co * scale).tolist()))
+        return st
+
+    # ------------------------------------------------------------ estimates
+
+    def pattern_cardinality(self, pattern) -> float:
+        """Estimated matching rows for a triple pattern (constant positions
+        narrow the estimate multiplicatively, mirroring estimator.rs:194+)."""
+        n = float(max(self.total_triples, 1))
+        est = n
+        s, p, o = pattern.subject, pattern.predicate, pattern.object
+        if s.kind == "id":
+            est = min(est, self.subject_counts.get(s.value, 1.0))
+        if p.kind == "id":
+            est = min(est, self.predicate_counts.get(p.value, 1.0))
+        if o.kind == "id":
+            est = min(est, self.object_counts.get(o.value, 1.0))
+        return max(est, 0.0)
+
+    def join_selectivity(self, card_left: float, card_right: float) -> float:
+        """Crude independence assumption over the larger distinct-value side."""
+        denom = max(self.distinct_subjects + self.distinct_objects, 1)
+        return 1.0 / denom

@@ -1,0 +1,477 @@
+"""Query driver: parse → (updates | plan → execute) → post-process → format.
+
+Parity: ``kolibrie/src/execute_query.rs`` — the Volcano path
+``execute_query_rayon_parallel2_volcano`` (:356): TRAIN decls, DELETE (re-issue
+SELECT + substitute + delete), INSERT, logical plan build, memoized
+``Streamertail::find_best_plan``, execution, then the post-pass (subqueries,
+GROUP BY/aggregate, ORDER BY, LIMIT, formatting :607-650).  The legacy
+sequential join path ``execute_query`` (:156) is kept as the naive reference
+implementation for agreement testing (the reference's own most valuable test
+pattern, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.optimizer.engine import UNBOUND, ExecutionEngine, resolve_pattern
+from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
+from kolibrie_tpu.ops.join import (
+    BindingTable,
+    anti_join_tables,
+    concat_tables,
+    equi_join_tables,
+    left_outer_join_tables,
+    table_len,
+)
+from kolibrie_tpu.ops.unique import unique_rows, unique_table
+from kolibrie_tpu.query.ast import (
+    Aggregate,
+    CombinedQuery,
+    DeleteClause,
+    InsertClause,
+    OrderCondition,
+    PatternTerm,
+    PatternTriple,
+    SelectItem,
+    SelectQuery,
+    SubQuery,
+    Var,
+    WhereClause,
+)
+from kolibrie_tpu.query.parser import parse_combined_query
+
+Rows = List[List[str]]
+
+
+# --------------------------------------------------------------------------
+# WHERE evaluation (shared by volcano executor, rules, RSP, ML input queries)
+# --------------------------------------------------------------------------
+
+
+def eval_where(db, where: WhereClause, use_optimizer: bool = True) -> BindingTable:
+    """Evaluate a group graph pattern to a binding table (IDs)."""
+    engine = ExecutionEngine(db, subquery_eval=lambda sq: eval_select_to_table(db, sq.query))
+    resolved = [resolve_pattern(db, p) for p in where.patterns]
+    # filters referencing BIND outputs can only run after the binds
+    bind_vars = {b.var for b in where.binds}
+    plan_filters = [
+        f for f in where.filters if not (set(_filter_vars(f)) & bind_vars)
+    ]
+    post_bind_filters = [
+        f for f in where.filters if set(_filter_vars(f)) & bind_vars
+    ]
+    if use_optimizer:
+        logical = build_logical_plan(resolved, plan_filters, [], where.values)
+        stats = db.get_or_build_stats()
+        planner = Streamertail(stats)
+        plan = planner.find_best_plan(logical)
+        table = engine.execute_with_ids(plan)
+    else:
+        table = _naive_eval(engine, resolved, where, plan_filters)
+    # subqueries join in
+    for sq in where.subqueries:
+        sub = eval_select_to_table(db, sq.query)
+        table = equi_join_tables(table, sub)
+    # UNION groups
+    for groups in where.unions:
+        parts = [eval_where(db, g, use_optimizer) for g in groups]
+        keys = set()
+        for t in parts:
+            keys |= set(t)
+        norm = []
+        for t in parts:
+            nt = dict(t)
+            n = table_len(t)
+            for k in keys:
+                if k not in nt:
+                    nt[k] = np.full(n, UNBOUND, dtype=np.uint32)
+            norm.append(nt)
+        union_table = concat_tables(norm) if norm else {}
+        table = equi_join_tables(table, union_table) if table_len(table) or where.patterns else union_table
+    # OPTIONAL — over the unit table (no preceding clauses produced columns)
+    # join(unit, optional) keeps the optional's solutions
+    for opt in where.optionals:
+        opt_table = eval_where(db, opt, use_optimizer)
+        if (
+            not table
+            and not where.patterns
+            and where.values is None
+            and not where.subqueries
+            and not where.unions
+        ):
+            table = opt_table
+        else:
+            table = left_outer_join_tables(table, opt_table)
+    # MINUS
+    for m in where.minus:
+        table = anti_join_tables(table, eval_where(db, m, use_optimizer))
+    # NOT blocks (NAF)
+    for nb in where.not_blocks:
+        neg_where = WhereClause(patterns=nb.patterns)
+        table = anti_join_tables(table, eval_where(db, neg_where, use_optimizer))
+    # BINDs after joins (may reference any bound variable)
+    for b in where.binds:
+        col = engine.eval_arith_to_ids(b.expr, table)
+        table = dict(table)
+        table[b.var] = col
+    # filters that reference BIND outputs run now
+    for f in post_bind_filters:
+        mask = engine.eval_filter(f, table)
+        table = {k: v[mask] for k, v in table.items()}
+    return table
+
+
+def _filter_vars(expr) -> List[str]:
+    from kolibrie_tpu.query import ast as A
+
+    out: List[str] = []
+
+    def walk(e):
+        if isinstance(e, A.Var):
+            out.append(e.name)
+        elif isinstance(e, A.Comparison):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, (A.LogicalAnd, A.LogicalOr)):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, A.LogicalNot):
+            walk(e.inner)
+        elif isinstance(e, (A.FunctionCall, A.FuncExpr)):
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, A.ArithOp):
+            walk(e.left)
+            walk(e.right)
+
+    walk(expr)
+    return out
+
+
+def _naive_eval(
+    engine: ExecutionEngine, patterns, where: WhereClause, filters
+) -> BindingTable:
+    """Legacy sequential join path (execute_query.rs:156): patterns joined in
+    textual order, filters applied at the end."""
+    table: Optional[BindingTable] = None
+    for pat in patterns:
+        t = engine._scan(pat)
+        table = t if table is None else equi_join_tables(table, t)
+    if table is None:
+        table = {}
+        if where.values is not None:
+            table = engine._values_table(where.values)
+    elif where.values is not None:
+        table = equi_join_tables(table, engine._values_table(where.values))
+    for f in filters:
+        mask = engine.eval_filter(f, table)
+        table = {k: v[mask] for k, v in table.items()}
+    return table
+
+
+# --------------------------------------------------------------------------
+# SELECT execution
+# --------------------------------------------------------------------------
+
+
+def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> BindingTable:
+    """Run a SELECT down to a binding table projected to its variables
+    (aggregates resolved).  Used for subqueries and ML input queries."""
+    table = eval_where(db, q.where, use_optimizer)
+    if q.group_by or any(i.kind == "agg" for i in q.select):
+        table = _group_and_aggregate_table(db, table, q)
+    else:
+        if not q.select_all():
+            keep = [i.var for i in q.select if i.kind == "var" and i.var in table]
+            engine = ExecutionEngine(db)
+            out: BindingTable = {v: table[v] for v in keep}
+            for item in q.select:
+                if item.kind == "expr":
+                    out[item.alias] = engine.eval_arith_to_ids(item.expr, table)
+            table = out
+    if q.distinct:
+        table = unique_table(table)
+    return table
+
+
+def _group_key_cols(table: BindingTable, group_by: List[str]):
+    cols = [table[g] for g in group_by if g in table]
+    return cols
+
+
+def _group_and_aggregate_table(db, table: BindingTable, q: SelectQuery) -> BindingTable:
+    """GROUP BY + aggregates via np.unique segment ids (segment-reduce —
+    device-friendly).  Parity: ``group_and_aggregate_results`` in
+    execute_query.rs."""
+    n = table_len(table)
+    group_by = [g for g in q.group_by if g in table]
+    if group_by:
+        cols = _group_key_cols(table, group_by)
+        stacked = np.stack(cols, axis=1) if cols else np.zeros((n, 0), dtype=np.uint32)
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        n_groups = len(uniq)
+    else:
+        # aggregate without GROUP BY: exactly one group (SPARQL semantics)
+        uniq = None
+        inverse = np.zeros(n, dtype=np.int64)
+        n_groups = 1
+    out: BindingTable = {}
+    for j, g in enumerate(group_by):
+        out[g] = uniq[:, j].astype(np.uint32) if uniq is not None else np.empty(0, dtype=np.uint32)
+    numeric = db.numeric_values()
+    enc = db.dictionary.encode
+    for item in q.select:
+        if item.kind != "agg":
+            continue
+        agg = item.agg
+        vals_col: Optional[np.ndarray] = None
+        if agg.var is not None and agg.var in table:
+            vals_col = table[agg.var]
+        if agg.func == "COUNT":
+            if vals_col is None:
+                counts = np.bincount(inverse, minlength=n_groups) if n else np.zeros(n_groups, dtype=np.int64)
+            elif agg.distinct:
+                counts = np.zeros(n_groups, dtype=np.int64)
+                for g in range(n_groups):
+                    seg = vals_col[inverse == g]
+                    counts[g] = len(np.unique(seg[seg != UNBOUND]))
+            else:
+                counts = np.bincount(inverse, weights=(vals_col != UNBOUND).astype(float), minlength=n_groups).astype(np.int64) if n else np.zeros(n_groups, dtype=np.int64)
+            out[agg.alias] = _encode_numbers(enc, counts.astype(np.float64))
+            continue
+        if vals_col is None:
+            out[agg.alias] = np.full(n_groups, UNBOUND, dtype=np.uint32)
+            continue
+        nums = numeric[np.minimum(vals_col, len(numeric) - 1)] if n else np.empty(0)
+        if agg.func in ("SUM", "AVG", "MIN", "MAX"):
+            res = np.zeros(n_groups, dtype=np.float64)
+            for g in range(n_groups):
+                seg = nums[inverse == g]
+                seg = seg[~np.isnan(seg)]
+                if len(seg) == 0:
+                    res[g] = np.nan
+                elif agg.func == "SUM":
+                    res[g] = seg.sum()
+                elif agg.func == "AVG":
+                    res[g] = seg.mean()
+                elif agg.func == "MIN":
+                    res[g] = seg.min()
+                else:
+                    res[g] = seg.max()
+            out[agg.alias] = _encode_numbers(enc, res)
+        elif agg.func == "SAMPLE":
+            res_ids = np.zeros(n_groups, dtype=np.uint32)
+            for g in range(n_groups):
+                seg = vals_col[inverse == g]
+                res_ids[g] = seg[0] if len(seg) else UNBOUND
+            out[agg.alias] = res_ids
+        elif agg.func == "GROUP_CONCAT":
+            dec = db.decode_term
+            res_ids = np.zeros(n_groups, dtype=np.uint32)
+            for g in range(n_groups):
+                seg = vals_col[inverse == g]
+                parts = [_format_value(dec(int(i))) for i in seg]
+                res_ids[g] = enc('"' + ", ".join(x or "" for x in parts) + '"')
+            out[agg.alias] = res_ids
+        else:
+            raise ValueError(f"unsupported aggregate {agg.func}")
+    return out
+
+
+def _encode_numbers(enc, values: np.ndarray) -> np.ndarray:
+    out = np.empty(len(values), dtype=np.uint32)
+    for i, v in enumerate(values):
+        if np.isnan(v):
+            out[i] = UNBOUND
+        else:
+            sv = str(int(v)) if float(v) == int(v) else f"{v:g}"
+            out[i] = enc(f'"{sv}"')
+    return out
+
+
+# --------------------------------------------------------------------------
+# Ordering / formatting
+# --------------------------------------------------------------------------
+
+
+def _order_table(db, table: BindingTable, order_by: List[OrderCondition]) -> BindingTable:
+    n = table_len(table)
+    if n == 0 or not order_by:
+        return table
+    numeric = db.numeric_values()
+    keys = []
+    for cond in reversed(order_by):
+        if isinstance(cond.expr, Var) and cond.expr.name in table:
+            col = table[cond.expr.name]
+            nums = numeric[np.minimum(col, len(numeric) - 1)]
+            if np.isnan(nums).any():
+                # non-numeric: rank the decoded strings so DESC can negate
+                dec = db.decode_term
+                strs = np.array([dec(int(i)) or "" for i in col])
+                _, order_key = np.unique(strs, return_inverse=True)
+                order_key = order_key.astype(np.float64)
+            else:
+                order_key = nums
+        else:
+            engine = ExecutionEngine(db)
+            nums = engine._try_numeric(cond.expr, table)
+            order_key = nums if nums is not None else np.zeros(n)
+        if cond.descending:
+            order_key = -order_key
+        keys.append(order_key)
+    # stable lexsort over keys (last key = primary)
+    idx = np.lexsort(tuple(keys))
+    return {k: v[idx] for k, v in table.items()}
+
+
+def _format_value(term: Optional[str]) -> str:
+    """Human-facing form: strip literal quotes and datatype suffix."""
+    if term is None:
+        return ""
+    if term.startswith('"'):
+        end = term.rfind('"')
+        if end > 0:
+            return term[1:end]
+    return term
+
+
+def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
+    """Final parallel ID→string decode (engine.rs:34-50 parity)."""
+    if q.select_all():
+        header = sorted(table.keys())
+    else:
+        header = []
+        for item in q.select:
+            if item.kind == "var":
+                header.append(item.var)
+            elif item.kind == "agg":
+                header.append(item.agg.alias)
+            else:
+                header.append(item.alias)
+    n = table_len(table)
+    dec = db.decode_term
+    cols = []
+    for h in header:
+        col = table.get(h)
+        if col is None:
+            cols.append([""] * n)
+        else:
+            cols.append([_format_value(dec(int(i))) if i != UNBOUND else "" for i in col])
+    return [list(row) for row in zip(*cols)] if n else []
+
+
+# --------------------------------------------------------------------------
+# Top-level entry points
+# --------------------------------------------------------------------------
+
+
+def _apply_limit_offset(rows: Rows, q: SelectQuery) -> Rows:
+    start = q.offset or 0
+    end = start + q.limit if q.limit is not None else None
+    return rows[start:end]
+
+
+def execute_select(db, q: SelectQuery, use_optimizer: bool = True) -> Rows:
+    table = eval_select_to_table(db, q, use_optimizer)
+    table = _order_table(db, table, q.order_by)
+    rows = format_results(db, table, q)
+    if not q.order_by:
+        rows.sort()
+    return _apply_limit_offset(rows, q)
+
+
+def process_insert_clause(db, insert: InsertClause) -> int:
+    count = 0
+    for pat in insert.triples:
+        ids = []
+        for t in (pat.subject, pat.predicate, pat.object):
+            if t.is_var:
+                raise ValueError("INSERT DATA cannot contain variables")
+            ids.append(_encode_pattern_term(db, t))
+        db.add_triple(Triple(*ids))
+        count += 1
+    return count
+
+
+def _encode_pattern_term(db, t: PatternTerm) -> int:
+    if t.kind == "quoted":
+        s, p, o = t.value
+        return db.quoted.intern(
+            _encode_pattern_term(db, s),
+            _encode_pattern_term(db, p),
+            _encode_pattern_term(db, o),
+        )
+    return db.dictionary.encode(db.expand_term(t.value))
+
+
+def process_delete_clause(db, delete: DeleteClause) -> int:
+    """DELETE [WHERE]: bind variables from WHERE, substitute into the delete
+    templates, remove (execute_query.rs:395-468)."""
+    count = 0
+    if delete.where is None:
+        for pat in delete.triples:
+            ids = [_encode_pattern_term(db, t) for t in (pat.subject, pat.predicate, pat.object)]
+            db.delete_triple(Triple(*ids))
+            count += 1
+        return count
+    table = eval_where(db, delete.where)
+    n = table_len(table)
+    for pat in delete.triples:
+        cols = []
+        for t in (pat.subject, pat.predicate, pat.object):
+            if t.is_var:
+                col = table.get(t.value)
+                if col is None:
+                    col = np.full(n, UNBOUND, dtype=np.uint32)
+                cols.append(col)
+            else:
+                cols.append(np.full(n, _encode_pattern_term(db, t), dtype=np.uint32))
+        for i in range(n):
+            db.delete_triple(Triple(int(cols[0][i]), int(cols[1][i]), int(cols[2][i])))
+            count += 1
+    return count
+
+
+def execute_query_volcano(sparql: str, db) -> Rows:
+    """The main query path (execute_query.rs:356 parity)."""
+    db.register_prefixes_from_query(sparql)
+    cq = parse_combined_query(sparql, db.prefixes)
+    return execute_combined(db, cq)
+
+
+def execute_combined(db, cq: CombinedQuery) -> Rows:
+    db.prefixes.update(cq.prefixes)
+    # neural/train declarations
+    if cq.models or cq.neural_relations or cq.train_decls or cq.ml_predict:
+        from kolibrie_tpu.ml import runtime as ml_runtime
+
+        ml_runtime.register_declarations(db, cq)
+        for train in cq.train_decls:
+            ml_runtime.execute_train_decl(db, train)
+        if cq.ml_predict is not None:
+            ml_runtime.execute_ml_predict(db, cq.ml_predict)
+    for rule in cq.rules:
+        from kolibrie_tpu.reasoner import rule_runtime
+
+        rule_runtime.process_combined_rule(db, rule)
+    if cq.delete is not None:
+        process_delete_clause(db, cq.delete)
+    if cq.insert is not None:
+        process_insert_clause(db, cq.insert)
+    if cq.select is not None:
+        return execute_select(db, cq.select)
+    return []
+
+
+def execute_query(sparql: str, db) -> Rows:
+    """Legacy sequential path (execute_query.rs:156 parity): same semantics,
+    naive join order, no cost-based planning.  Kept for agreement tests."""
+    db.register_prefixes_from_query(sparql)
+    cq = parse_combined_query(sparql, db.prefixes)
+    if cq.select is None:
+        return execute_combined(db, cq)
+    return execute_select(db, cq.select, use_optimizer=False)

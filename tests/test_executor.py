@@ -1,0 +1,312 @@
+"""End-to-end query execution tests: BGP joins, filters, aggregates, BIND,
+VALUES, subqueries, INSERT/DELETE, RDF-star, optional/union/minus.
+
+Parity targets: kolibrie/tests/integration_test.rs + rdf_star_test.rs and the
+legacy-vs-volcano agreement pattern (SURVEY §4).
+"""
+
+import pytest
+
+from kolibrie_tpu.query.executor import execute_query, execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+EX = "http://example.org/"
+
+EMPLOYEE_TTL = """
+@prefix ex: <http://example.org/> .
+ex:alice a ex:Employee ; ex:name "Alice" ; ex:age 30 ; ex:dept ex:Sales ; ex:salary 50000 .
+ex:bob a ex:Employee ; ex:name "Bob" ; ex:age 25 ; ex:dept ex:Sales ; ex:salary 40000 .
+ex:carol a ex:Employee ; ex:name "Carol" ; ex:age 35 ; ex:dept ex:Engineering ; ex:salary 70000 .
+ex:dave a ex:Employee ; ex:name "Dave" ; ex:age 28 ; ex:dept ex:Engineering ; ex:salary 60000 .
+ex:eve a ex:Manager ; ex:name "Eve" ; ex:age 45 ; ex:dept ex:Engineering ; ex:salary 90000 .
+ex:Sales ex:label "Sales Department" .
+ex:Engineering ex:label "Engineering Department" .
+"""
+
+
+@pytest.fixture
+def db():
+    d = SparqlDatabase()
+    d.parse_turtle(EMPLOYEE_TTL)
+    return d
+
+
+class TestBasicSelect:
+    def test_single_pattern(self, db):
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT ?n WHERE { ?x ex:name ?n }", db
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Bob", "Carol", "Dave", "Eve"]
+
+    def test_bgp_join(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n ?d WHERE { ?x ex:name ?n . ?x ex:dept ?d }""",
+            db,
+        )
+        assert ["Carol", EX + "Engineering"] in rows
+        assert len(rows) == 5
+
+    def test_filter_numeric(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE { ?x ex:name ?n . ?x ex:age ?a . FILTER (?a > 28) }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Carol", "Eve"]
+
+    def test_filter_logical(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE { ?x ex:name ?n . ?x ex:age ?a .
+              FILTER (?a > 28 && ?a < 40) }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Carol"]
+
+    def test_filter_equality_on_terms(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE { ?x ex:name ?n . ?x ex:dept ?d . FILTER (?d = ex:Sales) }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Bob"]
+
+    def test_three_pattern_join_type(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE {
+              ?x a ex:Employee . ?x ex:name ?n . ?x ex:dept ex:Engineering }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Carol", "Dave"]
+
+    def test_limit_offset(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE { ?x ex:name ?n } ORDER BY ?n LIMIT 2 OFFSET 1""",
+            db,
+        )
+        assert [r[0] for r in rows] == ["Bob", "Carol"]
+
+    def test_select_star(self, db):
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT * WHERE { ?x ex:dept ?d }", db
+        )
+        assert len(rows) == 5 and len(rows[0]) == 2
+
+    def test_distinct(self, db):
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT DISTINCT ?d WHERE { ?x ex:dept ?d }",
+            db,
+        )
+        assert len(rows) == 2
+
+
+class TestAggregates:
+    def test_count_group_by(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?d (COUNT(?x) AS ?n) WHERE { ?x ex:dept ?d } GROUP BY ?d""",
+            db,
+        )
+        res = {r[0]: r[1] for r in rows}
+        assert res[EX + "Engineering"] == "3"
+        assert res[EX + "Sales"] == "2"
+
+    def test_avg_sum_min_max(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?d (AVG(?s) AS ?avg) (SUM(?s) AS ?sum) (MIN(?s) AS ?min) (MAX(?s) AS ?max)
+            WHERE { ?x ex:dept ?d . ?x ex:salary ?s } GROUP BY ?d""",
+            db,
+        )
+        res = {r[0]: r[1:] for r in rows}
+        assert res[EX + "Sales"] == ["45000", "90000", "40000", "50000"]
+
+    def test_count_no_group(self, db):
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT (COUNT(?x) AS ?n) WHERE { ?x a ex:Employee }",
+            db,
+        )
+        assert rows == [["4"]]
+
+    def test_order_by_aggregate(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?d (COUNT(?x) AS ?n) WHERE { ?x ex:dept ?d }
+            GROUP BY ?d ORDER BY DESC(?n)""",
+            db,
+        )
+        assert rows[0][0] == EX + "Engineering"
+
+
+class TestBindValues:
+    def test_bind_arithmetic(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n ?a2 WHERE { ?x ex:name ?n . ?x ex:age ?a . BIND(?a * 2 AS ?a2) }""",
+            db,
+        )
+        res = {r[0]: r[1] for r in rows}
+        assert res["Alice"] == "60"
+
+    def test_bind_concat(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?greeting WHERE { ?x ex:name ?n . BIND(CONCAT("Hello, ", ?n) AS ?greeting) }""",
+            db,
+        )
+        assert "Hello, Alice" in [r[0] for r in rows]
+
+    def test_values(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE { VALUES ?x { ex:alice ex:bob } ?x ex:name ?n }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Bob"]
+
+    def test_udf(self, db):
+        db.register_udf("SHOUT", lambda s: (s or "").upper() + "!")
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?y WHERE { ?x ex:name ?n . BIND(SHOUT(?n) AS ?y) }""",
+            db,
+        )
+        assert "ALICE!" in [r[0] for r in rows]
+
+
+class TestSubqueryOptionalUnionMinus:
+    def test_subquery(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n WHERE {
+              ?x ex:name ?n .
+              { SELECT ?x WHERE { ?x ex:dept ex:Sales } }
+            }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == ["Alice", "Bob"]
+
+    def test_optional(self, db):
+        db.parse_turtle("@prefix ex: <http://example.org/> . ex:frank ex:name \"Frank\" .")
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?n ?d WHERE { ?x ex:name ?n OPTIONAL { ?x ex:dept ?d } }""",
+            db,
+        )
+        res = {r[0]: r[1] for r in rows}
+        assert res["Frank"] == ""
+        assert res["Alice"] == EX + "Sales"
+
+    def test_union(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?x WHERE { { ?x a ex:Manager } UNION { ?x ex:dept ex:Sales } }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == [EX + "alice", EX + "bob", EX + "eve"]
+
+    def test_minus(self, db):
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?x WHERE { ?x a ex:Employee MINUS { ?x ex:dept ex:Sales } }""",
+            db,
+        )
+        assert sorted(r[0] for r in rows) == [EX + "carol", EX + "dave"]
+
+
+class TestUpdates:
+    def test_insert(self, db):
+        execute_query_volcano(
+            'PREFIX ex: <http://example.org/> INSERT DATA { ex:frank ex:name "Frank" . }',
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT ?n WHERE { ex:frank ex:name ?n }", db
+        )
+        assert rows == [["Frank"]]
+
+    def test_delete_data(self, db):
+        execute_query_volcano(
+            "PREFIX ex: <http://example.org/> DELETE DATA { ex:alice ex:dept ex:Sales . }",
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT ?x WHERE { ?x ex:dept ex:Sales }", db
+        )
+        assert [r[0] for r in rows] == [EX + "bob"]
+
+    def test_delete_where(self, db):
+        execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            DELETE { ?x ex:salary ?s } WHERE { ?x ex:salary ?s . FILTER(?s > 55000) }""",
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://example.org/> SELECT ?s WHERE { ?x ex:salary ?s }", db
+        )
+        assert sorted(r[0] for r in rows) == ["40000", "50000"]
+
+
+class TestRdfStar:
+    def test_quoted_pattern_query(self, db):
+        db.parse_turtle(
+            """@prefix ex: <http://example.org/> .
+            << ex:alice ex:knows ex:bob >> ex:certainty "0.9" .
+            << ex:bob ex:knows ex:carol >> ex:certainty "0.5" ."""
+        )
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?s ?c WHERE { << ?s ex:knows ?o >> ex:certainty ?c . FILTER (?c > 0.7) }""",
+            db,
+        )
+        assert rows == [[EX + "alice", "0.9"]]
+
+    def test_triple_builtin(self, db):
+        db.parse_turtle(
+            """@prefix ex: <http://example.org/> .
+            << ex:alice ex:knows ex:bob >> ex:certainty "0.9" ."""
+        )
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?sub WHERE {
+              << ?s ex:knows ?o >> ex:certainty ?c .
+              BIND(TRIPLE(?s, ex:knows, ?o) AS ?t) .
+              BIND(SUBJECT(?t) AS ?sub)
+            }""",
+            db,
+        )
+        assert rows == [[EX + "alice"]]
+
+    def test_istriple_filter(self, db):
+        db.parse_turtle(
+            """@prefix ex: <http://example.org/> .
+            << ex:a ex:b ex:c >> ex:p ex:o .
+            ex:plain ex:p ex:o ."""
+        )
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?s WHERE { ?s ex:p ex:o . FILTER (isTRIPLE(?s)) }""",
+            db,
+        )
+        assert rows == [["<< " + EX + "a " + EX + "b " + EX + "c >>"]]
+
+
+class TestAgreement:
+    """Legacy naive path vs Volcano path must agree (SURVEY §4 pattern)."""
+
+    QUERIES = [
+        "PREFIX ex: <http://example.org/> SELECT ?n WHERE { ?x ex:name ?n }",
+        """PREFIX ex: <http://example.org/>
+           SELECT ?n ?d WHERE { ?x ex:name ?n . ?x ex:dept ?d . ?x ex:age ?a . FILTER(?a < 40) }""",
+        """PREFIX ex: <http://example.org/>
+           SELECT ?d (COUNT(?x) AS ?n) WHERE { ?x ex:dept ?d } GROUP BY ?d""",
+    ]
+
+    def test_agreement(self, db):
+        for q in self.QUERIES:
+            naive = execute_query(q, db)
+            volcano = execute_query_volcano(q, db)
+            assert sorted(map(tuple, naive)) == sorted(map(tuple, volcano)), q

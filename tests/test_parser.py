@@ -1,0 +1,357 @@
+"""SPARQL++ parser tests.
+
+Parity: kolibrie/tests/parser_test.rs — all productions incl. 'a' syntax,
+PROB annotations per combination, ML.PREDICT, rules, REGISTER/windows.
+"""
+
+import pytest
+
+from kolibrie_tpu.query.ast import (
+    Comparison,
+    FunctionCall,
+    LogicalAnd,
+    NumberLit,
+    StreamType,
+    SyncPolicyKind,
+    TimeoutFallback,
+    Var,
+    WindowType,
+)
+from kolibrie_tpu.query.parser import (
+    RDF_TYPE,
+    SparqlParseError,
+    parse_combined_query,
+    parse_rule_definition,
+    parse_sparql_query,
+)
+
+EX = {"ex": "http://example.org/"}
+
+
+class TestSelect:
+    def test_basic_select(self):
+        q = parse_sparql_query(
+            """PREFIX ex: <http://example.org/>
+            SELECT ?person ?name WHERE {
+              ?person ex:name ?name .
+              ?person ex:age ?age .
+              FILTER (?age > 18)
+            } LIMIT 10"""
+        )
+        assert [i.var for i in q.select] == ["person", "name"]
+        assert len(q.where.patterns) == 2
+        assert q.where.patterns[0].predicate.value == "http://example.org/name"
+        assert q.limit == 10
+        f = q.where.filters[0]
+        assert isinstance(f, Comparison)
+        assert isinstance(f.left, Var) and f.left.name == "age"
+        assert isinstance(f.right, NumberLit) and f.right.value == 18.0
+
+    def test_a_syntax(self):
+        q = parse_sparql_query(
+            "PREFIX ex: <http://www.example.com/>\nSELECT ?p WHERE { ?p a ex:Test . }"
+        )
+        assert q.where.patterns[0].predicate.value == RDF_TYPE
+
+    def test_semicolon_shorthand(self):
+        q = parse_sparql_query(
+            "PREFIX ex: <http://e/> SELECT ?p WHERE { ?p ex:name \"John\" ; ex:age 25 . }"
+        )
+        assert len(q.where.patterns) == 2
+        assert q.where.patterns[1].subject.value == "p"
+        assert q.where.patterns[1].object.value == '"25"^^http://www.w3.org/2001/XMLSchema#integer'
+
+    def test_select_star_distinct(self):
+        q = parse_sparql_query("SELECT DISTINCT * WHERE { ?s ?p ?o }")
+        assert q.distinct and q.select_all()
+
+    def test_aggregates_group_by(self):
+        q = parse_sparql_query(
+            """PREFIX ex: <http://e/>
+            SELECT ?dept (COUNT(?emp) AS ?n) (AVG(?sal) AS ?avgsal)
+            WHERE { ?emp ex:dept ?dept . ?emp ex:salary ?sal }
+            GROUP BY ?dept ORDER BY DESC(?n) LIMIT 5"""
+        )
+        assert q.select[1].agg.func == "COUNT"
+        assert q.select[1].agg.alias == "n"
+        assert q.select[2].agg.func == "AVG"
+        assert q.group_by == ["dept"]
+        assert q.order_by[0].descending
+
+    def test_bind_values_union_optional(self):
+        q = parse_sparql_query(
+            """PREFIX ex: <http://e/>
+            SELECT ?x ?y WHERE {
+              VALUES ?x { ex:a ex:b }
+              BIND(?a + 1 AS ?y)
+              OPTIONAL { ?x ex:opt ?o }
+              { ?x ex:p ?y } UNION { ?x ex:q ?y }
+            }"""
+        )
+        assert q.where.values.variables == ["x"]
+        assert len(q.where.values.rows) == 2
+        assert q.where.binds[0].var == "y"
+        assert len(q.where.optionals) == 1
+        assert len(q.where.unions) == 1 and len(q.where.unions[0]) == 2
+
+    def test_subquery(self):
+        q = parse_sparql_query(
+            """PREFIX ex: <http://e/>
+            SELECT ?x WHERE {
+              ?x ex:p ?y .
+              { SELECT ?y WHERE { ?y ex:q ?z } }
+            }"""
+        )
+        assert len(q.where.subqueries) == 1
+        assert q.where.subqueries[0].query.select[0].var == "y"
+
+    def test_filter_logic_and_functions(self):
+        q = parse_sparql_query(
+            """SELECT ?x WHERE { ?x ?p ?o .
+               FILTER (?o > 1 && ?o < 10 || BOUND(?x)) }"""
+        )
+        f = q.where.filters[0]
+        # || binds loosest
+        from kolibrie_tpu.query.ast import LogicalOr
+
+        assert isinstance(f, LogicalOr)
+        assert isinstance(f.left, LogicalAnd)
+        assert isinstance(f.right, FunctionCall)
+        assert f.right.name == "BOUND"
+
+    def test_quoted_triple_pattern(self):
+        q = parse_sparql_query(
+            "PREFIX ex: <http://e/> SELECT ?c WHERE { << ?s ex:p ?o >> ex:certainty ?c }"
+        )
+        pat = q.where.patterns[0]
+        assert pat.subject.kind == "quoted"
+        s, p, o = pat.subject.value
+        assert s.kind == "var" and p.value == "http://e/p"
+
+    def test_parse_error_position(self):
+        with pytest.raises(SparqlParseError):
+            parse_sparql_query("SELECT WHERE { ?x ?p ?o }")
+
+
+class TestUpdates:
+    def test_insert(self):
+        cq = parse_combined_query(
+            'PREFIX ex: <http://e/> INSERT DATA { ex:a ex:p "v" . ex:b ex:q ex:c }'
+        )
+        assert len(cq.insert.triples) == 2
+
+    def test_delete_where(self):
+        cq = parse_combined_query(
+            "PREFIX ex: <http://e/> DELETE { ?x ex:p ?y } WHERE { ?x ex:p ?y . FILTER(?y > 3) }"
+        )
+        assert cq.delete.where is not None
+        assert len(cq.delete.triples) == 1
+
+
+class TestRules:
+    def test_basic_rule(self):
+        rule = parse_rule_definition(
+            """RULE :OverheatingAlert :-
+            CONSTRUCT { ?room ex:overheatingAlert true . }
+            WHERE {
+              ?reading ex:room ?room ;
+                       ex:temperature ?temp
+              FILTER (?temp > 80)
+            }""",
+            prefixes={"ex": "http://e/"},
+        )
+        assert rule.name == ":OverheatingAlert" or rule.name.endswith("OverheatingAlert")
+        assert len(rule.conclusions) == 1
+        assert rule.conclusions[0].object.value == '"true"^^http://www.w3.org/2001/XMLSchema#boolean'
+        assert len(rule.body.patterns) == 2
+        assert len(rule.body.filters) == 1
+
+    def test_prob_annotations(self):
+        rule = parse_rule_definition(
+            """RULE :TransitiveRelated PROB(combination=independent, threshold=0.3, confidence=0.9) :-
+            CONSTRUCT { ?x ex:related ?z . }
+            WHERE { ?x ex:related ?y . ?y ex:related ?z . }""",
+            prefixes={"ex": "http://e/"},
+        )
+        assert rule.prob.combination == "addmult"
+        assert abs(rule.prob.threshold - 0.3) < 1e-9
+        assert abs(rule.prob.confidence - 0.9) < 1e-9
+
+    def test_prob_min_topk_wmc(self):
+        r1 = parse_rule_definition(
+            "RULE :R PROB(combination=min, threshold=0.5) :- CONSTRUCT { ?x ex:t ?y . } WHERE { ?x ex:p ?y . }",
+            prefixes={"ex": "http://e/"},
+        )
+        assert r1.prob.combination == "minmax"
+        r2 = parse_rule_definition(
+            "RULE :R PROB(combination=topk, threshold=5) :- CONSTRUCT { ?x ex:t ?y . } WHERE { ?x ex:p ?y . }",
+            prefixes={"ex": "http://e/"},
+        )
+        assert r2.prob.combination == "topk" and r2.prob.k == 5
+        r3 = parse_rule_definition(
+            "RULE :R PROB(combination=wmc) :- CONSTRUCT { ?x ex:t ?y . } WHERE { ?x ex:p ?y . }",
+            prefixes={"ex": "http://e/"},
+        )
+        assert r3.prob.combination == "wmc"
+
+    def test_rule_without_prob(self):
+        r = parse_rule_definition(
+            "RULE :Simple :- CONSTRUCT { ?x ex:t ?y . } WHERE { ?x ex:p ?y . }",
+            prefixes={"ex": "http://e/"},
+        )
+        assert r.prob is None
+
+    def test_rule_with_not_block(self):
+        r = parse_rule_definition(
+            """RULE :NoParent :- CONSTRUCT { ?x ex:orphan true . }
+            WHERE { ?x a ex:Person . NOT { ?x ex:hasParent ?p } }""",
+            prefixes={"ex": "http://e/"},
+        )
+        assert len(r.body.not_blocks) == 1
+        assert r.body.not_blocks[0].patterns[0].predicate.value == "http://e/hasParent"
+
+
+class TestML:
+    def test_model_decl(self):
+        cq = parse_combined_query(
+            """MODEL "mnist_classifier" {
+                ARCH MLP { HIDDEN [64, 32] }
+                OUTPUT EXCLUSIVE { "0", "1", "2" }
+            }"""
+        )
+        decl = cq.models[0]
+        assert decl.name == "mnist_classifier"
+        assert decl.arch.hidden == [64, 32]
+        assert decl.output.kind == "exclusive"
+        assert decl.output.labels == ["0", "1", "2"]
+
+    def test_neural_relation_decl(self):
+        cq = parse_combined_query(
+            """PREFIX ex: <http://e/>
+            NEURAL RELATION ex:predictedDigit USING MODEL "mnist_classifier" {
+                INPUT {
+                    ?sample ex:pixel_0 ?p0 .
+                    ?sample ex:pixel_1 ?p1 .
+                }
+                FEATURES { ?p0, ?p1 }
+            }"""
+        )
+        decl = cq.neural_relations[0]
+        assert decl.predicate == "http://e/predictedDigit"
+        assert decl.model_name == "mnist_classifier"
+        assert len(decl.input_patterns) == 2
+        assert decl.anchor_var == "sample"
+        assert decl.feature_vars == ["p0", "p1"]
+
+    def test_train_decl(self):
+        cq = parse_combined_query(
+            """PREFIX ex: <http://e/>
+            TRAIN NEURAL RELATION ex:predictedDigit {
+                DATA { ?sample ex:label ?label . }
+                LABEL ?label
+                TARGET { ?sample ex:predictedDigit ?label }
+                LOSS cross_entropy
+                OPTIMIZER adam
+                LEARNING_RATE 0.001
+                EPOCHS 50
+                BATCH_SIZE 16
+                SAVE_TO "mnist_digit_model.bin"
+            }"""
+        )
+        decl = cq.train_decls[0]
+        assert decl.relation == "http://e/predictedDigit"
+        assert len(decl.data_patterns) == 1
+        assert decl.label_var == "label"
+        assert decl.target.predicate.value == "http://e/predictedDigit"
+        assert decl.epochs == 50 and decl.batch_size == 16
+        assert decl.learning_rate == 0.001
+        assert decl.save_path == "mnist_digit_model.bin"
+
+    def test_ml_predict_top_level(self):
+        cq = parse_combined_query(
+            """PREFIX ex: <http://e/>
+            ML.PREDICT(
+                MODEL "temperaturePredictor",
+                INPUT { SELECT ?room ?humidity WHERE { ?room ex:humidity ?humidity } },
+                OUTPUT ?predictedTemp
+            )"""
+        )
+        assert cq.ml_predict.model == "temperaturePredictor"
+        assert cq.ml_predict.output_var == "predictedTemp"
+        assert cq.ml_predict.input_select.select[0].var == "room"
+
+
+class TestRSP:
+    def test_register_basic(self):
+        cq = parse_combined_query(
+            """PREFIX ex: <http://e/>
+            REGISTER RSTREAM <http://out/stream> AS
+            SELECT ?a ?b
+            FROM NAMED WINDOW :w ON ?stream [RANGE 10 STEP 10]
+            WHERE { WINDOW :w { ?a ex:p ?b } }"""
+        )
+        reg = cq.register
+        assert reg.stream_type == StreamType.RSTREAM
+        assert reg.output_iri == "http://out/stream"
+        assert len(reg.windows) == 1
+        w = reg.windows[0]
+        assert w.spec.width == 10 and w.spec.slide == 10
+        assert w.stream_iri == "?stream"
+        assert len(reg.select.where.window_blocks) == 1
+
+    def test_window_variants(self):
+        cq = parse_combined_query(
+            """REGISTER ISTREAM <http://out/s> AS SELECT *
+            FROM NAMED WINDOW <http://e/w1> ON <http://e/tempStream> [SLIDING 6 SLIDE 2 REPORT ON_WINDOW_CLOSE TICK TIME_DRIVEN]
+            FROM NAMED WINDOW <http://e/w2> ON <http://e/tempStream2> [TUMBLING 5 REPORT NON_EMPTY_CONTENT TICK TUPLE_DRIVEN]
+            WHERE { WINDOW <http://e/w1> { ?s ?p ?o } }"""
+        )
+        w1, w2 = cq.register.windows
+        assert w1.spec.width == 6 and w1.spec.slide == 2
+        assert w1.spec.window_type == WindowType.SLIDING
+        assert w2.spec.window_type == WindowType.TUMBLING
+        assert w2.spec.width == 5 and w2.spec.slide == 5
+        assert w2.spec.report == "NON_EMPTY_CONTENT"
+        assert w2.spec.tick == "TUPLE_DRIVEN"
+
+    def test_iso_durations_and_policy(self):
+        cq = parse_combined_query(
+            """REGISTER RSTREAM <http://out/s> AS SELECT *
+            FROM NAMED WINDOW :w ON :stream [RANGE PT10M STEP PT1M] WITH POLICY (timeout=5s, fallback=drop)
+            WHERE { WINDOW :w { ?s ?p ?o } }"""
+        )
+        w = cq.register.windows[0]
+        assert w.spec.width == 600 and w.spec.slide == 60
+        assert w.policy.kind == SyncPolicyKind.TIMEOUT
+        assert w.policy.timeout_ms == 5000
+        assert w.policy.fallback == TimeoutFallback.DROP
+
+    def test_policy_steal_wait(self):
+        cq = parse_combined_query(
+            """REGISTER RSTREAM <http://o/s> AS SELECT *
+            FROM NAMED WINDOW :a ON :s1 [RANGE 10 STEP 2] WITH POLICY steal
+            FROM NAMED WINDOW :b ON :s2 [RANGE 10 STEP 2] WITH POLICY wait
+            WHERE { WINDOW :a { ?x ?y ?z } }"""
+        )
+        assert cq.register.windows[0].policy.kind == SyncPolicyKind.STEAL
+        assert cq.register.windows[1].policy.kind == SyncPolicyKind.WAIT
+
+    def test_retrieve(self):
+        cq = parse_combined_query(
+            """RETRIEVE SOME ACTIVE STREAM ?s FROM <http://my.org/catalog>
+            WITH {
+                ?s a :Stream .
+                ?s :hasDescriptor ?d .
+            }
+            REGISTER RSTREAM <http://out/stream> AS
+            SELECT *
+            FROM NAMED WINDOW :wind ON ?s [RANGE PT10M STEP PT1M]
+            WHERE { WINDOW :wind { ?obs :hasSimpleResult ?value . } }""",
+            prefixes={"": "http://base/"},
+        )
+        r = cq.retrieve
+        assert r.mode == "SOME" and r.state == "ACTIVE"
+        assert r.variable == "s"
+        assert r.from_iri == "http://my.org/catalog"
+        assert len(r.with_patterns) == 2
+        assert cq.register is not None

@@ -1,0 +1,208 @@
+"""RDF format parser + SparqlDatabase tests.
+
+Parity targets: reference parse_turtle/parse_ntriples/parse_rdf behavior
+(kolibrie/src/sparql_database.rs:401-1141) and rdf_star_test.rs parsing cases.
+"""
+
+import pytest
+
+from kolibrie_tpu.core.dictionary import is_quoted_triple_id
+from kolibrie_tpu.query.rdf_parsers import (
+    RDF_TYPE,
+    RdfParseError,
+    parse_ntriples,
+    parse_rdf_xml,
+    parse_turtle,
+)
+from kolibrie_tpu.query.sparql_database import SparqlDatabase, split_quoted_triple_content
+
+
+class TestTurtle:
+    def test_basic_prefix_and_shorthand(self):
+        data = """
+        @prefix ex: <http://example.org/> .
+        ex:alice ex:knows ex:bob ;
+                 ex:age "30" .
+        ex:bob ex:knows ex:carol , ex:dave .
+        """
+        triples, prefixes = parse_turtle(data)
+        assert prefixes["ex"] == "http://example.org/"
+        tset = set(triples)
+        assert ("http://example.org/alice", "http://example.org/knows", "http://example.org/bob") in tset
+        assert ("http://example.org/alice", "http://example.org/age", '"30"') in tset
+        assert ("http://example.org/bob", "http://example.org/knows", "http://example.org/carol") in tset
+        assert ("http://example.org/bob", "http://example.org/knows", "http://example.org/dave") in tset
+        assert len(triples) == 4
+
+    def test_a_keyword_and_numbers(self):
+        data = """
+        @prefix ex: <http://example.org/> .
+        ex:x a ex:Person ; ex:age 42 ; ex:height 1.75 ; ex:smart true .
+        """
+        triples, _ = parse_turtle(data)
+        tset = set(triples)
+        assert ("http://example.org/x", RDF_TYPE, "http://example.org/Person") in tset
+        assert ("http://example.org/x", "http://example.org/age", '"42"^^http://www.w3.org/2001/XMLSchema#integer') in tset
+        assert ("http://example.org/x", "http://example.org/height", '"1.75"^^http://www.w3.org/2001/XMLSchema#decimal') in tset
+        assert ("http://example.org/x", "http://example.org/smart", '"true"^^http://www.w3.org/2001/XMLSchema#boolean') in tset
+
+    def test_typed_and_lang_literals(self):
+        data = """
+        @prefix ex: <http://e/> .
+        @prefix xsd: <http://www.w3.org/2001/XMLSchema#> .
+        ex:x ex:name "Alice"@en ; ex:age "30"^^xsd:integer ; ex:note "esc\\"q" .
+        """
+        triples, _ = parse_turtle(data)
+        objs = {t[2] for t in triples}
+        assert '"Alice"@en' in objs
+        assert '"30"^^http://www.w3.org/2001/XMLSchema#integer' in objs
+        assert '"esc"q"' in objs
+
+    def test_turtle_star(self):
+        data = """
+        @prefix ex: <http://e/> .
+        << ex:a ex:b ex:c >> ex:certainty "0.9" .
+        ex:x ex:says << ex:a ex:b ex:c >> .
+        """
+        triples, _ = parse_turtle(data)
+        assert triples[0][0] == ("qt", "http://e/a", "http://e/b", "http://e/c")
+        assert triples[1][2] == ("qt", "http://e/a", "http://e/b", "http://e/c")
+
+    def test_blank_node_property_list(self):
+        data = """
+        @prefix ex: <http://e/> .
+        ex:x ex:addr [ ex:city ex:Leuven ; ex:zip "3000" ] .
+        """
+        triples, _ = parse_turtle(data)
+        tset = set(triples)
+        bnodes = {s for s, p, o in triples if p == "http://e/city"}
+        assert len(bnodes) == 1
+        b = bnodes.pop()
+        assert ("http://e/x", "http://e/addr", b) in tset
+        assert (b, "http://e/zip", '"3000"') in tset
+
+    def test_sparql_style_prefix(self):
+        data = "PREFIX ex: <http://e/>\nex:a ex:b ex:c ."
+        triples, _ = parse_turtle(data)
+        assert triples == [("http://e/a", "http://e/b", "http://e/c")]
+
+    def test_comments_and_errors(self):
+        triples, _ = parse_turtle("# just a comment\n")
+        assert triples == []
+        with pytest.raises(RdfParseError):
+            parse_turtle("ex:a ex:b ex:c .")  # undefined prefix
+        with pytest.raises(RdfParseError):
+            parse_turtle("@prefix ex: <http://e/> .\nex:a ex:b ")  # missing object/dot
+
+
+class TestNTriples:
+    def test_basic(self):
+        data = """
+<http://e/a> <http://e/p> <http://e/b> .
+<http://e/a> <http://e/name> "Alice" .
+"""
+        triples = parse_ntriples(data)
+        assert len(triples) == 2
+        assert triples[0] == ("http://e/a", "http://e/p", "http://e/b")
+
+    def test_ntriples_star(self):
+        data = '<< <http://e/a> <http://e/p> <http://e/b> >> <http://e/conf> "0.8" .'
+        triples = parse_ntriples(data)
+        assert triples[0][0] == ("qt", "http://e/a", "http://e/p", "http://e/b")
+
+
+class TestRdfXml:
+    DATA = """<?xml version="1.0"?>
+<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+         xmlns:ex="http://example.org/">
+  <rdf:Description rdf:about="http://example.org/alice">
+    <ex:knows rdf:resource="http://example.org/bob"/>
+    <ex:age rdf:datatype="http://www.w3.org/2001/XMLSchema#integer">30</ex:age>
+    <ex:name xml:lang="en">Alice</ex:name>
+  </rdf:Description>
+  <ex:Person rdf:about="http://example.org/bob">
+    <ex:friend>
+      <ex:Person rdf:about="http://example.org/carol"/>
+    </ex:friend>
+  </ex:Person>
+</rdf:RDF>"""
+
+    def test_parse(self):
+        triples = set(parse_rdf_xml(self.DATA))
+        ex = "http://example.org/"
+        assert (ex + "alice", ex + "knows", ex + "bob") in triples
+        assert (ex + "alice", ex + "age", '"30"^^http://www.w3.org/2001/XMLSchema#integer') in triples
+        assert (ex + "alice", ex + "name", '"Alice"@en') in triples
+        assert (ex + "bob", RDF_TYPE, ex + "Person") in triples
+        assert (ex + "bob", ex + "friend", ex + "carol") in triples
+        assert (ex + "carol", RDF_TYPE, ex + "Person") in triples
+
+
+class TestSparqlDatabase:
+    def test_ingest_and_decode(self):
+        db = SparqlDatabase()
+        n = db.parse_turtle(
+            "@prefix ex: <http://e/> . ex:a ex:p ex:b . ex:b ex:p ex:c ."
+        )
+        assert n == 2
+        assert len(db) == 2
+        decoded = set(db.iter_decoded())
+        assert ("http://e/a", "http://e/p", "http://e/b") in decoded
+
+    def test_quoted_triples_roundtrip(self):
+        db = SparqlDatabase()
+        db.parse_turtle('@prefix ex: <http://e/> . << ex:a ex:b ex:c >> ex:conf "0.9" .')
+        s, p, o = next(iter(db.store))
+        assert is_quoted_triple_id(s)
+        assert db.decode_term(s) == "<< http://e/a http://e/b http://e/c >>"
+        nt = db.to_ntriples()
+        assert "<< http://e/a http://e/b http://e/c >>" in nt
+
+    def test_encode_term_star(self):
+        db = SparqlDatabase()
+        qid = db.encode_term_str("<< <http://e/a> <http://e/b> <http://e/c> >>")
+        assert is_quoted_triple_id(qid)
+        qid2 = db.encode_term_str("<< << <http://e/a> <http://e/b> <http://e/c> >> <http://e/p> <http://e/o> >>")
+        assert is_quoted_triple_id(qid2)
+        inner = db.quoted.get(qid2)[0]
+        assert inner == qid
+
+    def test_split_quoted_content(self):
+        parts = split_quoted_triple_content('<http://a> <http://b> "a literal"')
+        assert parts == ["<http://a>", "<http://b>", '"a literal"']
+        parts = split_quoted_triple_content("<< <a> <b> <c> >> <p> <o>")
+        assert parts == ["<< <a> <b> <c> >>", "<p>", "<o>"]
+
+    def test_add_delete(self):
+        db = SparqlDatabase()
+        t = db.add_triple_parts("<http://e/a>", "<http://e/p>", '"x"')
+        assert len(db) == 1
+        db.delete_triple(t)
+        assert len(db) == 0
+
+    def test_prefix_registration_from_query(self):
+        db = SparqlDatabase()
+        db.register_prefixes_from_query(
+            "PREFIX foaf: <http://xmlns.com/foaf/0.1/> SELECT ?x WHERE { ?x foaf:knows ?y }"
+        )
+        assert db.prefixes["foaf"] == "http://xmlns.com/foaf/0.1/"
+        assert db.expand_term("foaf:knows") == "http://xmlns.com/foaf/0.1/knows"
+
+    def test_numeric_cache(self):
+        db = SparqlDatabase()
+        db.parse_turtle('@prefix ex: <http://e/> . ex:a ex:age "30" . ex:b ex:age 25 .')
+        vals = db.numeric_values()
+        import numpy as np
+
+        a30 = db.dictionary.lookup('"30"')
+        a25 = db.dictionary.lookup('"25"^^http://www.w3.org/2001/XMLSchema#integer')
+        assert vals[a30] == 30.0
+        assert vals[a25] == 25.0
+        aa = db.dictionary.lookup("http://e/a")
+        assert np.isnan(vals[aa])
+
+    def test_load_file_format_dispatch(self, tmp_path):
+        p = tmp_path / "data.ttl"
+        p.write_text("@prefix ex: <http://e/> . ex:a ex:b ex:c .")
+        db = SparqlDatabase()
+        assert db.load_file(str(p)) == 1
