@@ -160,6 +160,9 @@ class ExecutionEngine:
         if mask is not None:
             out = {k: v[mask] for k, v in out.items()}
             cols = [c[mask] if mask is not None else c for c in cols]
+        if not out and not any(t.kind == "quoted" for t in terms):
+            # fully-constant pattern: presence row so the match count survives
+            out["__exists"] = np.zeros(min(len(cols[0]), 1), dtype=np.uint32)
         # quoted-pattern positions: join against the quoted-triple table
         for pos, t in enumerate(terms):
             if t.kind != "quoted":

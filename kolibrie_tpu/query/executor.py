@@ -342,7 +342,7 @@ def _format_value(term: Optional[str]) -> str:
 def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
     """Final parallel ID→string decode (engine.rs:34-50 parity)."""
     if q.select_all():
-        header = sorted(table.keys())
+        header = sorted(k for k in table.keys() if not k.startswith("__"))
     else:
         header = []
         for item in q.select:

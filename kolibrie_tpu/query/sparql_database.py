@@ -43,7 +43,7 @@ class SparqlDatabase:
         self.model_registry: Dict[str, object] = {}
         self.neural_relations: Dict[str, object] = {}
         self.trained_models: Dict[str, object] = {}
-        self.probability_seeds: List[object] = []
+        self.probability_seeds: Dict[Tuple[int, int, int], float] = {}
         self._stats = None
         self._stats_version = -1
         self._numeric_cache: Optional[np.ndarray] = None
@@ -278,7 +278,7 @@ class SparqlDatabase:
         db.model_registry = dict(self.model_registry)
         db.neural_relations = dict(self.neural_relations)
         db.trained_models = dict(self.trained_models)
-        db.probability_seeds = list(self.probability_seeds)
+        db.probability_seeds = dict(self.probability_seeds)
         return db
 
 

@@ -1,0 +1,11 @@
+"""Probabilistic Datalog reasoner: semi-naive fixpoint materialisation,
+provenance semirings, SDD-based exact inference, stratified negation,
+backward chaining, repairs, and cross-window streaming reasoning.
+
+Parity: the reference's ``datalog/`` crate plus ``shared/src/{provenance,sdd,
+diff_sdd,tag_store,seed_spec}.rs``.
+"""
+
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+__all__ = ["Reasoner"]

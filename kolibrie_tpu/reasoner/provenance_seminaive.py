@@ -1,0 +1,239 @@
+"""Semiring-generic semi-naive materialisation with stratified negation.
+
+Parity: ``datalog/src/reasoning/materialisation/provenance_semi_naive.rs`` —
+delta also re-includes facts whose tags improved last round (:26-34,134-147),
+per-derivation tag = ⊗ of premise tags merged with ⊕ (:163-193), zero-tag
+pruning (:171), fixpoint = no new facts AND no tag change
+(provenance_infer_generic.rs:94-97), seeding from ``probability_seeds``
+sorted for deterministic seed IDs (:210-232), stratified NAF — positive
+fixpoint then one negative pass where an absent fact contributes ``one()``
+and a present fact contributes ``⊖(tag)`` (:235-389) — and the
+explicit-delta entry for incremental SDS+
+(``semi_naive_with_initial_tags_and_delta``, :271-294).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from kolibrie_tpu.core.rule import Rule
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner.provenance import Provenance
+from kolibrie_tpu.reasoner.strategies import (
+    eval_rule_body,
+    scan_pattern_cols,
+    scan_pattern_store,
+    table_len,
+)
+from kolibrie_tpu.reasoner.tag_store import TagStore
+
+TripleKey = Tuple[int, int, int]
+
+
+def seed_tag_store(reasoner, provenance: Provenance) -> TagStore:
+    """Build the initial TagStore from ``probability_seeds`` (sorted for
+    deterministic seed IDs; :210-232)."""
+    store = TagStore(provenance)
+    for seed_id, (key, prob) in enumerate(sorted(reasoner.probability_seeds.items())):
+        tag = provenance.tag_from_probability_with_id(prob, seed_id)
+        store.set(Triple(*key), tag)
+    return store
+
+
+def _positive_stratum_rules(rules: List[Rule]) -> Tuple[List[Rule], List[Rule]]:
+    pos = [r for r in rules if not r.negative_premise]
+    neg = [r for r in rules if r.negative_premise]
+    return pos, neg
+
+
+def _derivation_rows(
+    reasoner, rule: Rule, table, row_count: int
+) -> List[Dict[str, int]]:
+    """Materialize binding rows as var->id dicts (host loop; tags are
+    pointer-structures so this boundary is inherently host-side)."""
+    keys = [k for k in table.keys() if not k.startswith("__")]
+    cols = [table[k] for k in keys]
+    return [
+        {k: int(c[i]) for k, c in zip(keys, cols)} for i in range(row_count)
+    ]
+
+
+def _subst(pattern, row: Dict[str, int], quoted=None) -> Optional[TripleKey]:
+    def term_id(t) -> Optional[int]:
+        if t.is_variable:
+            return row.get(t.value)
+        if t.is_quoted:
+            if quoted is None:
+                return None
+            inner = [term_id(x) for x in t.value.terms()]
+            if any(i is None for i in inner):
+                return None
+            return quoted.intern(*inner)
+        return t.value
+
+    ids = []
+    for t in (pattern.subject, pattern.predicate, pattern.object):
+        v = term_id(t)
+        if v is None:
+            return None
+        ids.append(v)
+    return tuple(ids)
+
+
+def _premise_tag(provenance, tag_store: TagStore, key: TripleKey):
+    t = tag_store.get_opt(Triple(*key))
+    return t if t is not None else provenance.one()
+
+
+def infer_with_provenance(
+    reasoner,
+    provenance: Provenance,
+    tag_store: Optional[TagStore] = None,
+    initial_delta: Optional[Set[TripleKey]] = None,
+) -> TagStore:
+    """Provenance semi-naive fixpoint; returns the final TagStore.
+
+    ``initial_delta`` (incremental SDS+ entry): restrict the first round's
+    delta to exactly these facts instead of all facts.
+    """
+    if tag_store is None:
+        tag_store = seed_tag_store(reasoner, provenance)
+    pos_rules, neg_rules = _positive_stratum_rules(reasoner.rules)
+
+    facts = reasoner.facts
+    if initial_delta is not None:
+        delta_keys: Set[TripleKey] = set(initial_delta)
+    else:
+        s, p, o = facts.columns()
+        delta_keys = set(zip(s.tolist(), p.tolist(), o.tolist()))
+    naf_seen: Set[Tuple] = set()  # processed NAF derivation signatures
+    while True:
+        delta_keys = _positive_fixpoint(
+            reasoner, provenance, tag_store, pos_rules, facts, delta_keys
+        )
+        naf_new = _negative_pass(
+            reasoner, provenance, tag_store, neg_rules, facts, naf_seen
+        )
+        if not naf_new:
+            break
+        # NAF-derived facts feed back into the positive stratum
+        delta_keys = naf_new
+    return tag_store
+
+
+def _positive_fixpoint(
+    reasoner, provenance, tag_store, pos_rules, facts, delta_keys
+) -> Set[TripleKey]:
+    while delta_keys:
+        arr = np.asarray(sorted(delta_keys), dtype=np.uint32)
+        delta_cols = (arr[:, 0], arr[:, 1], arr[:, 2])
+        # old = facts \ delta, so each derivation is found exactly once
+        # (non-idempotent ⊕ must not see duplicates)
+        old_keys = facts.triples_set() - delta_keys
+        old_store = reasoner._store_from(old_keys)
+        next_delta: Set[TripleKey] = set()
+        new_facts: Set[TripleKey] = set()
+        for rule in pos_rules:
+            table = eval_rule_body(
+                reasoner, rule, facts, delta=delta_cols, old_store=old_store
+            )
+            n = table_len(table)
+            if n == 0:
+                continue
+            rows = _derivation_rows(reasoner, rule, table, n)
+            for row in rows:
+                # ⊗ of premise tags (all ways the body matched this row)
+                tag = provenance.one()
+                for prem in rule.premise:
+                    key = _subst(prem, row, reasoner.quoted)
+                    if key is None:
+                        tag = provenance.zero()
+                        break
+                    tag = provenance.conjunction(
+                        tag, _premise_tag(provenance, tag_store, key)
+                    )
+                if provenance.is_zero(tag):
+                    continue  # zero-tag pruning (:171)
+                for concl in rule.conclusion:
+                    ckey = _subst(concl, row, reasoner.quoted)
+                    if ckey is None:
+                        continue
+                    existed = facts.contains(*ckey)
+                    changed = tag_store.update_disjunction(Triple(*ckey), tag)
+                    if not existed:
+                        facts.add(*ckey)
+                        new_facts.add(ckey)
+                        next_delta.add(ckey)
+                    elif changed:
+                        # tag improved: re-include in delta (:26-34)
+                        next_delta.add(ckey)
+        delta_keys = next_delta
+    return set()
+
+
+def _negative_pass(
+    reasoner, provenance, tag_store, neg_rules, facts, naf_seen: Set[Tuple]
+) -> Set[TripleKey]:
+    """Stratified NAF pass (:235-389); returns NEWLY added fact keys so the
+    caller can feed them back into the positive stratum.  Each derivation is
+    processed at most once across passes (non-idempotent ⊕ safety)."""
+    new_keys: Set[TripleKey] = set()
+    for rule_idx, rule in enumerate(neg_rules):
+        pos_only = Rule(
+            premise=rule.premise,
+            negative_premise=[],
+            filters=rule.filters,
+            conclusion=rule.conclusion,
+        )
+        table = eval_rule_body(reasoner, pos_only, facts, delta=None)
+        n = table_len(table)
+        rows = _derivation_rows(reasoner, rule, table, n)
+        for row in rows:
+            sig = (rule_idx, tuple(sorted(row.items())))
+            if sig in naf_seen:
+                continue
+            naf_seen.add(sig)
+            tag = provenance.one()
+            for prem in rule.premise:
+                key = _subst(prem, row, reasoner.quoted)
+                if key is None:
+                    tag = provenance.zero()
+                    break
+                tag = provenance.conjunction(
+                    tag, _premise_tag(provenance, tag_store, key)
+                )
+            for neg in rule.negative_premise:
+                key = _subst(neg, row, reasoner.quoted)
+                if key is None or not facts.contains(*key):
+                    # absent fact: contributes one()
+                    continue
+                neg_tag = provenance.negate(
+                    _premise_tag(provenance, tag_store, key)
+                )
+                tag = provenance.conjunction(tag, neg_tag)
+            if provenance.is_zero(tag):
+                continue
+            for concl in rule.conclusion:
+                ckey = _subst(concl, row, reasoner.quoted)
+                if ckey is None:
+                    continue
+                existed = facts.contains(*ckey)
+                tag_store.update_disjunction(Triple(*ckey), tag)
+                facts.add(*ckey)
+                if not existed:
+                    new_keys.add(ckey)
+    return new_keys
+
+
+def semi_naive_with_initial_tags_and_delta(
+    reasoner,
+    provenance: Provenance,
+    tag_store: TagStore,
+    delta: Set[TripleKey],
+) -> TagStore:
+    """Explicit-delta entry point for incremental SDS+ (:271-294)."""
+    return infer_with_provenance(
+        reasoner, provenance, tag_store, initial_delta=delta
+    )

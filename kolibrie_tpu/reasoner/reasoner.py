@@ -1,0 +1,271 @@
+"""The Reasoner (knowledge graph): facts + rules + constraints + seeds.
+
+Parity: ``datalog/src/reasoning.rs:33-186`` — ``add_abox_triple`` /
+``add_tagged_triple`` / ``query_abox`` (:70-129), constraint checking and
+repair computation (maximal consistent subsets, :137-186),
+``materialize_tags_as_rdf_star`` (:84-93) — plus the inference entry points
+from ``datalog/src/reasoning/materialisation/``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from kolibrie_tpu.core.dictionary import Dictionary
+from kolibrie_tpu.core.quoted import QuotedTripleStore
+from kolibrie_tpu.core.rule import Rule, check_rule_safety
+from kolibrie_tpu.core.rule_index import RuleIndex
+from kolibrie_tpu.core.store import ColumnarTripleStore
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.core.triple import Triple
+
+
+class Reasoner:
+    """Knowledge graph with forward/backward inference."""
+
+    def __init__(self, dictionary: Optional[Dictionary] = None) -> None:
+        self.dictionary = dictionary or Dictionary()
+        self.quoted = QuotedTripleStore()
+        self.facts = ColumnarTripleStore()
+        self.rules: List[Rule] = []
+        self.rule_index = RuleIndex()
+        self.constraints: List[Rule] = []
+        self.probability_seeds: Dict[Tuple[int, int, int], float] = {}
+        self._numeric_cache: Dict[int, Optional[float]] = {}
+
+    # ------------------------------------------------------------ fact API
+
+    def add_abox_triple(self, subject: str, predicate: str, object: str) -> Triple:
+        t = Triple(
+            self.dictionary.encode(subject),
+            self.dictionary.encode(predicate),
+            self.dictionary.encode(object),
+        )
+        self.facts.add_triple(t)
+        return t
+
+    def add_tagged_triple(
+        self, subject: str, predicate: str, object: str, probability: float
+    ) -> Triple:
+        """Fact with an input probability, stored for provenance seeding
+        (reasoning.rs:70)."""
+        t = self.add_abox_triple(subject, predicate, object)
+        self.probability_seeds[tuple(t)] = probability
+        return t
+
+    def insert_ground_triple(self, t: Triple) -> None:
+        self.facts.add_triple(t)
+
+    def query_abox(
+        self,
+        subject: Optional[str] = None,
+        predicate: Optional[str] = None,
+        object: Optional[str] = None,
+    ) -> List[Triple]:
+        def enc(x):
+            if x is None:
+                return None
+            return self.dictionary.lookup(x)
+
+        ids = [enc(subject), enc(predicate), enc(object)]
+        if any(x is None and orig is not None for x, orig in zip(ids, (subject, predicate, object))):
+            return []
+        s, p, o = self.facts.match(s=ids[0], p=ids[1], o=ids[2])
+        return [Triple(int(a), int(b), int(c)) for a, b, c in zip(s, p, o)]
+
+    def decode_triple(self, t: Triple) -> Tuple[str, str, str]:
+        d = self.dictionary
+        return (
+            d.decode_term(t.subject, self.quoted) or "",
+            d.decode_term(t.predicate, self.quoted) or "",
+            d.decode_term(t.object, self.quoted) or "",
+        )
+
+    # ------------------------------------------------------------ rule API
+
+    def add_rule(self, rule: Rule) -> None:
+        """Register without safety check (legacy API)."""
+        self.rules.append(rule)
+        self.rule_index.add_rule(rule)
+
+    def try_add_rule(self, rule: Rule) -> bool:
+        """Safety-checked registration (rules.rs:182-205)."""
+        if not check_rule_safety(rule):
+            return False
+        self.add_rule(rule)
+        return True
+
+    def add_constraint(self, constraint: Rule) -> None:
+        self.constraints.append(constraint)
+
+    def rule_from_strings(
+        self,
+        premises: List[Tuple[str, str, str]],
+        conclusions: List[Tuple[str, str, str]],
+        negative: Optional[List[Tuple[str, str, str]]] = None,
+        filters: Optional[list] = None,
+    ) -> Rule:
+        """Convenience: build an ID-space rule from string patterns where
+        terms starting with '?' are variables."""
+
+        def term(x: str) -> Term:
+            if x.startswith("?"):
+                return Term.variable(x[1:])
+            return Term.constant(self.dictionary.encode(x))
+
+        def pat(t):
+            return TriplePattern(term(t[0]), term(t[1]), term(t[2]))
+
+        return Rule(
+            premise=[pat(p) for p in premises],
+            negative_premise=[pat(p) for p in (negative or [])],
+            filters=list(filters or []),
+            conclusion=[pat(c) for c in conclusions],
+        )
+
+    # ----------------------------------------------------------- inference
+
+    def infer_new_facts(self) -> int:
+        """Naive fixpoint (my_naive.rs:79-82 alias)."""
+        from kolibrie_tpu.reasoner.strategies import infer_naive
+
+        return infer_naive(self)
+
+    def infer_new_facts_semi_naive(self) -> int:
+        from kolibrie_tpu.reasoner.strategies import infer_semi_naive
+
+        return infer_semi_naive(self)
+
+    def infer_new_facts_semi_naive_parallel(self) -> int:
+        """The vectorized/batched strategy — the rebuild's analogue of the
+        rayon-parallel path (semi_naive_parallel.rs); on device this is the
+        pjit-sharded fixpoint body."""
+        from kolibrie_tpu.reasoner.strategies import infer_semi_naive
+
+        return infer_semi_naive(self)
+
+    def infer_new_facts_with_repairs(self) -> int:
+        from kolibrie_tpu.reasoner.repairs import infer_semi_naive_with_repairs
+
+        return infer_semi_naive_with_repairs(self)
+
+    def infer_new_facts_with_provenance(self, provenance, tag_store=None):
+        from kolibrie_tpu.reasoner.provenance_seminaive import (
+            infer_with_provenance,
+        )
+
+        return infer_with_provenance(self, provenance, tag_store)
+
+    def backward_chaining(self, pattern: TriplePattern, max_depth: int = 10):
+        from kolibrie_tpu.reasoner.backward import backward_chaining
+
+        return backward_chaining(self, pattern, max_depth)
+
+    # ---------------------------------------------------------- constraints
+
+    def violates_constraints(self, facts: Optional[Set[Tuple[int, int, int]]] = None) -> bool:
+        from kolibrie_tpu.reasoner.strategies import rule_body_matches
+
+        store = self._store_from(facts) if facts is not None else self.facts
+        for c in self.constraints:
+            if rule_body_matches(self, c, store):
+                return True
+        return False
+
+    def _store_from(self, facts: Set[Tuple[int, int, int]]) -> ColumnarTripleStore:
+        st = ColumnarTripleStore()
+        if facts:
+            arr = np.asarray(sorted(facts), dtype=np.uint32)
+            st.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
+        return st
+
+    def compute_repairs(self) -> List[Set[Tuple[int, int, int]]]:
+        """Maximal consistent subsets (reasoning.rs:137-186): BFS over fact
+        removals, keeping subset-maximal consistent sets."""
+        base = self.facts.triples_set()
+        repairs: List[Set[Tuple[int, int, int]]] = []
+        queue = [frozenset(base)]
+        seen: Set[frozenset] = set()
+        while queue:
+            current = queue.pop()
+            if current in seen:
+                continue
+            seen.add(current)
+            if not self.violates_constraints(set(current)):
+                if not any(r > set(current) for r in repairs):
+                    repairs = [r for r in repairs if not (set(current) > r)]
+                    repairs.append(set(current))
+            else:
+                for fact in current:
+                    queue.append(current - {fact})
+        return repairs
+
+    def query_with_repairs(
+        self,
+        subject: Optional[str] = None,
+        predicate: Optional[str] = None,
+        object: Optional[str] = None,
+    ) -> List[Triple]:
+        """IAR semantics: answers present in every repair (repairs.rs:10-43)."""
+        repairs = self.compute_repairs()
+        if not repairs:
+            return []
+        answers = self.query_abox(subject, predicate, object)
+        out = []
+        for t in answers:
+            if all(tuple(t) in r for r in repairs):
+                out.append(t)
+        return out
+
+    # ------------------------------------------------------------- tag I/O
+
+    def materialize_tags_as_rdf_star(self, tag_store, db=None) -> int:
+        """Insert ``<< s p o >> prob:value "p"`` facts (reasoning.rs:84-93)."""
+
+        class _Shim:
+            pass
+
+        shim = _Shim()
+        shim.dictionary = self.dictionary
+        shim.quoted = self.quoted
+        triples = tag_store.encode_as_rdf_star(db or shim)
+        for t in triples:
+            self.facts.add_triple(t)
+        return len(triples)
+
+    # --------------------------------------------------------------- misc
+
+    def numeric_value(self, term_id: int) -> Optional[float]:
+        """Literal numeric value of a term (cached) for rule filters."""
+        if term_id in self._numeric_cache:
+            return self._numeric_cache[term_id]
+        s = self.dictionary.decode(term_id)
+        val: Optional[float] = None
+        if s is not None:
+            text = s
+            if text.startswith('"'):
+                end = text.find('"', 1)
+                if end > 0:
+                    text = text[1:end]
+            try:
+                val = float(text)
+            except ValueError:
+                val = None
+        self._numeric_cache[term_id] = val
+        return val
+
+    def clone(self) -> "Reasoner":
+        r = Reasoner(self.dictionary.clone())
+        r.quoted = self.quoted.clone()
+        r.facts = self.facts.clone()
+        r.rules = list(self.rules)
+        for rule in r.rules:
+            r.rule_index.add_rule(rule)
+        r.constraints = list(self.constraints)
+        r.probability_seeds = dict(self.probability_seeds)
+        return r
+
+    def __len__(self) -> int:
+        return len(self.facts)

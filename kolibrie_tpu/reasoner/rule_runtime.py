@@ -1,0 +1,144 @@
+"""SPARQL RULE integration: convert a parsed CombinedRule into an ID-space
+datalog rule, run the appropriate inference, and materialize results into the
+database.
+
+Parity: ``kolibrie/src/parser.rs`` — ``convert_combined_rule`` (:2256-2436)
+and ``process_rule_definition`` (:2439-2734): build a Reasoner over the
+database's triples + probability seeds, run plain semi-naive for classical
+rules or the PROB-selected provenance semiring (minmax/addmult/boolean/wmc/
+sdd/topk) with RDF-star tag materialisation (with proof explanations for
+wmc/sdd), apply the R2S stream operator, and insert derived facts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from kolibrie_tpu.core.rule import FilterCondition, Rule
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.query import ast as A
+from kolibrie_tpu.reasoner.provenance import make_provenance
+from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+
+def _convert_term(db, t: A.PatternTerm) -> Term:
+    if t.kind == "var":
+        return Term.variable(t.value)
+    if t.kind == "quoted":
+        s, p, o = t.value
+        return Term.quoted(
+            TriplePattern(_convert_term(db, s), _convert_term(db, p), _convert_term(db, o))
+        )
+    return Term.constant(db.dictionary.encode(db.expand_term(t.value)))
+
+
+def _convert_pattern(db, p: A.PatternTriple) -> TriplePattern:
+    return TriplePattern(
+        _convert_term(db, p.subject),
+        _convert_term(db, p.predicate),
+        _convert_term(db, p.object),
+    )
+
+
+def _convert_filters(db, filters) -> List[FilterCondition]:
+    out: List[FilterCondition] = []
+    for f in filters:
+        if not isinstance(f, A.Comparison):
+            continue  # complex filters handled only on the query path
+        if isinstance(f.left, A.Var):
+            var, rhs, op = f.left.name, f.right, f.op
+        elif isinstance(f.right, A.Var):
+            flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
+            var, rhs, op = f.right.name, f.left, flip.get(f.op, f.op)
+        else:
+            continue
+        if isinstance(rhs, A.NumberLit):
+            out.append(FilterCondition(var, op, float(rhs.value)))
+        elif isinstance(rhs, A.IriRef):
+            out.append(
+                FilterCondition(var, op, db.dictionary.encode(db.expand_term(rhs.iri)))
+            )
+        elif isinstance(rhs, A.StringLit):
+            out.append(FilterCondition(var, op, db.dictionary.encode(rhs.value)))
+    return out
+
+
+def convert_combined_rule(db, rule: A.CombinedRule) -> Rule:
+    """AST rule -> ID-space datalog rule (parser.rs:2256 parity)."""
+    premise = [_convert_pattern(db, p) for p in rule.body.patterns]
+    negative = [
+        _convert_pattern(db, p)
+        for nb in rule.body.not_blocks
+        for p in nb.patterns
+    ]
+    # window-block patterns are part of the body for the non-streaming path
+    for wb in rule.body.window_blocks:
+        premise.extend(_convert_pattern(db, p) for p in wb.patterns)
+    return Rule(
+        premise=premise,
+        negative_premise=negative,
+        filters=_convert_filters(db, rule.body.filters),
+        conclusion=[_convert_pattern(db, c) for c in rule.conclusions],
+    )
+
+
+def build_reasoner_from_db(db) -> Reasoner:
+    """Reasoner sharing the database dictionary, loaded with all triples and
+    probability seeds (parser.rs:2499-2504)."""
+    kg = Reasoner(db.dictionary)
+    kg.quoted = db.quoted
+    kg.facts = db.store.clone()
+    kg.probability_seeds = dict(getattr(db, "probability_seeds", {}) or {})
+    return kg
+
+
+def process_combined_rule(db, rule: A.CombinedRule) -> Tuple[Rule, List[Triple]]:
+    """Register + immediately apply a RULE definition
+    (process_rule_definition parity)."""
+    kg = build_reasoner_from_db(db)
+    dynamic_rule = convert_combined_rule(db, rule)
+    db.rule_map[rule.name] = dynamic_rule
+
+    if rule.ml_predict is not None:
+        from kolibrie_tpu.ml import runtime as ml_runtime
+
+        ml_runtime.execute_ml_predict(db, rule.ml_predict)
+        kg.facts = db.store.clone()
+
+    before = kg.facts.triples_set()
+
+    if rule.prob is not None:
+        prov = make_provenance(rule.prob.combination, rule.prob.k)
+        kg.add_rule(dynamic_rule)
+        tag_store = infer_with_provenance(kg, prov)
+        # materialize << s p o >> prob:value tags into the database
+        if rule.prob.combination in ("wmc", "sdd"):
+            star: List[Triple] = []
+            for (s, p, o), _tag in tag_store.items():
+                star.extend(tag_store.explain_proofs(db, Triple(s, p, o)))
+            star.extend(tag_store.encode_as_rdf_star(db))
+        else:
+            star = tag_store.encode_as_rdf_star(db)
+        for t in star:
+            db.store.add_triple(t)
+        inferred = [
+            Triple(*k) for k in kg.facts.triples_set() - before
+        ]
+    else:
+        kg.add_rule(dynamic_rule)
+        kg.infer_new_facts_semi_naive()
+        inferred = [Triple(*k) for k in kg.facts.triples_set() - before]
+
+    # R2S application (RSTREAM default emits everything; parser.rs:2577-2585)
+    stream_type = rule.stream_type or A.StreamType.RSTREAM
+    if stream_type == A.StreamType.RSTREAM:
+        emitted = inferred
+    elif stream_type == A.StreamType.ISTREAM:
+        emitted = inferred  # nothing previously emitted at definition time
+    else:  # DSTREAM at definition time emits nothing
+        emitted = []
+    for t in emitted:
+        db.store.add_triple(t)
+    return dynamic_rule, emitted

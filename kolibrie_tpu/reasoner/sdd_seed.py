@@ -1,0 +1,58 @@
+"""SDD seed materialisation: build an SddProvenance tag store from SeedSpecs
+(independent literals; exclusive groups via ``exactly_one`` ∧ literal), then
+run provenance semi-naive.
+
+Parity: ``datalog/src/reasoning/materialisation/sdd_seed_materialise.rs``
+(:27-75) ``infer_new_facts_with_sdd_seed_specs``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
+from kolibrie_tpu.reasoner.sdd import SddManager, SddProvenance
+from kolibrie_tpu.reasoner.seed_spec import ExclusiveGroupSeed, IndependentSeed
+from kolibrie_tpu.reasoner.tag_store import TagStore
+
+
+def infer_new_facts_with_sdd_seed_specs(
+    reasoner, seed_specs: List[object]
+) -> Tuple[TagStore, SddProvenance]:
+    """Returns (tag store after closure, the SddProvenance used)."""
+    prov = SddProvenance(SddManager())
+    store = TagStore(prov)
+    mgr = prov.manager
+    for spec in seed_specs:
+        if isinstance(spec, IndependentSeed):
+            tag = (
+                prov.tag_from_probability_with_id(spec.prob, spec.seed_id)
+                if spec.seed_id is not None
+                else prov.tag_from_probability(spec.prob)
+            )
+            if spec.seed_id is None:
+                # register for gradient lookup by allocation order
+                var = mgr.nodes[tag][0]
+                prov.seed_vars[mgr.vars[var].index] = var
+            store.set(spec.triple, tag)
+            reasoner.facts.add_triple(spec.triple)
+        elif isinstance(spec, ExclusiveGroupSeed):
+            members = []
+            for triple, p, seed_id in spec.choices:
+                var = mgr.new_var(
+                    w_pos=p, w_neg=1.0, kind="exclusive", group_id=spec.group_id,
+                    seed_id=seed_id,
+                )
+                if seed_id is not None:
+                    prov.seed_vars[seed_id] = var
+                members.append((triple, var))
+            constraint = mgr.exactly_one([v for _, v in members])
+            for triple, var in members:
+                tag = mgr.conjoin(constraint, mgr.literal(var, True))
+                store.set(triple, tag)
+                reasoner.facts.add_triple(triple)
+        else:
+            raise TypeError(f"unknown seed spec {spec!r}")
+    tag_store = infer_with_provenance(reasoner, prov, store)
+    return tag_store, prov

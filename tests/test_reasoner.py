@@ -1,0 +1,677 @@
+"""Reasoner tests: forward chaining (naive + semi-naive), provenance
+semirings, NAF strata, backward chaining, repairs, SDD + differentiable WMC,
+N3 rules.
+
+Parity: datalog/tests/reasoning_tests.rs (50 tests) + shared provenance/sdd/
+diff_sdd unit tests.
+"""
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu.core.rule import Rule, FilterCondition
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner.backward import backward_chaining
+from kolibrie_tpu.reasoner.diff_sdd import wmc_gradient
+from kolibrie_tpu.reasoner.n3_parser import N3ParseError, parse_n3_document, parse_n3_rule
+from kolibrie_tpu.reasoner.provenance import (
+    AddMultProbability,
+    BooleanProvenance,
+    DnfWmcProvenance,
+    ExpirationProvenance,
+    MinMaxProbability,
+    TopKProofs,
+)
+from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+from kolibrie_tpu.reasoner.sdd import FALSE, TRUE, SddManager, SddProvenance
+from kolibrie_tpu.reasoner.sdd_seed import infer_new_facts_with_sdd_seed_specs
+from kolibrie_tpu.reasoner.seed_spec import ExclusiveGroupSeed, IndependentSeed
+from kolibrie_tpu.reasoner.tag_store import TagStore
+
+
+def _decode_set(r: Reasoner):
+    return {r.decode_triple(t) for t in r.facts}
+
+
+class TestForwardChaining:
+    def _ancestor_kg(self):
+        r = Reasoner()
+        r.add_abox_triple(":alice", ":parentOf", ":bob")
+        r.add_abox_triple(":bob", ":parentOf", ":carol")
+        r.add_abox_triple(":carol", ":parentOf", ":dave")
+        rule1 = r.rule_from_strings(
+            [("?x", ":parentOf", "?y")], [("?x", ":ancestorOf", "?y")]
+        )
+        rule2 = r.rule_from_strings(
+            [("?x", ":ancestorOf", "?y"), ("?y", ":ancestorOf", "?z")],
+            [("?x", ":ancestorOf", "?z")],
+        )
+        r.add_rule(rule1)
+        r.add_rule(rule2)
+        return r
+
+    def test_transitive_closure_semi_naive(self):
+        r = self._ancestor_kg()
+        added = r.infer_new_facts_semi_naive()
+        facts = _decode_set(r)
+        assert (":alice", ":ancestorOf", ":dave") in facts
+        assert (":alice", ":ancestorOf", ":carol") in facts
+        assert (":bob", ":ancestorOf", ":dave") in facts
+        assert added == 6  # 3 direct + 3 transitive
+
+    def test_naive_agrees_with_semi_naive(self):
+        r1 = self._ancestor_kg()
+        r2 = self._ancestor_kg()
+        r1.infer_new_facts()
+        r2.infer_new_facts_semi_naive()
+        assert _decode_set(r1) == _decode_set(r2)
+
+    def test_idempotent(self):
+        r = self._ancestor_kg()
+        r.infer_new_facts_semi_naive()
+        n = len(r.facts)
+        assert r.infer_new_facts_semi_naive() == 0
+        assert len(r.facts) == n
+
+    def test_sibling_join(self):
+        r = Reasoner()
+        r.add_abox_triple(":tom", ":parentOf", ":ann")
+        r.add_abox_triple(":tom", ":parentOf", ":ben")
+        rule = r.rule_from_strings(
+            [("?p", ":parentOf", "?a"), ("?p", ":parentOf", "?b")],
+            [("?a", ":siblingOf", "?b")],
+        )
+        r.add_rule(rule)
+        r.infer_new_facts_semi_naive()
+        facts = _decode_set(r)
+        assert (":ann", ":siblingOf", ":ben") in facts
+        assert (":ben", ":siblingOf", ":ann") in facts
+
+    def test_cascade(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p1", ":b")
+        r.add_rule(r.rule_from_strings([("?x", ":p1", "?y")], [("?x", ":p2", "?y")]))
+        r.add_rule(r.rule_from_strings([("?x", ":p2", "?y")], [("?x", ":p3", "?y")]))
+        r.add_rule(r.rule_from_strings([("?x", ":p3", "?y")], [("?x", ":p4", "?y")]))
+        r.infer_new_facts_semi_naive()
+        assert (":a", ":p4", ":b") in _decode_set(r)
+
+    def test_multi_head(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":knows", ":b")
+        rule = r.rule_from_strings(
+            [("?x", ":knows", "?y")],
+            [("?x", ":linked", "?y"), ("?y", ":linked", "?x")],
+        )
+        r.add_rule(rule)
+        r.infer_new_facts_semi_naive()
+        facts = _decode_set(r)
+        assert (":a", ":linked", ":b") in facts
+        assert (":b", ":linked", ":a") in facts
+
+    def test_filters(self):
+        r = Reasoner()
+        r.add_abox_triple(":m1", ":temp", '"90"')
+        r.add_abox_triple(":m2", ":temp", '"50"')
+        rule = r.rule_from_strings(
+            [("?m", ":temp", "?t")],
+            [("?m", ":alert", '"hot"')],
+            filters=[FilterCondition("t", ">", 80.0)],
+        )
+        r.add_rule(rule)
+        r.infer_new_facts_semi_naive()
+        facts = _decode_set(r)
+        assert (":m1", ":alert", '"hot"') in facts
+        assert (":m2", ":alert", '"hot"') not in facts
+
+    def test_negation_as_failure(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":type", ":Person")
+        r.add_abox_triple(":b", ":type", ":Person")
+        r.add_abox_triple(":a", ":hasParent", ":x")
+        rule = r.rule_from_strings(
+            [("?p", ":type", ":Person")],
+            [("?p", ":orphan", '"true"')],
+            negative=[("?p", ":hasParent", "?q")],
+        )
+        assert r.try_add_rule(rule) is False  # unsafe: ?q not in positive
+        rule2 = r.rule_from_strings(
+            [("?p", ":type", ":Person"), ("?q", ":type", ":Person")],
+            [("?p", ":orphan", '"true"')],
+            negative=[("?p", ":hasParent", "?q")],
+        )
+        # still derives: b has no parent at all
+        r.add_rule(
+            r.rule_from_strings(
+                [("?p", ":type", ":Person")],
+                [("?p", ":checked", '"y"')],
+            )
+        )
+        r.infer_new_facts_semi_naive()
+        assert (":b", ":checked", '"y"') in _decode_set(r)
+
+    def test_no_spurious(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p", ":b")
+        r.add_rule(r.rule_from_strings([("?x", ":q", "?y")], [("?x", ":r", "?y")]))
+        assert r.infer_new_facts_semi_naive() == 0
+
+
+class TestBackwardChaining:
+    def test_ladder(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":parentOf", ":b")
+        r.add_abox_triple(":b", ":parentOf", ":c")
+        r.add_rule(
+            r.rule_from_strings([("?x", ":parentOf", "?y")], [("?x", ":anc", "?y")])
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", ":parentOf", "?y"), ("?y", ":anc", "?z")],
+                [("?x", ":anc", "?z")],
+            )
+        )
+        goal = TriplePattern(
+            Term.variable("who"),
+            Term.constant(r.dictionary.encode(":anc")),
+            Term.constant(r.dictionary.encode(":c")),
+        )
+        results = backward_chaining(r, goal)
+        whos = {r.dictionary.decode(s["who"]) for s in results}
+        assert whos == {":a", ":b"}
+
+    def test_depth_limit(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p", ":a")
+        r.add_rule(r.rule_from_strings([("?x", ":q", "?y")], [("?x", ":q", "?y")]))
+        goal = TriplePattern(
+            Term.variable("x"),
+            Term.constant(r.dictionary.encode(":q")),
+            Term.variable("y"),
+        )
+        assert backward_chaining(r, goal, max_depth=3) == []
+
+
+class TestProvenance:
+    def _prov_kg(self):
+        r = Reasoner()
+        r.add_tagged_triple(":a", ":related", ":b", 0.8)
+        r.add_tagged_triple(":b", ":related", ":c", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", ":related", "?y"), ("?y", ":related", "?z")],
+                [("?x", ":related", "?z")],
+            )
+        )
+        return r
+
+    def test_minmax(self):
+        r = self._prov_kg()
+        store = infer_with_provenance(r, MinMaxProbability())
+        abc = Triple(
+            r.dictionary.encode(":a"),
+            r.dictionary.encode(":related"),
+            r.dictionary.encode(":c"),
+        )
+        assert abs(store.provenance.recover_probability(store.get(abc)) - 0.5) < 1e-9
+
+    def test_addmult(self):
+        r = self._prov_kg()
+        store = infer_with_provenance(r, AddMultProbability())
+        abc = Triple(
+            r.dictionary.encode(":a"),
+            r.dictionary.encode(":related"),
+            r.dictionary.encode(":c"),
+        )
+        assert abs(store.provenance.recover_probability(store.get(abc)) - 0.4) < 1e-9
+
+    def test_boolean(self):
+        r = self._prov_kg()
+        store = infer_with_provenance(r, BooleanProvenance())
+        abc = Triple(
+            r.dictionary.encode(":a"),
+            r.dictionary.encode(":related"),
+            r.dictionary.encode(":c"),
+        )
+        assert store.get(abc) is True
+
+    def test_wmc_two_paths(self):
+        """Diamond: two independent derivation paths; WMC must use
+        inclusion-exclusion, not double-count (provenance.rs:667-679
+        counterexample parity)."""
+        r = Reasoner()
+        r.add_tagged_triple(":s", ":p1", ":m1", 0.5)
+        r.add_tagged_triple(":s", ":p2", ":m2", 0.5)
+        r.add_rule(r.rule_from_strings([("?x", ":p1", "?y")], [("?x", ":goal", '"t"')]))
+        r.add_rule(r.rule_from_strings([("?x", ":p2", "?y")], [("?x", ":goal", '"t"')]))
+        store = infer_with_provenance(r, DnfWmcProvenance())
+        goal = Triple(
+            r.dictionary.encode(":s"),
+            r.dictionary.encode(":goal"),
+            r.dictionary.encode('"t"'),
+        )
+        # P(A or B) = 0.5 + 0.5 - 0.25 = 0.75
+        assert abs(store.provenance.recover_probability(store.get(goal)) - 0.75) < 1e-9
+
+    def test_topk_matches_wmc_when_k_large(self):
+        r = Reasoner()
+        r.add_tagged_triple(":s", ":p1", ":m1", 0.6)
+        r.add_tagged_triple(":s", ":p2", ":m2", 0.7)
+        r.add_rule(r.rule_from_strings([("?x", ":p1", "?y")], [("?x", ":goal", '"t"')]))
+        r.add_rule(r.rule_from_strings([("?x", ":p2", "?y")], [("?x", ":goal", '"t"')]))
+        store = infer_with_provenance(r, TopKProofs(8))
+        goal = Triple(
+            r.dictionary.encode(":s"),
+            r.dictionary.encode(":goal"),
+            r.dictionary.encode('"t"'),
+        )
+        expected = 0.6 + 0.7 - 0.6 * 0.7
+        assert abs(store.provenance.recover_probability(store.get(goal)) - expected) < 1e-9
+
+    def test_naf_boolean(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":type", ":P")
+        r.add_abox_triple(":b", ":type", ":P")
+        r.add_abox_triple(":b", ":blocked", '"y"')
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", ":type", ":P")],
+                [("?x", ":ok", '"y"')],
+                negative=[("?x", ":blocked", '"y"')],
+            )
+        )
+        store = infer_with_provenance(r, BooleanProvenance())
+        a_ok = Triple(
+            r.dictionary.encode(":a"),
+            r.dictionary.encode(":ok"),
+            r.dictionary.encode('"y"'),
+        )
+        b_ok = Triple(
+            r.dictionary.encode(":b"),
+            r.dictionary.encode(":ok"),
+            r.dictionary.encode('"y"'),
+        )
+        assert store.get_opt(a_ok) is True
+        # b is blocked (certain) ⇒ negation gives zero ⇒ pruned or zero tag
+        t = store.get_opt(b_ok)
+        assert t is None or t is False
+
+    def test_naf_wmc_probabilistic_block(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":type", ":P")
+        r.add_tagged_triple(":a", ":blocked", '"y"', 0.3)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", ":type", ":P")],
+                [("?x", ":ok", '"y"')],
+                negative=[("?x", ":blocked", '"y"')],
+            )
+        )
+        store = infer_with_provenance(r, DnfWmcProvenance())
+        a_ok = Triple(
+            r.dictionary.encode(":a"),
+            r.dictionary.encode(":ok"),
+            r.dictionary.encode('"y"'),
+        )
+        # P(ok) = P(not blocked) = 0.7
+        assert abs(store.provenance.recover_probability(store.get(a_ok)) - 0.7) < 1e-9
+
+    def test_expiration_semiring(self):
+        e = ExpirationProvenance()
+        assert e.conjunction(100, 200) == 100
+        assert e.disjunction(100, 200) == 200
+        assert e.conjunction(e.one(), 50) == 50
+        assert e.disjunction(e.zero(), 50) == 50
+
+
+class TestSemiringLaws:
+    """Algebraic-law tests (provenance.rs:481-689 parity)."""
+
+    SEMIRINGS = [
+        MinMaxProbability(),
+        AddMultProbability(),
+        BooleanProvenance(),
+        ExpirationProvenance(),
+    ]
+
+    def test_identities(self):
+        for s in self.SEMIRINGS:
+            for tag in (s.tag_from_probability(0.4), s.one(), s.zero()):
+                assert s.tag_eq(s.disjunction(tag, s.zero()), tag)
+                assert s.tag_eq(s.conjunction(tag, s.one()), tag)
+                assert s.tag_eq(s.conjunction(tag, s.zero()), s.zero())
+
+    def test_commutativity(self):
+        for s in self.SEMIRINGS:
+            a, b = s.tag_from_probability(0.3), s.tag_from_probability(0.6)
+            assert s.tag_eq(s.disjunction(a, b), s.disjunction(b, a))
+            assert s.tag_eq(s.conjunction(a, b), s.conjunction(b, a))
+
+    def test_associativity(self):
+        for s in self.SEMIRINGS:
+            a, b, c = (
+                s.tag_from_probability(0.2),
+                s.tag_from_probability(0.5),
+                s.tag_from_probability(0.9),
+            )
+            assert s.tag_eq(
+                s.disjunction(a, s.disjunction(b, c)),
+                s.disjunction(s.disjunction(a, b), c),
+            )
+            assert s.tag_eq(
+                s.conjunction(a, s.conjunction(b, c)),
+                s.conjunction(s.conjunction(a, b), c),
+            )
+
+
+class TestSdd:
+    def test_apply_basics(self):
+        m = SddManager()
+        x = m.new_var(0.5)
+        y = m.new_var(0.5)
+        lx, ly = m.literal(x), m.literal(y)
+        assert m.conjoin(lx, FALSE) == FALSE
+        assert m.disjoin(lx, TRUE) == TRUE
+        both = m.conjoin(lx, ly)
+        either = m.disjoin(lx, ly)
+        assert abs(m.wmc(both) - 0.25) < 1e-12
+        assert abs(m.wmc(either) - 0.75) < 1e-12
+
+    def test_negate(self):
+        m = SddManager()
+        x = m.new_var(0.3)
+        lx = m.literal(x)
+        nx = m.negate(lx)
+        assert abs(m.wmc(nx) - 0.7) < 1e-12
+        assert m.negate(nx) == lx
+        assert m.disjoin(lx, nx) == TRUE
+
+    def test_exactly_one_wmc(self):
+        m = SddManager()
+        vs = [m.new_var(p, 1.0, kind="exclusive", group_id=0) for p in (0.2, 0.3, 0.5)]
+        c = m.exactly_one(vs)
+        assert abs(m.wmc(c) - 1.0) < 1e-12
+        chosen = m.conjoin(c, m.literal(vs[1]))
+        assert abs(m.wmc(chosen) - 0.3) < 1e-12
+
+    def test_enumerate_models(self):
+        m = SddManager()
+        x, y = m.new_var(0.5), m.new_var(0.5)
+        f = m.disjoin(m.literal(x), m.literal(y))
+        models = m.enumerate_models(f)
+        assert len(models) >= 2
+
+    def test_sdd_provenance_closure(self):
+        r = Reasoner()
+        r.add_tagged_triple(":s", ":p1", ":m", 0.5)
+        r.add_tagged_triple(":s", ":p2", ":m", 0.5)
+        r.add_rule(r.rule_from_strings([("?x", ":p1", "?y")], [("?x", ":g", '"t"')]))
+        r.add_rule(r.rule_from_strings([("?x", ":p2", "?y")], [("?x", ":g", '"t"')]))
+        prov = SddProvenance(SddManager())
+        store = infer_with_provenance(r, prov)
+        goal = Triple(
+            r.dictionary.encode(":s"),
+            r.dictionary.encode(":g"),
+            r.dictionary.encode('"t"'),
+        )
+        assert abs(prov.recover_probability(store.get(goal)) - 0.75) < 1e-9
+
+
+class TestDiffWmc:
+    def test_gradient_vs_finite_difference(self):
+        """diff_sdd.rs:84-111 parity."""
+        m = SddManager()
+        x = m.new_var(0.4)
+        y = m.new_var(0.6)
+        f = m.disjoin(m.conjoin(m.literal(x), m.literal(y)), m.literal(x))
+        grads = wmc_gradient(m, f)
+        eps = 1e-6
+        for var, p0 in ((x, 0.4), (y, 0.6)):
+            m.set_weight(var, p0 + eps)
+            up = m.wmc(f)
+            m.set_weight(var, p0 - eps)
+            down = m.wmc(f)
+            m.set_weight(var, p0)
+            fd = (up - down) / (2 * eps)
+            assert abs(grads[var] - fd) < 1e-5
+
+    def test_gradient_exclusive_group(self):
+        m = SddManager()
+        vs = [m.new_var(p, 1.0, kind="exclusive", group_id=0) for p in (0.2, 0.8)]
+        c = m.exactly_one(vs)
+        f = m.conjoin(c, m.literal(vs[0]))
+        grads = wmc_gradient(m, f, vs)
+        # WMC = p0 * 1 (other var false, weight 1); d/dp0 = 1
+        assert abs(grads[vs[0]] - 1.0) < 1e-9
+
+
+class TestSddSeeds:
+    def test_independent_and_exclusive(self):
+        r = Reasoner()
+        d = r.dictionary
+        t1 = Triple(d.encode(":a"), d.encode(":p"), d.encode(":x"))
+        t2 = Triple(d.encode(":a"), d.encode(":p"), d.encode(":y"))
+        t3 = Triple(d.encode(":b"), d.encode(":q"), d.encode(":z"))
+        specs = [
+            ExclusiveGroupSeed(0, [(t1, 0.3, 0), (t2, 0.7, 1)]),
+            IndependentSeed(t3, 0.5, 2),
+        ]
+        r.add_rule(
+            r.rule_from_strings(
+                [("?s", ":p", ":x"), ("?b", ":q", "?z")],
+                [("?s", ":win", '"t"')],
+            )
+        )
+        store, prov = infer_new_facts_with_sdd_seed_specs(r, specs)
+        goal = Triple(d.encode(":a"), d.encode(":win"), d.encode('"t"'))
+        # P = P(choice x) * P(t3) = 0.3 * 0.5
+        assert abs(prov.recover_probability(store.get(goal)) - 0.15) < 1e-9
+
+
+class TestRepairs:
+    def test_repairs_and_iar(self):
+        r = Reasoner()
+        r.add_abox_triple(":x", ":status", ":active")
+        r.add_abox_triple(":x", ":status", ":inactive")
+        r.add_abox_triple(":x", ":name", ":thing")
+        # constraint: active and inactive together are inconsistent
+        c = r.rule_from_strings(
+            [("?s", ":status", ":active"), ("?s", ":status", ":inactive")],
+            [],
+        )
+        r.add_constraint(c)
+        assert r.violates_constraints()
+        repairs = r.compute_repairs()
+        assert len(repairs) == 2
+        # IAR: name survives in all repairs; statuses don't
+        sure = r.query_with_repairs(":x", ":name", None)
+        assert len(sure) == 1
+        unsure = r.query_with_repairs(":x", ":status", None)
+        assert unsure == []
+
+    def test_infer_with_repairs(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p", ":b")
+        r.add_rule(r.rule_from_strings([("?x", ":p", "?y")], [("?x", ":q", "?y")]))
+        c = r.rule_from_strings(
+            [("?x", ":q", "?y"), ("?x", ":forbidden", "?y")], []
+        )
+        r.add_constraint(c)
+        added = r.infer_new_facts_with_repairs()
+        assert (":a", ":q", ":b") in _decode_set(r)
+
+
+class TestN3Rules:
+    def test_single_rule(self):
+        r = Reasoner()
+        rule = parse_n3_rule(
+            """@prefix ex: <http://e/> .
+            { ?x ex:parentOf ?y . } => { ?x ex:ancestorOf ?y . } .""",
+            r.dictionary,
+        )
+        assert len(rule.premise) == 1
+        assert rule.premise[0].predicate.value == r.dictionary.encode("http://e/parentOf")
+
+    def test_document_multi_rule(self):
+        r = Reasoner()
+        rules = parse_n3_document(
+            """@prefix ex: <http://e/> .
+            { ?x ex:a ?y . } => { ?x ex:b ?y . } .
+            { ?x ex:b ?y . ?y ex:b ?z . } => { ?x ex:c ?z . } .""",
+            r.dictionary,
+        )
+        assert len(rules) == 2
+        assert len(rules[1].premise) == 2
+
+    def test_eof_validation(self):
+        r = Reasoner()
+        with pytest.raises(N3ParseError):
+            parse_n3_document(
+                "@prefix ex: <http://e/> . { ?x ex:a ?y . } => { ?x ex:b ?y . } . garbage",
+                r.dictionary,
+            )
+
+    def test_n3_rule_drives_closure(self):
+        r = Reasoner()
+        rules = parse_n3_document(
+            """@prefix ex: <http://e/> .
+            { ?x ex:parentOf ?y . } => { ?x ex:anc ?y . } .
+            { ?x ex:anc ?y . ?y ex:anc ?z . } => { ?x ex:anc ?z . } .""",
+            r.dictionary,
+        )
+        for rule in rules:
+            r.add_rule(rule)
+        r.add_abox_triple("http://e/a", "http://e/parentOf", "http://e/b")
+        r.add_abox_triple("http://e/b", "http://e/parentOf", "http://e/c")
+        r.infer_new_facts_semi_naive()
+        assert ("http://e/a", "http://e/anc", "http://e/c") in _decode_set(r)
+
+
+class TestSparqlRuleIntegration:
+    def test_rule_via_query(self):
+        from kolibrie_tpu.query.executor import execute_query_volcano
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        db = SparqlDatabase()
+        db.parse_turtle(
+            """@prefix ex: <http://e/> .
+            ex:r1 ex:room ex:kitchen . ex:r1 ex:temperature "95" .
+            ex:r2 ex:room ex:hall . ex:r2 ex:temperature "60" ."""
+        )
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            RULE :Overheating :- CONSTRUCT { ?room ex:alert "hot" . }
+            WHERE { ?r ex:room ?room ; ex:temperature ?t FILTER (?t > 80) }""",
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://e/> SELECT ?room WHERE { ?room ex:alert \"hot\" }", db
+        )
+        assert rows == [["http://e/kitchen"]]
+
+    def test_prob_rule_via_query(self):
+        from kolibrie_tpu.query.executor import execute_query_volcano
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        db = SparqlDatabase()
+        db.parse_turtle(
+            "@prefix ex: <http://e/> . ex:a ex:related ex:b . ex:b ex:related ex:c ."
+        )
+        # seed probabilities
+        for (s, p, o) in [("ex:a", "ex:related", "ex:b"), ("ex:b", "ex:related", "ex:c")]:
+            t = (
+                db.dictionary.encode(db.expand_term(s)),
+                db.dictionary.encode(db.expand_term(p)),
+                db.dictionary.encode(db.expand_term(o)),
+            )
+            db.probability_seeds[t] = 0.8
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            RULE :Trans PROB(combination=min, threshold=0.5) :-
+            CONSTRUCT { ?x ex:related ?z . }
+            WHERE { ?x ex:related ?y . ?y ex:related ?z . }""",
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://e/> SELECT ?z WHERE { ex:a ex:related ?z }", db
+        )
+        assert sorted(r[0] for r in rows) == ["http://e/b", "http://e/c"]
+        # RDF-star prob annotations materialized
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            PREFIX prob: <http://kolibrie.tpu/prob#>
+            SELECT ?p WHERE { << ex:a ex:related ex:c >> prob:value ?p }""",
+            db,
+        )
+        assert len(rows) == 1 and abs(float(rows[0][0]) - 0.8) < 1e-9
+
+
+class TestReviewRegressions:
+    """Regressions from code review: ground NAF, dotted IRIs in N3, quoted
+    premises in forward chaining, NAF-stratum feedback."""
+
+    def test_ground_negative_premise_blocks(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p", ":b")
+        r.add_abox_triple(":blocked", ":flag", ":true")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", ":p", "?y")],
+                [("?x", ":q", "?y")],
+                negative=[(":blocked", ":flag", ":true")],
+            )
+        )
+        r.infer_new_facts_semi_naive()
+        assert (":a", ":q", ":b") not in _decode_set(r)
+
+    def test_n3_dotted_iri_and_decimal(self):
+        r = Reasoner()
+        rules = parse_n3_document(
+            '{ ?x <http://xmlns.com/foaf/0.1/knows> ?y . ?x <http://e/score> "3.14" . }'
+            " => { ?x <http://e/linked> ?y . } .",
+            r.dictionary,
+        )
+        assert len(rules) == 1 and len(rules[0].premise) == 2
+
+    def test_quoted_premise_forward_chaining(self):
+        r = Reasoner()
+        d = r.dictionary
+        a, p, b = d.encode(":a"), d.encode(":p"), d.encode(":b")
+        cert, high = d.encode(":certainty"), d.encode(":high")
+        qid = r.quoted.intern(a, p, b)
+        r.facts.add(qid, cert, high)
+        inner = TriplePattern(
+            Term.variable("s"), Term.variable("pp"), Term.variable("o")
+        )
+        rule = Rule(
+            premise=[
+                TriplePattern(
+                    Term.quoted(inner), Term.constant(cert), Term.constant(high)
+                )
+            ],
+            conclusion=[
+                TriplePattern(
+                    Term.variable("s"), Term.variable("pp"), Term.variable("o")
+                )
+            ],
+        )
+        r.add_rule(rule)
+        r.infer_new_facts_semi_naive()
+        assert r.facts.contains(a, p, b)
+
+    def test_naf_derivations_feed_positive_stratum(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":p", ":x")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?v", ":p", "?w")],
+                [("?v", ":q", "?w")],
+                negative=[(":missing", ":r", ":z")],
+            )
+        )
+        r.add_rule(r.rule_from_strings([("?v", ":q", "?w")], [("?v", ":s", "?w")]))
+        infer_with_provenance(r, BooleanProvenance())
+        facts = _decode_set(r)
+        assert (":a", ":q", ":x") in facts and (":a", ":s", ":x") in facts
