@@ -388,7 +388,7 @@ class ProbAnnotation:
     combination: str = "minmax"  # minmax | addmult | boolean | topk | wmc | sdd
     threshold: Optional[float] = None
     confidence: Optional[float] = None
-    k: int = 8
+    k: int = 5  # topk proof budget (reference default, parser.rs:2679)
 
 
 @dataclass

@@ -339,19 +339,25 @@ def _format_value(term: Optional[str]) -> str:
     return term
 
 
+def table_header(table: BindingTable, q: SelectQuery) -> List[str]:
+    """Output column names for a SELECT over a binding table (internal
+    ``__``-prefixed columns excluded)."""
+    if q.select_all():
+        return sorted(k for k in table.keys() if not k.startswith("__"))
+    header = []
+    for item in q.select:
+        if item.kind == "var":
+            header.append(item.var)
+        elif item.kind == "agg":
+            header.append(item.agg.alias)
+        else:
+            header.append(item.alias)
+    return header
+
+
 def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
     """Final parallel ID→string decode (engine.rs:34-50 parity)."""
-    if q.select_all():
-        header = sorted(k for k in table.keys() if not k.startswith("__"))
-    else:
-        header = []
-        for item in q.select:
-            if item.kind == "var":
-                header.append(item.var)
-            elif item.kind == "agg":
-                header.append(item.agg.alias)
-            else:
-                header.append(item.alias)
+    header = table_header(table, q)
     n = table_len(table)
     dec = db.decode_term
     cols = []

@@ -1000,6 +1000,7 @@ class SparqlParser:
         ts.expect_kw("prob")
         ts.expect_punct("(")
         ann = ProbAnnotation()
+        explicit_k = None
         while not ts.is_punct(")"):
             key = ts.next().text.lower()
             if not ts.take_op("="):
@@ -1009,15 +1010,19 @@ class SparqlParser:
             if key in ("combination", "provenance"):
                 ann.combination = _normalize_combination(val)
             elif key == "threshold":
-                if ann.combination == "topk":
-                    ann.k = int(float(val))
                 ann.threshold = float(val)
             elif key == "confidence":
                 ann.confidence = float(val)
             elif key == "k":
-                ann.k = int(float(val))
+                explicit_k = int(float(val))
             ts.take_punct(",")
         ts.next()
+        # topk reads k from the threshold field at use time, key-order
+        # independent; default 5 (parser.rs:2679 unwrap_or(5))
+        if explicit_k is not None:
+            ann.k = explicit_k
+        elif ann.combination == "topk":
+            ann.k = int(ann.threshold) if ann.threshold is not None else 5
         return ann
 
     # ----------------------------------------------------- ML declarations

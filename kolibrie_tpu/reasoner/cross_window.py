@@ -1,0 +1,243 @@
+"""Cross-window streaming reasoning: the RSP-QL Streaming Dataset (SDS) model
+and its naive / incremental materialisation (SDS+).
+
+Parity: ``datalog/src/cross_window_sds.rs`` — predicate annotation =
+windowIRI+localName (:17-19), ``Sds{windows: WindowData{alpha, triples},
+static_graphs, output_iris}`` (:45-59), ``translate_sds_to_datalog`` (alive
+facts with expiry = event_time + α, static = u64::MAX, :82-122),
+``translate_datalog_back`` / ``sds_with_expiry_to_external`` (:126-182) —
+plus ``cross_window_naive.rs`` (full recomputation) and
+``cross_window_incremental.rs`` (D_old = unexpired prior facts max-merged,
+D_new = facts whose expiry improved, ExpirationProvenance TagStore, provenance
+semi-naive with initial delta = D_new only).
+
+The expiry tags are u64 columns under the Expiration semiring — the
+device-friendliest semiring (min/max reductions on the VPU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from kolibrie_tpu.core.dictionary import Dictionary
+from kolibrie_tpu.core.rule import Rule
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner.provenance import ExpirationProvenance
+from kolibrie_tpu.reasoner.provenance_seminaive import (
+    semi_naive_with_initial_tags_and_delta,
+)
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+from kolibrie_tpu.reasoner.tag_store import TagStore
+
+U64_MAX = ExpirationProvenance.FOREVER
+
+CROSS_WINDOW_STATIC_IRI = "urn:kolibrie:static:"
+
+
+def annotate_predicate(window_iri: str, local_name: str) -> str:
+    return window_iri + local_name
+
+
+def strip_window_prefix(
+    annotated: str, known_iris: List[str]
+) -> Optional[Tuple[str, str]]:
+    """Longest-prefix strip (caller passes IRIs sorted longest-first)."""
+    for iri in known_iris:
+        if annotated.startswith(iri):
+            return iri, annotated[len(iri):]
+    return None
+
+
+@dataclass
+class WindowedTriple:
+    subject: str
+    predicate: str  # LOCAL name under the owning window IRI
+    object: str
+    event_time: int
+
+
+@dataclass
+class WindowData:
+    alpha: int  # window width
+    triples: List[WindowedTriple] = field(default_factory=list)
+
+
+@dataclass
+class Sds:
+    """An RSP-QL Streaming Dataset at a point in time."""
+
+    windows: Dict[str, WindowData] = field(default_factory=dict)
+    static_graphs: Dict[str, List[Tuple[str, str, str]]] = field(default_factory=dict)
+    output_iris: Set[str] = field(default_factory=set)
+
+
+def all_component_iris(sds: Sds) -> List[str]:
+    iris = (
+        list(sds.windows.keys())
+        + list(sds.static_graphs.keys())
+        + list(sds.output_iris)
+    )
+    iris.sort(key=len, reverse=True)
+    return iris
+
+
+def translate_sds_to_datalog(
+    sds: Sds, dictionary: Dictionary, current_time: int
+) -> List[Tuple[Triple, int]]:
+    """Alive facts annotated with expiry; static facts get expiry = ∞."""
+    out: List[Tuple[Triple, int]] = []
+    enc = dictionary.encode
+    for window_iri, wd in sds.windows.items():
+        for wt in wd.triples:
+            expiry = wt.event_time + wd.alpha
+            if expiry <= current_time:
+                continue
+            out.append(
+                (
+                    Triple(
+                        enc(wt.subject),
+                        enc(annotate_predicate(window_iri, wt.predicate)),
+                        enc(wt.object),
+                    ),
+                    expiry,
+                )
+            )
+    for graph_iri, triples in sds.static_graphs.items():
+        for s, p, o in triples:
+            out.append(
+                (
+                    Triple(enc(s), enc(annotate_predicate(graph_iri, p)), enc(o)),
+                    U64_MAX,
+                )
+            )
+    return out
+
+
+def translate_datalog_back(
+    facts: List[Triple], dictionary: Dictionary, sds: Sds
+) -> Dict[str, List[Triple]]:
+    """Strip window-IRI prefixes; route triples to component buckets."""
+    component_iris = all_component_iris(sds)
+    out: Dict[str, List[Triple]] = {}
+    for t in facts:
+        pred = dictionary.decode(t.predicate)
+        if pred is None:
+            continue
+        hit = strip_window_prefix(pred, component_iris)
+        if hit is None:
+            continue
+        comp, local = hit
+        out.setdefault(comp, []).append(
+            Triple(t.subject, dictionary.encode(local), t.object)
+        )
+    return out
+
+
+# Internal incremental state: component IRI -> {annotated triple -> expiry}
+SdsWithExpiry = Dict[str, Dict[Tuple[int, int, int], int]]
+
+
+def sds_with_expiry_to_external(
+    internal: SdsWithExpiry, dictionary: Dictionary, component_iris: List[str]
+) -> Dict[str, List[Triple]]:
+    out: Dict[str, List[Triple]] = {}
+    for comp, fact_map in internal.items():
+        for key in fact_map:
+            t = Triple(*key)
+            pred = dictionary.decode(t.predicate)
+            if pred is None:
+                continue
+            hit = strip_window_prefix(pred, component_iris)
+            if hit is None:
+                continue
+            _, local = hit
+            out.setdefault(comp, []).append(
+                Triple(t.subject, dictionary.encode(local), t.object)
+            )
+    return out
+
+
+def naive_sds_plus(
+    rules: List[Rule], sds: Sds, dictionary: Dictionary, current_time: int
+) -> Dict[str, List[Triple]]:
+    """Full SDS+ recomputation (cross_window_naive.rs:20-43)."""
+    annotated = translate_sds_to_datalog(sds, dictionary, current_time)
+    reasoner = Reasoner(dictionary)
+    for t, _ in annotated:
+        reasoner.insert_ground_triple(t)
+    for rule in rules:
+        reasoner.add_rule(rule)
+    reasoner.infer_new_facts_semi_naive()
+    all_facts = [Triple(*k) for k in reasoner.facts.triples_set()]
+    return translate_datalog_back(all_facts, dictionary, sds)
+
+
+def incremental_sds_plus(
+    rules: List[Rule],
+    sds_current: Sds,
+    sds_plus_old: SdsWithExpiry,
+    dictionary: Dictionary,
+    current_time: int,
+) -> SdsWithExpiry:
+    """Incremental SDS+ maintenance (cross_window_incremental.rs:26-110).
+
+    D_old = unexpired prior facts (max-merged over components);
+    D_new = current facts whose expiry improved on the prior state;
+    run expiration-provenance semi-naive with initial delta = D_new ONLY.
+    """
+    d_base = translate_sds_to_datalog(sds_current, dictionary, current_time)
+
+    d_old_map: Dict[Tuple[int, int, int], int] = {}
+    for fact_map in sds_plus_old.values():
+        for key, expiry in fact_map.items():
+            if expiry > current_time:
+                prev = d_old_map.get(key)
+                if prev is None or prev < expiry:
+                    d_old_map[key] = expiry
+
+    d_new: List[Tuple[Triple, int]] = [
+        (t, e)
+        for t, e in d_base
+        if d_old_map.get(tuple(t), -1) < e
+    ]
+
+    reasoner = Reasoner(dictionary)
+    for key in d_old_map:
+        reasoner.insert_ground_triple(Triple(*key))
+    for t, _ in d_new:
+        reasoner.insert_ground_triple(t)
+    for rule in rules:
+        reasoner.add_rule(rule)
+
+    prov = ExpirationProvenance()
+    initial_tags = TagStore(prov)
+    for key, e in d_old_map.items():
+        if e < U64_MAX:
+            initial_tags.set(Triple(*key), e)
+    for t, e in d_new:
+        if e < U64_MAX:
+            # a re-arrival may improve expiry over D_old
+            old = initial_tags.get_opt(t)
+            initial_tags.set(t, e if old is None else max(old, e))
+
+    delta = {tuple(t) for t, _ in d_new}
+    tag_store = semi_naive_with_initial_tags_and_delta(
+        reasoner, prov, initial_tags, delta
+    )
+
+    component_iris = all_component_iris(sds_current)
+    result: SdsWithExpiry = {}
+    for key in reasoner.facts.triples_set():
+        pred = dictionary.decode(key[1])
+        if pred is None:
+            continue
+        hit = strip_window_prefix(pred, component_iris)
+        if hit is None:
+            continue
+        comp, _ = hit
+        expiry = tag_store.get_opt(Triple(*key))
+        if expiry is None:
+            expiry = U64_MAX
+        result.setdefault(comp, {})[key] = expiry
+    return result

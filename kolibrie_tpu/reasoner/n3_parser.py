@@ -32,6 +32,39 @@ class N3ParseError(ValueError):
     pass
 
 
+def _strip_comments(text: str) -> str:
+    """Remove ``# ...`` comments, but never a '#' inside ``<...>`` (fragment
+    IRIs like rdf-syntax-ns#) or inside string literals."""
+    out: List[str] = []
+    in_iri = in_str = False
+    skip = False
+    for i, c in enumerate(text):
+        if skip:
+            if c == "\n":
+                skip = False
+                out.append(c)
+            continue
+        if in_str:
+            out.append(c)
+            if c == '"' and (i == 0 or text[i - 1] != "\\"):
+                in_str = False
+            continue
+        if in_iri:
+            out.append(c)
+            if c == ">":
+                in_iri = False
+            continue
+        if c == '"':
+            in_str = True
+        elif c == "<":
+            in_iri = True
+        elif c == "#":
+            skip = True
+            continue
+        out.append(c)
+    return "".join(out)
+
+
 RDF_TYPE = "http://www.w3.org/1999/02/22-rdf-syntax-ns#type"
 
 
@@ -155,9 +188,7 @@ def parse_n3_document(text: str, dictionary) -> List[Rule]:
     """Parse a multi-rule N3 document.  Validates that nothing but prefixes,
     comments, and rules appear (EOF validation, parser_n3_logic.rs:227)."""
     prefixes: Dict[str, str] = {}
-    rest = text
-    # strip comments
-    rest = re.sub(r"#[^\n]*", "", rest)
+    rest = _strip_comments(text)
     for m in _PREFIX_RE.finditer(rest):
         prefixes[m.group(1)] = m.group(2)
     rest_wo = _PREFIX_RE.sub("", rest)
@@ -194,7 +225,7 @@ def parse_n3_rules_for_sds(
     each predicate constant to its owning window (longest-prefix match) and
     collects non-window IRIs as output components."""
     prefixes: Dict[str, str] = {}
-    clean = re.sub(r"#[^\n]*", "", text)
+    clean = _strip_comments(text)
     for m in _PREFIX_RE.finditer(clean):
         prefixes[m.group(1)] = m.group(2)
     rest = _PREFIX_RE.sub("", clean)

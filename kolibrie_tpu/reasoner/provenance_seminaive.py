@@ -134,7 +134,7 @@ def _positive_fixpoint(
         old_keys = facts.triples_set() - delta_keys
         old_store = reasoner._store_from(old_keys)
         next_delta: Set[TripleKey] = set()
-        new_facts: Set[TripleKey] = set()
+        round_new: Set[TripleKey] = set()  # buffered until the round ends
         for rule in pos_rules:
             table = eval_rule_body(
                 reasoner, rule, facts, delta=delta_cols, old_store=old_store
@@ -160,15 +160,19 @@ def _positive_fixpoint(
                     ckey = _subst(concl, row, reasoner.quoted)
                     if ckey is None:
                         continue
-                    existed = facts.contains(*ckey)
+                    existed = facts.contains(*ckey) or ckey in round_new
                     changed = tag_store.update_disjunction(Triple(*ckey), tag)
                     if not existed:
-                        facts.add(*ckey)
-                        new_facts.add(ckey)
+                        round_new.add(ckey)
                         next_delta.add(ckey)
                     elif changed:
                         # tag improved: re-include in delta (:26-34)
                         next_delta.add(ckey)
+        # commit this round's facts only now, so the full-store scans within
+        # the round never see mid-round additions (each derivation must be
+        # found exactly once — non-idempotent ⊕ safety)
+        for ckey in round_new:
+            facts.add(*ckey)
         delta_keys = next_delta
     return set()
 

@@ -26,15 +26,14 @@ def infer_new_facts_with_sdd_seed_specs(
     mgr = prov.manager
     for spec in seed_specs:
         if isinstance(spec, IndependentSeed):
+            # seeds without an explicit id stay unregistered in seed_vars —
+            # gradients are keyed by explicit seed ids only, and registering
+            # by allocation order would collide with numbered seeds
             tag = (
                 prov.tag_from_probability_with_id(spec.prob, spec.seed_id)
                 if spec.seed_id is not None
                 else prov.tag_from_probability(spec.prob)
             )
-            if spec.seed_id is None:
-                # register for gradient lookup by allocation order
-                var = mgr.nodes[tag][0]
-                prov.seed_vars[mgr.vars[var].index] = var
             store.set(spec.triple, tag)
             reasoner.facts.add_triple(spec.triple)
         elif isinstance(spec, ExclusiveGroupSeed):

@@ -1,0 +1,23 @@
+"""RSP (RDF Stream Processing): C-SPARQL windows (S2R), per-window
+query+reason (R2R), stream operators (R2S), the multi-window engine with sync
+policies, and the RSP-QL builder.
+
+Parity: ``kolibrie/src/rsp/`` + ``rsp_engine.rs``.
+"""
+
+from kolibrie_tpu.rsp.s2r import CSPARQLWindow, ContentContainer, ReportStrategy, Tick, WindowTriple
+from kolibrie_tpu.rsp.r2s import Relation2StreamOperator, StreamOperator
+from kolibrie_tpu.rsp.builder import RSPBuilder
+from kolibrie_tpu.rsp.engine import RSPEngine
+
+__all__ = [
+    "CSPARQLWindow",
+    "ContentContainer",
+    "ReportStrategy",
+    "Tick",
+    "WindowTriple",
+    "Relation2StreamOperator",
+    "StreamOperator",
+    "RSPBuilder",
+    "RSPEngine",
+]

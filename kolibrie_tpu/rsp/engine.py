@@ -1,0 +1,501 @@
+"""RSPEngine — the streaming orchestrator.
+
+Parity: ``kolibrie/src/rsp_engine.rs`` — per-window processors (evict the
+previous firing, add content, materialize, execute the window plan;
+``create_window_processor!`` :102-188), SingleThread (callback) vs
+MultiThread (queue + thread) registration (:191-212), the multi-window
+coordinator joining the latest window results + static data under the
+``SyncPolicy`` (Steal / Wait / Timeout{Steal,Drop}; :488-660), shared
+dictionary between query plans and the R2R store (:272-293), a separate
+static background database (:296-300), opt-in cross-window SDS+ mode where
+raw (Triple, ts) window contents are routed to the coordinator which runs
+``incremental_sds_plus`` / ``naive_sds_plus`` per cycle (:114-135, :1059+),
+and R2S applied at emission (:449-460).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kolibrie_tpu.core.rule import Rule
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.query.ast import (
+    SelectItem,
+    SelectQuery,
+    SyncPolicy,
+    SyncPolicyKind,
+    TimeoutFallback,
+    WhereClause,
+)
+from kolibrie_tpu.query.executor import eval_select_to_table, format_results, table_header
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+from kolibrie_tpu.reasoner.cross_window import (
+    Sds,
+    SdsWithExpiry,
+    WindowData,
+    WindowedTriple,
+    all_component_iris,
+    incremental_sds_plus,
+    naive_sds_plus,
+    sds_with_expiry_to_external,
+)
+from kolibrie_tpu.reasoner.n3_parser import WindowContext
+from kolibrie_tpu.rsp.r2r import SimpleR2R
+from kolibrie_tpu.rsp.r2s import Relation2StreamOperator, StreamOperator
+from kolibrie_tpu.rsp.s2r import ContentContainer, WindowTriple
+from kolibrie_tpu.rsp.window_runner import WindowRunner, WindowSpec
+
+ResultRow = Tuple[Tuple[str, str], ...]  # sorted (var, value) pairs
+
+
+class OperationMode:
+    SINGLE_THREAD = "single"
+    MULTI_THREAD = "multi"
+
+
+class CrossWindowReasoningMode:
+    INCREMENTAL = "incremental"
+    NAIVE = "naive"
+
+
+@dataclass
+class RSPWindowConfig:
+    window_iri: str
+    stream_iri: str
+    width: int
+    slide: int
+    report: str
+    tick: str
+    query: SelectQuery  # per-window plan
+
+
+@dataclass
+class WindowResult:
+    window_iri: str
+    results: List[Dict[str, str]]
+    timestamp: int
+    raw_triples: List[Tuple[Triple, int]] = field(default_factory=list)
+
+
+def natural_join_maps(
+    left: List[Dict[str, str]], right: List[Dict[str, str]]
+) -> List[Dict[str, str]]:
+    """Natural join of binding-map sets (rsp_engine.rs:900-934)."""
+    if not left or not right:
+        return []
+    out = []
+    for lb in left:
+        for rb in right:
+            if all(rb.get(k, v) == v for k, v in lb.items()):
+                merged = dict(lb)
+                merged.update(rb)
+                out.append(merged)
+    return out
+
+
+def join_window_results(
+    buffers: Dict[str, List[Dict[str, str]]]
+) -> List[Dict[str, str]]:
+    if not buffers:
+        return []
+    parts = list(buffers.values())
+    joined = parts[0]
+    for p in parts[1:]:
+        joined = natural_join_maps(joined, p)
+    return joined
+
+
+class RSPEngine:
+    def __init__(
+        self,
+        window_configs: List[RSPWindowConfig],
+        stream_type: str = StreamOperator.RSTREAM,
+        consumer: Optional[Callable[[ResultRow], None]] = None,
+        operation_mode: str = OperationMode.SINGLE_THREAD,
+        sync_policy: Optional[SyncPolicy] = None,
+        static_query: Optional[SelectQuery] = None,
+        static_data: str = "",
+        initial_triples: str = "",
+        syntax: str = "turtle",
+        rules: str = "",
+        cross_window_rules: Optional[List[Rule]] = None,
+        cross_window_context: Optional[WindowContext] = None,
+        cross_window_mode: str = CrossWindowReasoningMode.INCREMENTAL,
+        cross_window_rules_text: Optional[str] = None,
+    ):
+        self.window_configs = window_configs
+        self.operation_mode = operation_mode
+        self.sync_policy = sync_policy or SyncPolicy(SyncPolicyKind.STEAL)
+        self.consumer = consumer or (lambda row: None)
+
+        # R2R store; one dictionary shared across store, static db, plans
+        self.r2r = SimpleR2R(SparqlDatabase())
+        self.dictionary = self.r2r.db.dictionary
+        self.static_db = SparqlDatabase()
+        self.static_db.dictionary = self.dictionary
+        self.static_db.quoted = self.r2r.db.quoted
+        if static_data:
+            self.static_db.parse_turtle(static_data)
+        if initial_triples:
+            self.r2r.load_triples(initial_triples, syntax)
+        if rules:
+            self.r2r.load_rules(rules)
+
+        self.static_query = static_query
+        self.r2s = Relation2StreamOperator(stream_type, 0)
+        self._store_lock = threading.Lock()
+        self._result_queue: "queue.Queue[WindowResult]" = queue.Queue()
+
+        # cross-window state (rules may arrive pre-parsed or as N3 text,
+        # which is parsed against THIS engine's dictionary so IDs align)
+        if cross_window_rules_text:
+            from kolibrie_tpu.reasoner.n3_parser import parse_n3_rules_for_sds
+
+            window_iris = [c.window_iri for c in window_configs]
+            cross_window_rules, cross_window_context = parse_n3_rules_for_sds(
+                cross_window_rules_text, self.dictionary, window_iris
+            )
+        self.cross_window_enabled = cross_window_rules is not None
+        self.cross_window_rules = cross_window_rules or []
+        self.cross_window_context = cross_window_context
+        self.cross_window_mode = cross_window_mode
+        self._sds_plus_state: SdsWithExpiry = {}
+        self._latest_contents: Dict[str, List[Tuple[Triple, int]]] = {}
+        self._cw_lock = threading.Lock()
+
+        # single-thread coordination state
+        self._st_last_materialized: Dict[str, List[Dict[str, str]]] = {}
+
+        self._has_joins = (
+            len(window_configs) > 1
+            or self.static_query is not None
+            or self.cross_window_enabled
+        )
+
+        self.windows: List[WindowRunner] = []
+        for cfg in window_configs:
+            runner = WindowRunner(
+                WindowSpec(
+                    cfg.window_iri,
+                    cfg.stream_iri,
+                    cfg.width,
+                    cfg.slide,
+                    cfg.report,
+                    cfg.tick,
+                )
+            )
+            self.windows.append(runner)
+        self._register_windows()
+        if (
+            self.operation_mode == OperationMode.MULTI_THREAD
+            and self._has_joins
+        ):
+            self._start_coordinator()
+
+    # ---------------------------------------------------------- registration
+
+    def _make_processor(self, cfg: RSPWindowConfig):
+        """Window processor closure (create_window_processor! parity)."""
+        prev_window_triples: List = []
+
+        def processor(content: ContentContainer):
+            ts = content.get_last_timestamp_changed()
+            if self.cross_window_enabled:
+                raw: List[Tuple[Triple, int]] = []
+                for item, event_ts in content.iter_with_timestamps():
+                    raw.append((self._item_to_triple(item), event_ts))
+                self._result_queue.put(
+                    WindowResult(cfg.window_iri, [], ts, raw)
+                )
+                return
+            with self._store_lock:
+                for t in prev_window_triples:
+                    self.r2r.remove(t)
+                prev_window_triples.clear()
+                for item in content:
+                    prev_window_triples.append(item)
+                    self.r2r.add(item)
+                self.r2r.materialize()
+                results = self.r2r.execute_query(cfg.query)
+            if self._has_joins:
+                mapped = [dict(row) for row in results]
+                self._result_queue.put(WindowResult(cfg.window_iri, mapped, ts))
+            else:
+                filtered = self.r2s.eval(results, ts)
+                for row in filtered:
+                    self.consumer(row)
+
+        return processor
+
+    def _item_to_triple(self, item) -> Triple:
+        if isinstance(item, Triple):
+            return item
+        if isinstance(item, WindowTriple):
+            return Triple(
+                self.r2r.db.encode_term_str(item.s),
+                self.r2r.db.encode_term_str(item.p),
+                self.r2r.db.encode_term_str(item.o),
+            )
+        raise TypeError(f"unsupported stream item {item!r}")
+
+    def _register_windows(self) -> None:
+        self._window_receivers: List[queue.Queue] = []
+        for cfg, runner in zip(self.window_configs, self.windows):
+            processor = self._make_processor(cfg)
+            if self.operation_mode == OperationMode.SINGLE_THREAD:
+                runner.register_callback(processor)
+            else:
+                receiver = runner.register()
+                self._window_receivers.append(receiver)
+
+                def run(recv=receiver, proc=processor, iri=cfg.window_iri):
+                    while True:
+                        content = recv.get()
+                        if content is None:  # shutdown sentinel
+                            break
+                        proc(content)
+
+                threading.Thread(target=run, daemon=True).start()
+
+    # ------------------------------------------------------------ streaming
+
+    @staticmethod
+    def _normalize_stream_iri(s: str) -> str:
+        s = s.strip().lstrip("<").rstrip(">")
+        return s[1:] if s.startswith(":") else s
+
+    def add_to_stream(self, stream_iri: str, item, ts: int) -> None:
+        """Route an event to the windows listening on this stream
+        (rsp_engine.rs:693-731)."""
+        if self.operation_mode == OperationMode.SINGLE_THREAD and self._has_joins:
+            self.process_single_thread_window_results()
+        input_norm = self._normalize_stream_iri(stream_iri)
+        for cfg, runner in zip(self.window_configs, self.windows):
+            if cfg.stream_iri.startswith("?"):
+                runner.add_to_window(item, ts)
+                continue
+            if self._normalize_stream_iri(cfg.stream_iri) == input_norm:
+                runner.add_to_window(item, ts)
+
+    def add(self, item, ts: int) -> None:
+        """Convenience: feed every window (single-stream engines)."""
+        if self.operation_mode == OperationMode.SINGLE_THREAD and self._has_joins:
+            self.process_single_thread_window_results()
+        for runner in self.windows:
+            runner.add_to_window(item, ts)
+
+    def flush_windows(self) -> None:
+        for runner in self.windows:
+            runner.flush()
+        if self.operation_mode == OperationMode.SINGLE_THREAD and self._has_joins:
+            self.process_single_thread_window_results()
+
+    # --------------------------------------------------- single-thread drain
+
+    def process_single_thread_window_results(self) -> None:
+        """Drain pending window results and emit when every window has
+        materialized (rsp_engine.rs:735-800; note the reference ACCUMULATES
+        single-thread results per window rather than replacing)."""
+        had_new = False
+        max_ts = 0
+        while True:
+            try:
+                wr = self._result_queue.get_nowait()
+            except queue.Empty:
+                break
+            had_new = True
+            max_ts = max(max_ts, wr.timestamp)
+            if self.cross_window_enabled:
+                with self._cw_lock:
+                    self._latest_contents[wr.window_iri] = list(wr.raw_triples)
+            self._st_last_materialized.setdefault(wr.window_iri, []).extend(
+                wr.results
+            )
+        if not had_new:
+            return
+        if len(self._st_last_materialized) == len(self.windows):
+            if self.cross_window_enabled:
+                self._emit_cross_window(max_ts)
+            else:
+                self._emit(self._st_last_materialized, max_ts)
+            self._st_last_materialized = {}
+
+    # ------------------------------------------------------------ coordinator
+
+    def _start_coordinator(self) -> None:
+        def run():
+            last_materialized: Dict[str, List[Dict[str, str]]] = {}
+            cycle_triggered: set = set()
+            cycle_start: Optional[float] = None
+            max_ts = 0
+            num_windows = len(self.windows)
+            policy = self.sync_policy
+            while True:
+                timeout: Optional[float] = None
+                if policy.kind == SyncPolicyKind.TIMEOUT and cycle_start is not None:
+                    timeout = max(
+                        policy.timeout_ms / 1000.0 - (time.monotonic() - cycle_start),
+                        0.0,
+                    )
+                try:
+                    wr = self._result_queue.get(timeout=timeout)
+                except queue.Empty:
+                    # deadline elapsed
+                    if cycle_triggered:
+                        if policy.fallback == TimeoutFallback.STEAL:
+                            if len(last_materialized) == num_windows:
+                                if self.cross_window_enabled:
+                                    self._emit_cross_window(max_ts)
+                                else:
+                                    self._emit(last_materialized, max_ts)
+                        # Drop: discard the cycle
+                        cycle_triggered.clear()
+                        cycle_start = None
+                        max_ts = 0
+                    continue
+                if wr is None:
+                    break
+                max_ts = max(max_ts, wr.timestamp)
+                if self.cross_window_enabled:
+                    with self._cw_lock:
+                        self._latest_contents[wr.window_iri] = list(wr.raw_triples)
+                last_materialized[wr.window_iri] = list(wr.results)
+                if not cycle_triggered:
+                    cycle_start = time.monotonic()
+                cycle_triggered.add(wr.window_iri)
+                # drain pending
+                while True:
+                    try:
+                        extra = self._result_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if extra is None:
+                        return
+                    max_ts = max(max_ts, extra.timestamp)
+                    if self.cross_window_enabled:
+                        with self._cw_lock:
+                            self._latest_contents[extra.window_iri] = list(
+                                extra.raw_triples
+                            )
+                    last_materialized[extra.window_iri] = list(extra.results)
+                    cycle_triggered.add(extra.window_iri)
+                if len(cycle_triggered) == num_windows:
+                    if self.cross_window_enabled:
+                        self._emit_cross_window(max_ts)
+                    else:
+                        self._emit(last_materialized, max_ts)
+                    cycle_triggered.clear()
+                    cycle_start = None
+                    max_ts = 0
+                elif policy.kind == SyncPolicyKind.STEAL:
+                    # emit immediately with stale data from non-firing windows
+                    if len(last_materialized) == num_windows:
+                        if self.cross_window_enabled:
+                            self._emit_cross_window(max_ts)
+                        else:
+                            self._emit(last_materialized, max_ts)
+                    cycle_triggered.clear()
+                    cycle_start = None
+                    max_ts = 0
+                # Wait / Timeout: keep waiting for remaining windows
+
+        self._coordinator = threading.Thread(target=run, daemon=True)
+        self._coordinator.start()
+
+    # -------------------------------------------------------------- emission
+
+    def _static_bindings(self) -> List[Dict[str, str]]:
+        if self.static_query is None:
+            return []
+        table = eval_select_to_table(self.static_db, self.static_query)
+        header = table_header(table, self.static_query)
+        rows = format_results(self.static_db, table, self.static_query)
+        return [dict(zip(header, row)) for row in rows]
+
+    def _emit(
+        self, last_materialized: Dict[str, List[Dict[str, str]]], ts: int
+    ) -> None:
+        """Join windows (+static), apply R2S, feed the consumer
+        (emit_results, rsp_engine.rs:864-897)."""
+        joined = join_window_results(last_materialized)
+        if self.static_query is not None:
+            static = self._static_bindings()
+            joined = natural_join_maps(joined, static)
+        outputs: List[ResultRow] = [
+            tuple(sorted(b.items())) for b in joined
+        ]
+        for row in self.r2s.eval(outputs, ts):
+            self.consumer(row)
+
+    # ---------------------------------------------------------- cross-window
+
+    def _build_sds(self) -> Sds:
+        sds = Sds()
+        dec = self.dictionary.decode
+        with self._cw_lock:
+            latest = {k: list(v) for k, v in self._latest_contents.items()}
+        for cfg in self.window_configs:
+            triples: List[WindowedTriple] = []
+            for t, event_time in latest.get(cfg.window_iri, []):
+                s = dec(t.subject)
+                p = dec(t.predicate)
+                o = dec(t.object)
+                if s is None or p is None or o is None:
+                    continue
+                triples.append(WindowedTriple(s, p, o, event_time))
+            sds.windows[cfg.window_iri] = WindowData(cfg.width, triples)
+        if self.cross_window_context is not None:
+            for iri in self.cross_window_context.output_iris:
+                sds.output_iris.add(iri)
+        static_triples = [
+            (s, p, o)
+            for s, p, o in self.static_db.iter_decoded()
+            if s is not None and p is not None and o is not None
+        ]
+        if static_triples:
+            sds.static_graphs["urn:kolibrie:static:"] = static_triples
+        return sds
+
+    def _emit_cross_window(self, ts: int) -> None:
+        """SDS+ cycle + per-window plans over derived buckets
+        (emit_cross_window_results, rsp_engine.rs:1059-1112)."""
+        sds = self._build_sds()
+        if self.cross_window_mode == CrossWindowReasoningMode.INCREMENTAL:
+            new_state = incremental_sds_plus(
+                self.cross_window_rules, sds, self._sds_plus_state, self.dictionary, ts
+            )
+            self._sds_plus_state = new_state
+            buckets = sds_with_expiry_to_external(
+                new_state, self.dictionary, all_component_iris(sds)
+            )
+        else:
+            buckets = naive_sds_plus(
+                self.cross_window_rules, sds, self.dictionary, ts
+            )
+        materialized: Dict[str, List[Dict[str, str]]] = {}
+        for cfg in self.window_configs:
+            db = SparqlDatabase()
+            db.dictionary = self.dictionary
+            db.quoted = self.r2r.db.quoted
+            for t in buckets.get(cfg.window_iri, []):
+                db.add_triple(t)
+            table = eval_select_to_table(db, cfg.query)
+            header = table_header(table, cfg.query)
+            rows = format_results(db, table, cfg.query)
+            materialized[cfg.window_iri] = [dict(zip(header, row)) for row in rows]
+        self._emit(materialized, ts)
+
+    # ----------------------------------------------------------------- misc
+
+    def stop(self) -> None:
+        for runner in self.windows:
+            runner.stop()
+        # unblock per-window worker threads (multi-thread mode) and the
+        # coordinator with shutdown sentinels
+        for recv in getattr(self, "_window_receivers", []):
+            recv.put(None)
+        self._result_queue.put(None)  # type: ignore[arg-type]

@@ -1,0 +1,115 @@
+"""R2R: relation-to-relation — per-window query + reasoning.
+
+Parity: ``kolibrie/src/rsp/r2r.rs`` (the ``R2ROperator`` trait:
+load_triples / load_rules / add / remove / materialize / execute_query) and
+``simple_r2r.rs`` (``SimpleR2R`` over a SparqlDatabase: materialize = clone
+Reasoner + semi-naive closure + track derived triples for next-cycle
+eviction; execute via the Volcano engine).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.query.ast import SelectItem, SelectQuery, WhereClause
+from kolibrie_tpu.query.executor import eval_select_to_table, format_results, table_header
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+from kolibrie_tpu.reasoner.n3_parser import parse_n3_document
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+from kolibrie_tpu.reasoner.rule_runtime import build_reasoner_from_db
+from kolibrie_tpu.rsp.s2r import WindowTriple
+
+
+class R2ROperator:
+    """Interface (r2r.rs:21-30)."""
+
+    def load_triples(self, data: str, syntax: str) -> int:
+        raise NotImplementedError
+
+    def load_rules(self, rules: str) -> int:
+        raise NotImplementedError
+
+    def add(self, item) -> None:
+        raise NotImplementedError
+
+    def remove(self, item) -> None:
+        raise NotImplementedError
+
+    def materialize(self) -> List[Triple]:
+        raise NotImplementedError
+
+    def execute_query(self, plan) -> List:
+        raise NotImplementedError
+
+
+class SimpleR2R(R2ROperator):
+    """SparqlDatabase-backed R2R (simple_r2r.rs:25-143)."""
+
+    def __init__(self, db: Optional[SparqlDatabase] = None):
+        self.db = db or SparqlDatabase()
+        self.rules: List = []
+        self._derived_prev: List[Triple] = []
+
+    def load_triples(self, data: str, syntax: str = "turtle") -> int:
+        syntax = syntax.lower()
+        if syntax in ("turtle", "ttl"):
+            return self.db.parse_turtle(data)
+        if syntax in ("ntriples", "nt"):
+            return self.db.parse_ntriples(data)
+        if syntax in ("rdfxml", "rdf/xml", "xml", "rdf"):
+            return self.db.parse_rdf(data)
+        if syntax == "n3":
+            return self.db.parse_n3(data)
+        raise ValueError(f"unknown syntax {syntax!r}")
+
+    def load_rules(self, rules: str) -> int:
+        if not rules.strip():
+            return 0
+        parsed = parse_n3_document(rules, self.db.dictionary)
+        self.rules.extend(parsed)
+        return len(parsed)
+
+    def _to_triple(self, item) -> Triple:
+        if isinstance(item, Triple):
+            return item
+        if isinstance(item, WindowTriple):
+            return Triple(
+                self.db.encode_term_str(item.s),
+                self.db.encode_term_str(item.p),
+                self.db.encode_term_str(item.o),
+            )
+        raise TypeError(f"unsupported window item {item!r}")
+
+    def add(self, item) -> None:
+        self.db.add_triple(self._to_triple(item))
+
+    def remove(self, item) -> None:
+        self.db.delete_triple(self._to_triple(item))
+
+    def materialize(self) -> List[Triple]:
+        """Evict the previous firing's derived facts, run the semi-naive
+        closure, track the new derived facts (simple_r2r.rs:103-128)."""
+        for t in self._derived_prev:
+            self.db.delete_triple(t)
+        self._derived_prev = []
+        if not self.rules:
+            return []
+        kg = build_reasoner_from_db(self.db)
+        for rule in self.rules:
+            kg.add_rule(rule)
+        before = kg.facts.triples_set()
+        kg.infer_new_facts_semi_naive()
+        derived = [Triple(*k) for k in kg.facts.triples_set() - before]
+        for t in derived:
+            self.db.add_triple(t)
+        self._derived_prev = derived
+        return derived
+
+    def execute_query(self, plan: SelectQuery) -> List[tuple]:
+        """Run the per-window SELECT; returns rows of sorted (var, value)
+        tuples (simple_r2r.rs:130-143)."""
+        table = eval_select_to_table(self.db, plan)
+        header = table_header(table, plan)
+        rows = format_results(self.db, table, plan)
+        return [tuple(sorted(zip(header, row))) for row in rows]

@@ -1,0 +1,43 @@
+"""Thin wrapper around CSPARQLWindow (parity: ``rsp/window_runner.rs``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kolibrie_tpu.rsp.s2r import CSPARQLWindow, Report, ReportStrategy, Tick
+
+
+@dataclass
+class WindowSpec:
+    window_iri: str
+    stream_iri: str
+    width: int
+    slide: int
+    report: str = ReportStrategy.ON_WINDOW_CLOSE
+    tick: str = Tick.TIME_DRIVEN
+
+
+class WindowRunner:
+    def __init__(self, spec: WindowSpec):
+        self.spec = spec
+        report = Report()
+        report.add(ReportStrategy.from_name(spec.report))
+        self.window = CSPARQLWindow(
+            spec.width, spec.slide, report, spec.tick, spec.window_iri
+        )
+
+    def add_to_window(self, item, ts: int) -> None:
+        self.window.add_to_window(item, ts)
+
+    def register_callback(self, fn) -> None:
+        self.window.register_callback(fn)
+
+    def register(self):
+        return self.window.register()
+
+    def flush(self) -> None:
+        self.window.flush()
+
+    def stop(self) -> None:
+        self.window.stop()

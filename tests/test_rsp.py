@@ -1,0 +1,371 @@
+"""RSP streaming tests: window firing traces, R2S semantics, multi-window
+sync policies, static-data joins, cross-window SDS+ naive-vs-incremental
+agreement.
+
+Parity: kolibrie/tests/rsp_engine_test.rs (exact firing traces :10-60, sync
+policies :641-730, static isolation :1021, eviction :1179) and
+datalog/tests/cross_window_tests.rs (naive/incremental agreement :201).
+"""
+
+import pytest
+
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.query.ast import SyncPolicy, SyncPolicyKind
+from kolibrie_tpu.reasoner.cross_window import (
+    Sds,
+    WindowData,
+    WindowedTriple,
+    all_component_iris,
+    incremental_sds_plus,
+    naive_sds_plus,
+    sds_with_expiry_to_external,
+    translate_sds_to_datalog,
+)
+from kolibrie_tpu.core.dictionary import Dictionary
+from kolibrie_tpu.reasoner.n3_parser import parse_n3_rules_for_sds
+from kolibrie_tpu.rsp.builder import RSPBuilder
+from kolibrie_tpu.rsp.engine import CrossWindowReasoningMode, OperationMode
+from kolibrie_tpu.rsp.r2s import Relation2StreamOperator, StreamOperator
+from kolibrie_tpu.rsp.s2r import (
+    CSPARQLWindow,
+    ContentContainer,
+    Report,
+    ReportStrategy,
+    Tick,
+    WindowTriple,
+)
+
+
+class TestS2R:
+    def _window(self, width, slide, strategy=ReportStrategy.ON_WINDOW_CLOSE):
+        report = Report()
+        report.add(ReportStrategy.from_name(strategy))
+        return CSPARQLWindow(width, slide, report, Tick.TIME_DRIVEN, "w")
+
+    def test_firing_trace_range3_step1(self):
+        """Exact tick-by-tick trace: RANGE 3 STEP 1, OnWindowClose.
+
+        The window that closes at ts fires with its PRE-event content."""
+        w = self._window(3, 1)
+        fired = []
+        w.register_callback(lambda c: fired.append(sorted(c)))
+        for i, ts in enumerate([1, 2, 3, 4], start=1):
+            w.add_to_window(f"e{i}", ts)
+        # t=1: [0,1) fires empty; t=2: [0,2)={e1}; t=3: [0,3)={e1,e2};
+        # t=4: [1,4)={e1,e2,e3} (e1 ts=1 lies in [1,4))
+        assert fired == [[], ["e1"], ["e1", "e2"], ["e1", "e2", "e3"]]
+
+    def test_non_empty_content_strategy(self):
+        w = self._window(3, 1, ReportStrategy.NON_EMPTY_CONTENT)
+        fired = []
+        w.register_callback(lambda c: fired.append(sorted(c)))
+        for i, ts in enumerate([1, 2, 3], start=1):
+            w.add_to_window(f"e{i}", ts)
+        # fires on every event once some window has content (max-close window)
+        assert fired[0] == ["e1"]
+
+    def test_tumbling_no_overlap(self):
+        w = self._window(2, 2)
+        fired = []
+        w.register_callback(lambda c: fired.append(sorted(c)))
+        for i, ts in enumerate([1, 2, 3, 4, 5], start=1):
+            w.add_to_window(f"e{i}", ts)
+        # [0,2) fires at t=2 with {e1}; [2,4) fires at t=4 with {e3};
+        # (e2 arrives at ts=2 which is outside [0,2) pre-add? e2 ts=2 goes to [2,4))
+        assert [sorted(c) for c in fired if c] == [["e1"], ["e2", "e3"]]
+
+    def test_content_container_dedup_max_ts(self):
+        c = ContentContainer()
+        c.add("x", 5)
+        c.add("x", 3)
+        assert len(c) == 1
+        assert dict(c.iter_with_timestamps())["x"] == 5
+
+    def test_time_driven_tick_monotone(self):
+        w = self._window(3, 1)
+        fired = []
+        w.register_callback(lambda c: fired.append(sorted(c)))
+        w.add_to_window("e1", 2)
+        n = len(fired)
+        w.add_to_window("e2", 2)  # same app time: no new firing
+        assert len(fired) == n
+
+    def test_flush(self):
+        w = self._window(10, 10)
+        fired = []
+        w.register_callback(lambda c: fired.append(sorted(c)))
+        w.add_to_window("e1", 1)
+        w.add_to_window("e2", 2)
+        w.flush()
+        assert fired[-1] == ["e1", "e2"]
+
+
+class TestR2S:
+    def test_rstream(self):
+        op = Relation2StreamOperator(StreamOperator.RSTREAM)
+        assert op.eval(["a", "b"], 1) == ["a", "b"]
+        assert op.eval(["a"], 2) == ["a"]
+
+    def test_istream(self):
+        op = Relation2StreamOperator(StreamOperator.ISTREAM)
+        assert op.eval(["a", "b"], 1) == ["a", "b"]
+        assert op.eval(["a", "c"], 2) == ["c"]
+        assert op.eval(["a", "c"], 3) == []
+
+    def test_dstream(self):
+        op = Relation2StreamOperator(StreamOperator.DSTREAM)
+        assert op.eval(["a", "b"], 1) == []
+        assert sorted(op.eval(["a"], 2)) == ["b"]
+
+
+QUERY_SINGLE = """
+PREFIX ex: <http://e/>
+REGISTER ISTREAM <http://out/stream> AS
+SELECT ?s ?o
+FROM NAMED WINDOW <http://e/w> ON ?stream [RANGE 3 STEP 1]
+WHERE { WINDOW <http://e/w> { ?s ex:val ?o } }
+"""
+
+
+class TestEngineSingleWindow:
+    def test_istream_range3_step1(self):
+        """ISTREAM over a RANGE3/STEP1 window: each element emitted once."""
+        results = []
+        engine = (
+            RSPBuilder(QUERY_SINGLE)
+            .with_consumer(lambda row: results.append(row))
+            .build()
+        )
+        for i, ts in enumerate([1, 2, 3, 4], start=1):
+            engine.add_to_stream(
+                ":stream", WindowTriple(f"<http://e/s{i}>", "<http://e/val>", f'"{i}"'), ts
+            )
+        vals = [dict(r).get("o") for r in results]
+        assert vals == ["1", "2", "3"]
+
+    def test_window_eviction(self):
+        """Old window contents must not leak into later firings
+        (rsp_engine_test.rs:1179 parity)."""
+        results = []
+        engine = (
+            RSPBuilder(
+                """PREFIX ex: <http://e/>
+                REGISTER RSTREAM <http://out/s> AS SELECT ?s ?o
+                FROM NAMED WINDOW <http://e/w> ON ?stream [RANGE 2 STEP 2]
+                WHERE { WINDOW <http://e/w> { ?s ex:val ?o } }"""
+            )
+            .with_consumer(lambda row: results.append(row))
+            .build()
+        )
+        for i, ts in enumerate([1, 3, 5], start=1):
+            engine.add_to_stream(
+                ":s", WindowTriple(f"<http://e/s{i}>", "<http://e/val>", f'"{i}"'), ts
+            )
+        # tumbling [0,2) fires at ts=3 with s1 only; [2,4) fires at ts=5 with s2
+        assert [dict(r)["o"] for r in results] == ["1", "2"]
+
+
+MULTI_QUERY = """
+PREFIX ex: <http://e/>
+REGISTER RSTREAM <http://out/s> AS
+SELECT ?room ?temp ?hum
+FROM NAMED WINDOW <http://e/wT> ON <http://e/tempStream> [RANGE 10 STEP 2]
+FROM NAMED WINDOW <http://e/wH> ON <http://e/humStream> [RANGE 10 STEP 2]
+WHERE {
+  WINDOW <http://e/wT> { ?room ex:temp ?temp }
+  WINDOW <http://e/wH> { ?room ex:hum ?hum }
+}
+"""
+
+
+class TestEngineMultiWindow:
+    def test_two_window_join_single_thread(self):
+        results = []
+        engine = (
+            RSPBuilder(MULTI_QUERY)
+            .with_consumer(lambda row: results.append(row))
+            .set_sync_policy(SyncPolicy(SyncPolicyKind.STEAL))
+            .build()
+        )
+        engine.add_to_stream(
+            "http://e/tempStream",
+            WindowTriple("<http://e/room1>", "<http://e/temp>", '"21"'),
+            1,
+        )
+        engine.add_to_stream(
+            "http://e/humStream",
+            WindowTriple("<http://e/room1>", "<http://e/hum>", '"60"'),
+            1,
+        )
+        # drive window closes + coordinator drain
+        for ts in (2, 3, 4):
+            engine.add_to_stream(
+                "http://e/tempStream",
+                WindowTriple("<http://e/room1>", "<http://e/temp>", '"21"'),
+                ts,
+            )
+            engine.add_to_stream(
+                "http://e/humStream",
+                WindowTriple("<http://e/room1>", "<http://e/hum>", '"60"'),
+                ts,
+            )
+        engine.process_single_thread_window_results()
+        assert results, "join across two windows should emit"
+        row = dict(results[0])
+        assert row["room"] == "http://e/room1"
+        assert row["temp"] == "21" and row["hum"] == "60"
+
+    def test_static_join(self):
+        """Static background data joins window results and is never evicted
+        (rsp_engine_test.rs:1021 parity)."""
+        results = []
+        engine = (
+            RSPBuilder(
+                """PREFIX ex: <http://e/>
+                REGISTER RSTREAM <http://out/s> AS
+                SELECT ?room ?temp ?label
+                FROM NAMED WINDOW <http://e/w> ON ?s [RANGE 5 STEP 1]
+                WHERE {
+                  ?room ex:label ?label
+                  WINDOW <http://e/w> { ?room ex:temp ?temp }
+                }"""
+            )
+            .add_static_data(
+                '@prefix ex: <http://e/> . ex:room1 ex:label "Kitchen" .'
+            )
+            .with_consumer(lambda row: results.append(row))
+            .build()
+        )
+        for ts in (1, 2, 3, 4, 5, 6):
+            engine.add_to_stream(
+                ":s", WindowTriple("<http://e/room1>", "<http://e/temp>", '"25"'), ts
+            )
+        engine.process_single_thread_window_results()
+        assert results
+        row = dict(results[0])
+        assert row["label"] == "Kitchen" and row["temp"] == "25"
+
+
+class TestCrossWindowSds:
+    RULES = """
+@prefix t: <http://e/wT/> .
+@prefix h: <http://e/wH/> .
+@prefix out: <http://e/out/> .
+{ ?room t:hot ?v . ?room h:humid ?w . } => { ?room out:alert ?v . } .
+"""
+
+    def _sds(self, t_events, h_events, alpha=10):
+        sds = Sds()
+        sds.windows["http://e/wT/"] = WindowData(
+            alpha, [WindowedTriple(s, p, o, ts) for (s, p, o, ts) in t_events]
+        )
+        sds.windows["http://e/wH/"] = WindowData(
+            alpha, [WindowedTriple(s, p, o, ts) for (s, p, o, ts) in h_events]
+        )
+        sds.output_iris.add("http://e/out/")
+        return sds
+
+    def test_translate_expiry_filtering(self):
+        d = Dictionary()
+        sds = self._sds([("r1", "hot", "1", 5)], [], alpha=10)
+        assert translate_sds_to_datalog(sds, d, 15) == []  # expiry 15 <= 15
+        alive = translate_sds_to_datalog(sds, d, 14)
+        assert len(alive) == 1 and alive[0][1] == 15
+
+    def test_naive_incremental_agree(self):
+        """The reference's most valuable pattern: naive recomputation and
+        incremental maintenance must agree (cross_window_tests.rs:201)."""
+        d_naive = Dictionary()
+        d_incr = Dictionary()
+        rules_n, _ = parse_n3_rules_for_sds(
+            self.RULES, d_naive, ["http://e/wT/", "http://e/wH/"]
+        )
+        rules_i, _ = parse_n3_rules_for_sds(
+            self.RULES, d_incr, ["http://e/wT/", "http://e/wH/"]
+        )
+        state = {}
+        for t in range(0, 30, 5):
+            t_events = [(f"r{i}", "hot", str(i), max(0, t - 3)) for i in range(3)]
+            h_events = [(f"r{i}", "humid", "x", max(0, t - 2)) for i in range(2)]
+            sds_n = self._sds(t_events, h_events)
+            sds_i = self._sds(t_events, h_events)
+            naive = naive_sds_plus(rules_n, sds_n, d_naive, t)
+            state = incremental_sds_plus(rules_i, sds_i, state, d_incr, t)
+            ext = sds_with_expiry_to_external(
+                state, d_incr, all_component_iris(sds_i)
+            )
+
+            def decode_bucket(bucket, d):
+                out = {}
+                for comp, triples in bucket.items():
+                    out[comp] = sorted(
+                        (
+                            d.decode(x.subject),
+                            d.decode(x.predicate),
+                            d.decode(x.object),
+                        )
+                        for x in triples
+                    )
+                return out
+
+            dn = decode_bucket(naive, d_naive)
+            di = decode_bucket(ext, d_incr)
+            # incremental keeps unexpired older derivations too; naive is a
+            # snapshot — naive must be a subset of incremental, and both must
+            # contain the same alert derivations for current data
+            for comp, rows in dn.items():
+                assert comp in di, (t, comp, di)
+                for row in rows:
+                    assert row in di[comp], (t, row, di[comp])
+
+    def test_alert_derivation(self):
+        d = Dictionary()
+        rules, ctx = parse_n3_rules_for_sds(
+            self.RULES, d, ["http://e/wT/", "http://e/wH/"]
+        )
+        assert "http://e/out/" in ctx.output_iris
+        sds = self._sds([("r1", "hot", "99", 5)], [("r1", "humid", "x", 6)])
+        buckets = naive_sds_plus(rules, sds, d, 7)
+        assert "http://e/out/" in buckets
+        alert = buckets["http://e/out/"][0]
+        assert d.decode(alert.predicate) == "alert"
+
+    def test_engine_cross_window(self):
+        results = []
+        engine = (
+            RSPBuilder(
+                """PREFIX ex: <http://e/>
+                REGISTER RSTREAM <http://out/s> AS
+                SELECT ?room ?v
+                FROM NAMED WINDOW <http://e/wT/> ON <http://e/tempStream> [RANGE 10 STEP 2]
+                FROM NAMED WINDOW <http://e/wH/> ON <http://e/humStream> [RANGE 10 STEP 2]
+                WHERE {
+                  WINDOW <http://e/wT/> { ?room <alerted> ?v }
+                  WINDOW <http://e/wH/> { ?room <humid> ?w }
+                }"""
+            )
+            .set_cross_window_rules(
+                """@prefix t: <http://e/wT/> .
+                @prefix h: <http://e/wH/> .
+                { ?room t:hot ?v . ?room h:humid ?w . } => { ?room t:alerted ?v . } ."""
+            )
+            .set_cross_window_reasoning_mode(CrossWindowReasoningMode.NAIVE)
+            .with_consumer(lambda row: results.append(row))
+            .build()
+        )
+        assert engine.cross_window_enabled
+        for ts in (1, 2, 3, 4, 5):
+            engine.add_to_stream(
+                "http://e/tempStream",
+                WindowTriple("r1", "hot", '"42"'),
+                ts,
+            )
+            engine.add_to_stream(
+                "http://e/humStream",
+                WindowTriple("r1", "humid", '"x"'),
+                ts,
+            )
+        engine.process_single_thread_window_results()
+        assert results, "cross-window rule should derive alerted fact"
+        row = dict(results[0])
+        assert row["v"] == "42"
