@@ -1,0 +1,11 @@
+"""Neurosymbolic ML layer: JAX MLP neural predicates trained end-to-end
+through differentiable weighted model counting, the MODEL / NEURAL RELATION /
+TRAIN / ML.PREDICT runtimes, and the external-model handler with MLSchema
+metadata.
+
+Parity: the reference's ``ml/`` crate (candle CPU MLP + pyo3 MLHandler) and
+``kolibrie/src/{neural_relations, execute_ml, execute_ml_train,
+ml_predict_runtime, ml_predict_candle, ml_feature_loader}.rs`` — except the
+MLP runs on the TPU via JAX (forward/VJP under jit), which replaces candle
+outright (SURVEY §7 step 7: "this part is MORE natural on TPU").
+"""

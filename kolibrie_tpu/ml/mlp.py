@@ -1,0 +1,191 @@
+"""JAX MLP neural predicate.
+
+Parity: ``ml/src/candle_model.rs`` — ``MlpNeuralPredicate``: He init, ReLU
+hidden layers, sigmoid (binary) / softmax (exclusive) output, Adam & SGD
+update rules, serde-JSON save/load (``SavedModel``).  Rebuilt on JAX: forward
+and VJP are jit-compiled XLA programs (MXU matmuls), and the custom manual
+backward of the reference is replaced by ``jax.vjp``.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _he_init(key, shape):
+    fan_in = shape[0]
+    return jax.random.normal(key, shape) * jnp.sqrt(2.0 / max(fan_in, 1))
+
+
+def _forward(params: List[Tuple[jnp.ndarray, jnp.ndarray]], x: jnp.ndarray, output: str):
+    h = x
+    for w, b in params[:-1]:
+        h = jax.nn.relu(h @ w + b)
+    w, b = params[-1]
+    logits = h @ w + b
+    if output == "binary":
+        return jax.nn.sigmoid(logits[..., 0])
+    return jax.nn.softmax(logits, axis=-1)
+
+
+# module-level jitted entry points: the compilation cache is shared across
+# model instances (keyed by shapes + static output kind)
+@partial(jax.jit, static_argnames="output")
+def _fwd_jit(params, x, output: str):
+    return _forward(params, x, output)
+
+
+@partial(jax.jit, static_argnames="output")
+def _vjp_jit(params, x, g, output: str):
+    _, vjp_fn = jax.vjp(lambda p: _forward(p, x, output), params)
+    return vjp_fn(g)[0]
+
+
+class MlpNeuralPredicate:
+    """MLP with probabilistic output, trained through WMC gradients."""
+
+    def __init__(
+        self,
+        in_dim: int,
+        hidden: Optional[List[int]] = None,
+        output_kind: str = "binary",
+        labels: Optional[List[str]] = None,
+        learning_rate: float = 0.01,
+        optimizer: str = "adam",
+        seed: int = 0,
+    ):
+        self.in_dim = in_dim
+        self.hidden = list(hidden or [16])
+        self.output_kind = output_kind
+        self.labels = list(labels or [])
+        self.out_dim = 1 if output_kind == "binary" else max(len(self.labels), 2)
+        self.learning_rate = learning_rate
+        self.optimizer = optimizer
+        key = jax.random.PRNGKey(seed)
+        dims = [in_dim] + self.hidden + [self.out_dim]
+        self.params: List[Tuple[jnp.ndarray, jnp.ndarray]] = []
+        for i in range(len(dims) - 1):
+            key, sub = jax.random.split(key)
+            self.params.append(
+                (_he_init(sub, (dims[i], dims[i + 1])), jnp.zeros(dims[i + 1]))
+            )
+        # Adam state
+        self._m = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self._v = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self._t = 0
+        # feature standardization (StandardScaler parity, ml/examples/predictor.py)
+        self.feature_mean = np.zeros(in_dim)
+        self.feature_std = np.ones(in_dim)
+
+    def set_normalization(self, mean: np.ndarray, std: np.ndarray) -> None:
+        self.feature_mean = np.asarray(mean, dtype=np.float64)
+        std = np.asarray(std, dtype=np.float64)
+        self.feature_std = np.where(std > 1e-9, std, 1.0)
+
+    def _norm(self, x: np.ndarray) -> jnp.ndarray:
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        return jnp.asarray((x - self.feature_mean) / self.feature_std, dtype=jnp.float32)
+
+    # ------------------------------------------------------------- inference
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Probabilities: (n,) for binary, (n, k) for exclusive."""
+        return np.asarray(_fwd_jit(self.params, self._norm(x), self.output_kind))
+
+    def predict_labels(self, x: np.ndarray) -> List[str]:
+        probs = self.predict(x)
+        if self.output_kind == "binary":
+            return ["true" if p >= 0.5 else "false" for p in probs]
+        idx = probs.argmax(axis=-1)
+        return [self.labels[i] if i < len(self.labels) else str(i) for i in idx]
+
+    # -------------------------------------------------------------- training
+
+    def forward_with_vjp(self, x: np.ndarray):
+        """Returns (probs, backward) where backward(prob_cotangents)
+        produces parameter gradients — the bridge from WMC seed gradients
+        back into the network (candle_model.rs forward_with_grads parity).
+
+        Both forward and backward run through shared jitted XLA programs."""
+        xj = self._norm(x)
+        probs = _fwd_jit(self.params, xj, self.output_kind)
+
+        def backward(prob_cotangents: np.ndarray):
+            g = jnp.asarray(prob_cotangents, dtype=probs.dtype).reshape(probs.shape)
+            return _vjp_jit(self.params, xj, g, self.output_kind)
+
+        return np.asarray(probs), backward
+
+    def apply_gradients(self, grads) -> None:
+        if self.optimizer == "sgd":
+            self.params = jax.tree_util.tree_map(
+                lambda p, g: p - self.learning_rate * g, self.params, grads
+            )
+            return
+        # Adam (candle_model.rs Adam state parity)
+        self._t += 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        self._m = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, self._m, grads
+        )
+        self._v = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, self._v, grads
+        )
+        t = self._t
+        lr = self.learning_rate * np.sqrt(1 - b2**t) / (1 - b1**t)
+        self.params = jax.tree_util.tree_map(
+            lambda p, m, v: p - lr * m / (jnp.sqrt(v) + eps),
+            self.params,
+            self._m,
+            self._v,
+        )
+
+    # ------------------------------------------------------------- save/load
+
+    def save(self, path: str) -> None:
+        data = {
+            "in_dim": self.in_dim,
+            "hidden": self.hidden,
+            "output_kind": self.output_kind,
+            "labels": self.labels,
+            "learning_rate": self.learning_rate,
+            "optimizer": self.optimizer,
+            "params": [
+                {"w": np.asarray(w).tolist(), "b": np.asarray(b).tolist()}
+                for w, b in self.params
+            ],
+            "feature_mean": self.feature_mean.tolist(),
+            "feature_std": self.feature_std.tolist(),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+
+    @staticmethod
+    def load(path: str) -> "MlpNeuralPredicate":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        model = MlpNeuralPredicate(
+            data["in_dim"],
+            data["hidden"],
+            data["output_kind"],
+            data.get("labels"),
+            data.get("learning_rate", 0.01),
+            data.get("optimizer", "adam"),
+        )
+        model.params = [
+            (jnp.asarray(p["w"], dtype=jnp.float32), jnp.asarray(p["b"], dtype=jnp.float32))
+            for p in data["params"]
+        ]
+        model._m = jax.tree_util.tree_map(jnp.zeros_like, model.params)
+        model._v = jax.tree_util.tree_map(jnp.zeros_like, model.params)
+        if "feature_mean" in data:
+            model.set_normalization(
+                np.asarray(data["feature_mean"]), np.asarray(data["feature_std"])
+            )
+        return model

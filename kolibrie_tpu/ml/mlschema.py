@@ -1,0 +1,50 @@
+"""MLSchema export: encode model implementations + evaluation metrics as W3C
+MLSchema (mls:) RDF/Turtle — metrics-as-knowledge-graph, queryable back via
+SPARQL.
+
+Parity: ``ml/src/mlschema.py`` (the reference's Python MLSchema writer) and
+the metrics-as-RDF pattern noted in SURVEY §5.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+MLS = "http://www.w3.org/ns/mls#"
+XSD = "http://www.w3.org/2001/XMLSchema#"
+
+
+def model_to_mlschema_ttl(
+    name: str,
+    algorithm: str = "MLP",
+    metrics: Dict[str, float] = None,
+    base: str = "http://kolibrie.tpu/models/",
+) -> str:
+    """Render a trained model + its evaluation metrics as MLSchema Turtle."""
+    metrics = metrics or {}
+    m = f"<{base}{name}>"
+    lines = [
+        "@prefix mls: <http://www.w3.org/ns/mls#> .",
+        f"@prefix xsd: <{XSD}> .",
+        "",
+        f"{m} a mls:Model ;",
+        f'    mls:hasQuality "{algorithm}" .',
+        "",
+        f"<{base}{name}/run> a mls:Run ;",
+        f"    mls:hasOutput {m} .",
+    ]
+    for i, (measure, value) in enumerate(sorted(metrics.items())):
+        ev = f"<{base}{name}/eval/{i}>"
+        lines += [
+            "",
+            f"{ev} a mls:ModelEvaluation ;",
+            f"    mls:specifiedBy <{MLS}{measure}> ;",
+            f'    mls:hasValue "{value}"^^xsd:double .',
+            f"<{base}{name}/run> mls:hasOutput {ev} .",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def load_mlschema_into_db(db, ttl: str) -> int:
+    """Ingest MLSchema metadata so model metrics are SPARQL-queryable."""
+    return db.parse_turtle(ttl)

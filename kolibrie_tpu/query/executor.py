@@ -449,6 +449,37 @@ def execute_query_volcano(sparql: str, db) -> Rows:
     return execute_combined(db, cq)
 
 
+def collect_all_patterns(where: WhereClause) -> List[PatternTriple]:
+    """Every triple pattern reachable from a group pattern — including
+    OPTIONAL/UNION/MINUS branches, NOT blocks, subqueries, and WINDOW
+    blocks (used for neural-relation materialization coverage)."""
+    out: List[PatternTriple] = list(where.patterns)
+    for nb in where.not_blocks:
+        out.extend(nb.patterns)
+    for wb in where.window_blocks:
+        out.extend(wb.patterns)
+    for opt in where.optionals:
+        out.extend(collect_all_patterns(opt))
+    for groups in where.unions:
+        for g in groups:
+            out.extend(collect_all_patterns(g))
+    for m in where.minus:
+        out.extend(collect_all_patterns(m))
+    for sq in where.subqueries:
+        out.extend(collect_all_patterns(sq.query.where))
+    return out
+
+
+def _materialize_neural_for_select(db, select: SelectQuery) -> None:
+    if not db.neural_relations:
+        return
+    from kolibrie_tpu.ml import runtime as ml_runtime
+
+    ml_runtime.materialize_neural_relations_for_patterns(
+        db, collect_all_patterns(select.where)
+    )
+
+
 def execute_combined(db, cq: CombinedQuery) -> Rows:
     db.prefixes.update(cq.prefixes)
     # neural/train declarations
@@ -469,6 +500,9 @@ def execute_combined(db, cq: CombinedQuery) -> Rows:
     if cq.insert is not None:
         process_insert_clause(db, cq.insert)
     if cq.select is not None:
+        # neural predicates referenced anywhere in the query materialize as
+        # ordinary triples first (neural_relations.rs parity)
+        _materialize_neural_for_select(db, cq.select)
         return execute_select(db, cq.select)
     return []
 
@@ -480,4 +514,6 @@ def execute_query(sparql: str, db) -> Rows:
     cq = parse_combined_query(sparql, db.prefixes)
     if cq.select is None:
         return execute_combined(db, cq)
+    # same pre-pass as the volcano path, so both agree on neural queries
+    _materialize_neural_for_select(db, cq.select)
     return execute_select(db, cq.select, use_optimizer=False)

@@ -97,6 +97,15 @@ def build_reasoner_from_db(db) -> Reasoner:
 def process_combined_rule(db, rule: A.CombinedRule) -> Tuple[Rule, List[Triple]]:
     """Register + immediately apply a RULE definition
     (process_rule_definition parity)."""
+    if db.neural_relations:
+        # rule bodies referencing neural predicates materialize first
+        # (parser.rs:2482 parity)
+        from kolibrie_tpu.ml import runtime as ml_runtime
+        from kolibrie_tpu.query.executor import collect_all_patterns
+
+        ml_runtime.materialize_neural_relations_for_patterns(
+            db, collect_all_patterns(rule.body)
+        )
     kg = build_reasoner_from_db(db)
     dynamic_rule = convert_combined_rule(db, rule)
     db.rule_map[rule.name] = dynamic_rule

@@ -1,11 +1,23 @@
 """Test configuration: force JAX onto an 8-device virtual CPU mesh so that
-multi-chip sharding paths compile and execute without TPU hardware."""
+multi-chip sharding paths compile and execute without TPU hardware.
+
+This environment preloads the axon (real-TPU tunnel) PJRT plugin via
+sitecustomize and sets JAX_PLATFORMS=axon, so jax is ALREADY imported when
+pytest starts; env-var overrides are too late, and initializing the axon
+backend from tests hangs (or costs ~70ms/dispatch over the tunnel).  The
+reliable override is ``jax.config.update("jax_platforms", "cpu")`` before
+any backend initialization.  Benchmarks (bench.py) intentionally keep the
+axon platform so they hit the real chip.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (preloaded by sitecustomize anyway)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,266 @@
+"""Neurosymbolic ML tests: JAX MLP, TRAIN NEURAL RELATION end-to-end through
+differentiable WMC, ML.PREDICT, MLSchema metadata.
+
+Parity: kolibrie/tests/ml_predict_candle_runtime.rs (TRAIN -> ML.PREDICT
+path, artifacts) + ml crate behavior.
+"""
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu.ml.handler import MLHandler, parse_mlschema_ttl
+from kolibrie_tpu.ml.mlp import MlpNeuralPredicate
+from kolibrie_tpu.ml.mlschema import load_mlschema_into_db, model_to_mlschema_ttl
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+
+class DummySk:
+    """Module-level so pickle can serialize it (sklearn stand-in)."""
+
+    def __init__(self, out):
+        self.out = out
+
+    def predict(self, X):
+        return np.full(len(X), self.out)
+
+
+class TestMlp:
+    def test_binary_forward_shapes(self):
+        m = MlpNeuralPredicate(3, [8], "binary")
+        p = m.predict(np.zeros((5, 3)))
+        assert p.shape == (5,)
+        assert ((p >= 0) & (p <= 1)).all()
+
+    def test_exclusive_softmax(self):
+        m = MlpNeuralPredicate(2, [4], "exclusive", labels=["a", "b", "c"])
+        p = m.predict(np.ones((4, 2)))
+        assert p.shape == (4, 3)
+        assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_vjp_backward_learns(self):
+        """Direct gradient descent through forward_with_vjp reduces loss."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 2))
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+        m = MlpNeuralPredicate(2, [16], "binary", learning_rate=0.05)
+
+        def loss_of(probs):
+            p = np.clip(probs, 1e-7, 1 - 1e-7)
+            return -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+
+        probs0, _ = m.forward_with_vjp(X)
+        for _ in range(200):
+            probs, backward = m.forward_with_vjp(X)
+            p = np.clip(probs, 1e-7, 1 - 1e-7)
+            cot = (-(y / p) + (1 - y) / (1 - p)) / len(y)
+            m.apply_gradients(backward(cot))
+        probs1, _ = m.forward_with_vjp(X)
+        assert loss_of(probs1) < loss_of(probs0) * 0.5
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = MlpNeuralPredicate(3, [5], "exclusive", labels=["x", "y"])
+        path = str(tmp_path / "model.json")
+        m.save(path)
+        m2 = MlpNeuralPredicate.load(path)
+        X = np.random.default_rng(1).normal(size=(4, 3))
+        assert np.allclose(m.predict(X), m2.predict(X), atol=1e-6)
+        assert m2.labels == ["x", "y"]
+
+
+def _digit_db():
+    db = SparqlDatabase()
+    rows = []
+    rng = np.random.default_rng(42)
+    for i in range(40):
+        label = i % 2
+        # feature pattern: class 0 near (0.1, 0.9), class 1 near (0.9, 0.1)
+        x0 = (0.1 if label == 0 else 0.9) + rng.normal(0, 0.05)
+        x1 = (0.9 if label == 0 else 0.1) + rng.normal(0, 0.05)
+        rows.append(
+            f'ex:s{i} ex:x0 "{x0:.4f}" ; ex:x1 "{x1:.4f}" ; ex:label "{label}" .'
+        )
+    db.parse_turtle("@prefix ex: <http://e/> .\n" + "\n".join(rows))
+    return db
+
+
+DECLS = """
+PREFIX ex: <http://e/>
+MODEL "digit_model" {
+    ARCH MLP { HIDDEN [16] }
+    OUTPUT EXCLUSIVE { "0", "1" }
+}
+NEURAL RELATION ex:predictedDigit USING MODEL "digit_model" {
+    INPUT {
+        ?sample ex:x0 ?x0 .
+        ?sample ex:x1 ?x1 .
+    }
+    FEATURES { ?x0, ?x1 }
+}
+"""
+
+
+class TestTrainPredict:
+    def test_train_and_predict_end_to_end(self, tmp_path):
+        db = _digit_db()
+        save = str(tmp_path / "digit.json")
+        execute_query_volcano(
+            DECLS
+            + f"""
+TRAIN NEURAL RELATION ex:predictedDigit {{
+    DATA {{ ?sample ex:label ?label . }}
+    LABEL ?label
+    TARGET {{ ?sample ex:predictedDigit ?label }}
+    LOSS cross_entropy
+    OPTIMIZER adam
+    LEARNING_RATE 0.05
+    EPOCHS 8
+    BATCH_SIZE 8
+    SAVE_TO "{save}"
+}}""",
+            db,
+        )
+        model = db.trained_models["digit_model"]
+        import os
+
+        assert os.path.exists(save)
+        # the trained model must classify the training distribution well
+        X = np.array([[0.1, 0.9], [0.9, 0.1]])
+        labels = model.predict_labels(X)
+        assert labels == ["0", "1"]
+
+    def test_ml_predict_materializes_predictions(self):
+        db = _digit_db()
+        execute_query_volcano(
+            DECLS
+            + """
+TRAIN NEURAL RELATION ex:predictedDigit {
+    DATA { ?sample ex:label ?label . }
+    LABEL ?label
+    TARGET { ?sample ex:predictedDigit ?label }
+    LOSS cross_entropy
+    EPOCHS 6
+    BATCH_SIZE 8
+    LEARNING_RATE 0.05
+}""",
+            db,
+        )
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            ML.PREDICT(
+                MODEL "digit_model",
+                INPUT { SELECT ?sample ?x0 ?x1 WHERE {
+                    ?sample ex:x0 ?x0 . ?sample ex:x1 ?x1 . } },
+                OUTPUT ?digit
+            )""",
+            db,
+        )
+        rows = execute_query_volcano(
+            "PREFIX ex: <http://e/> SELECT ?s ?d WHERE { ?s ex:predictedDigit ?d }",
+            db,
+        )
+        assert len(rows) == 40
+        preds = {r[0]: r[1] for r in rows}
+        assert preds["http://e/s0"] == "0"
+        assert preds["http://e/s1"] == "1"
+        # probability companions are queryable via SPARQL-star
+        prows = execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            PREFIX prob: <http://kolibrie.tpu/prob#>
+            SELECT ?p WHERE { << ex:s0 ex:predictedDigit "0" >> prob:value ?p }""",
+            db,
+        )
+        assert len(prows) == 1 and float(prows[0][0]) > 0.5
+
+    def test_neural_relation_in_query_pattern(self):
+        db = _digit_db()
+        execute_query_volcano(
+            DECLS
+            + """
+TRAIN NEURAL RELATION ex:predictedDigit {
+    DATA { ?sample ex:label ?label . }
+    LABEL ?label
+    TARGET { ?sample ex:predictedDigit ?label }
+    EPOCHS 6
+    BATCH_SIZE 8
+    LEARNING_RATE 0.05
+}""",
+            db,
+        )
+        rows = execute_query_volcano(
+            """PREFIX ex: <http://e/>
+            SELECT ?s WHERE { ?s ex:predictedDigit "1" }""",
+            db,
+        )
+        assert len(rows) == 20
+
+
+class TestBinaryTraining:
+    def test_binary_neural_relation(self):
+        db = SparqlDatabase()
+        rng = np.random.default_rng(7)
+        rows = []
+        for i in range(30):
+            hot = i % 2
+            t = (80 + rng.normal(0, 3)) if hot else (50 + rng.normal(0, 3))
+            rows.append(f'ex:m{i} ex:temp "{t:.2f}" ; ex:isHot "{"true" if hot else "false"}" .')
+        db.parse_turtle("@prefix ex: <http://e/> .\n" + "\n".join(rows))
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+MODEL "hot_model" { ARCH MLP { HIDDEN [8] } OUTPUT BINARY }
+NEURAL RELATION ex:predictedHot USING MODEL "hot_model" {
+    INPUT { ?m ex:temp ?t . }
+    FEATURES { ?t }
+}
+TRAIN NEURAL RELATION ex:predictedHot {
+    DATA { ?m ex:isHot ?hot . }
+    LABEL ?hot
+    TARGET { ?m ex:predictedHot ?l }
+    LOSS bce
+    EPOCHS 10
+    BATCH_SIZE 8
+    LEARNING_RATE 0.1
+}""",
+            db,
+        )
+        model = db.trained_models["hot_model"]
+        p_hot = model.predict(np.array([[85.0]]))
+        p_cold = model.predict(np.array([[45.0]]))
+        assert p_hot[0] > p_cold[0]
+
+
+class TestMLSchemaAndHandler:
+    def test_mlschema_roundtrip(self):
+        ttl = model_to_mlschema_ttl(
+            "m1", metrics={"accuracy": 0.93, "cpuUsage": 12.5}
+        )
+        db = SparqlDatabase()
+        load_mlschema_into_db(db, ttl)
+        rows = execute_query_volcano(
+            """PREFIX mls: <http://www.w3.org/ns/mls#>
+            SELECT ?v WHERE {
+              ?e a mls:ModelEvaluation .
+              ?e mls:specifiedBy <http://www.w3.org/ns/mls#accuracy> .
+              ?e mls:hasValue ?v
+            }""",
+            db,
+        )
+        assert rows == [["0.93"]]
+
+    def test_handler_discovery_best_model(self, tmp_path):
+        import pickle
+
+        for name, cpu in [("fast", 1.0), ("slow", 50.0)]:
+            with open(tmp_path / f"{name}_predictor.pkl", "wb") as f:
+                pickle.dump(DummySk(1.0 if name == "fast" else 2.0), f)
+            (tmp_path / f"{name}_schema.ttl").write_text(
+                model_to_mlschema_ttl(name, metrics={"cpuUsage": cpu})
+            )
+        h = MLHandler()
+        loaded = h.discover_and_load_models(str(tmp_path))
+        assert loaded == ["fast"]
+        res = h.predict("fast", [[1.0, 2.0]])
+        assert res.predictions == [1.0]
+        assert res.timing.total_ms >= 0
+        ranked = h.compare_models()
+        assert ranked[0].name == "fast"
