@@ -1,0 +1,258 @@
+"""Distributed partitioned equi-join: all_to_all repartition + local join.
+
+The shard_map bodies here are 32-bit only (keys are single u32 dictionary-ID
+columns; row identity uses multi-operand ``lax.sort``) so they run without
+the x64 scope that the packed host-facing kernels in
+:mod:`kolibrie_tpu.ops.device_join` need.
+
+Replaces the reference's rayon par_chunks hash joins
+(``shared/src/join_algorithm.rs:19-131,499-570``) with the classic
+distributed-DB plan: hash-partition both sides on the join key (one
+``all_to_all`` per repartitioned side, riding ICI), then sort-merge join
+locally per chip.
+
+Invalid-row sentinels: dictionary IDs occupy bits 0..30 (bit 31 marks quoted
+triples — ``shared/src/dictionary.rs:36-40``), so 0xFFFFFFFE / 0xFFFFFFFF
+never collide with real IDs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_LPAD32 = jnp.uint32(0xFFFFFFFE)
+_RPAD32 = jnp.uint32(0xFFFFFFFF)
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """Device twin of ``sharded_store._mix32`` — MUST stay bit-identical."""
+    x = x.astype(jnp.uint32)
+    c = jnp.uint32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * c
+    x = (x ^ (x >> 16)) * c
+    return x ^ (x >> 16)
+
+
+def shard_of_dev(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
+    return (mix32(key) % jnp.uint32(n_shards)).astype(jnp.int32)
+
+
+def local_join_u32(
+    lkey: jnp.ndarray,
+    rkey: jnp.ndarray,
+    cap: int,
+    lvalid: jnp.ndarray,
+    rvalid: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """32-bit static-shape equi-join (see device_join.join_indices)."""
+    lkey = jnp.where(lvalid, lkey.astype(jnp.uint32), _LPAD32)
+    rkey = jnp.where(rvalid, rkey.astype(jnp.uint32), _RPAD32)
+    ln, rn = lkey.shape[0], rkey.shape[0]
+    if ln == 0 or rn == 0:
+        z = jnp.zeros(cap, dtype=jnp.int32)
+        return z, z, jnp.zeros(cap, dtype=bool), jnp.int32(0)
+    order = jnp.argsort(rkey)
+    rsorted = rkey[order]
+    lo = jnp.searchsorted(rsorted, lkey, side="left")
+    hi = jnp.searchsorted(rsorted, lkey, side="right")
+    counts = (hi - lo).astype(jnp.int32)
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    row = jnp.searchsorted(cum, idx, side="right")
+    row_c = jnp.clip(row, 0, ln - 1)
+    start = cum[row_c] - counts[row_c]
+    pos = lo[row_c] + (idx - start)
+    valid = idx < total
+    li = jnp.where(valid, row_c, 0).astype(jnp.int32)
+    ri = jnp.where(valid, order[jnp.clip(pos, 0, rn - 1)], 0).astype(jnp.int32)
+    return li, ri, valid, total
+
+
+def bucketize(
+    cols: Sequence[jnp.ndarray],
+    valid: jnp.ndarray,
+    dest: jnp.ndarray,
+    n_shards: int,
+    bucket_cap: int,
+) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray]:
+    """Scatter local rows into per-destination buckets ``[n*bucket_cap]``.
+
+    Rows beyond a destination's capacity are DROPPED and counted so the host
+    can grow ``bucket_cap`` and retry (static-shape overflow protocol).
+    """
+    L = dest.shape[0]
+    dmask = jnp.where(valid, dest, n_shards)
+    order = jnp.argsort(dmask)
+    sd = dmask[order]
+    group_start = jnp.searchsorted(sd, sd, side="left")
+    rank = jnp.arange(L, dtype=jnp.int32) - group_start.astype(jnp.int32)
+    ok = (sd < n_shards) & (rank < bucket_cap)
+    slot = jnp.where(ok, sd * bucket_cap + rank, n_shards * bucket_cap)
+    bufs = []
+    for c in cols:
+        buf = jnp.zeros(n_shards * bucket_cap, dtype=c.dtype)
+        bufs.append(buf.at[slot].set(c[order], mode="drop"))
+    bvalid = (
+        jnp.zeros(n_shards * bucket_cap, dtype=bool).at[slot].set(ok, mode="drop")
+    )
+    dropped = jnp.sum(valid) - jnp.sum(ok)
+    return tuple(bufs), bvalid, dropped
+
+
+def exchange(
+    cols: Sequence[jnp.ndarray],
+    valid: jnp.ndarray,
+    dest: jnp.ndarray,
+    n_shards: int,
+    axis: str,
+    bucket_cap: int,
+) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray]:
+    """Route rows to their destination shard: bucketize + one all_to_all.
+
+    Returns local received rows ``[n*bucket_cap]`` + valid mask + the
+    GLOBAL dropped-row count (psum) for overflow detection.
+    """
+    bufs, bvalid, dropped = bucketize(cols, valid, dest, n_shards, bucket_cap)
+    a2a = lambda b: lax.all_to_all(  # noqa: E731
+        b.reshape(n_shards, bucket_cap), axis, 0, 0, tiled=True
+    ).reshape(n_shards * bucket_cap)
+    out = tuple(a2a(b) for b in bufs)
+    out_valid = a2a(bvalid)
+    return out, out_valid, lax.psum(dropped, axis)
+
+
+def _dist_join_body(
+    lcols, lvalid, rcols, rvalid, *, lkey_i, rkey_i, n, axis, bucket_cap, out_cap
+):
+    """Per-shard body: repartition both sides by key hash, join locally."""
+    lcols = tuple(c[0] for c in lcols)  # strip leading shard dim of size 1
+    rcols = tuple(c[0] for c in rcols)
+    lvalid, rvalid = lvalid[0], rvalid[0]
+    ld = shard_of_dev(lcols[lkey_i], n)
+    rd = shard_of_dev(rcols[rkey_i], n)
+    lr, lrv, ldrop = exchange(lcols, lvalid, ld, n, axis, bucket_cap)
+    rr, rrv, rdrop = exchange(rcols, rvalid, rd, n, axis, bucket_cap)
+    li, ri, jvalid, total = local_join_u32(
+        lr[lkey_i], rr[rkey_i], out_cap, lrv, rrv
+    )
+    louts = tuple(jnp.where(jvalid, c[li], 0)[None] for c in lr)
+    routs = tuple(jnp.where(jvalid, c[ri], 0)[None] for c in rr)
+    return (
+        louts,
+        routs,
+        jvalid[None],
+        lax.psum(total, axis)[None],
+        (ldrop + rdrop)[None],
+    )
+
+
+def dist_equi_join(
+    mesh: Mesh,
+    left_cols: Sequence[np.ndarray],
+    left_valid: np.ndarray,
+    right_cols: Sequence[np.ndarray],
+    right_valid: np.ndarray,
+    lkey_i: int,
+    rkey_i: int,
+    bucket_cap: int = 1024,
+    out_cap: int = 4096,
+):
+    """Distributed equi-join of two sharded row sets on one u32 key column.
+
+    Inputs are global ``[n_shards, L]`` arrays (host numpy or device).
+    Returns ``(left_out, right_out, valid, global_total, dropped)`` with
+    per-shard static capacity ``out_cap``; ``dropped > 0`` means bucket
+    overflow — retry with a larger ``bucket_cap``.
+    """
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    spec_cols = P(axis, None)
+    body = partial(
+        _dist_join_body,
+        lkey_i=lkey_i,
+        rkey_i=rkey_i,
+        n=n,
+        axis=axis,
+        bucket_cap=bucket_cap,
+        out_cap=out_cap,
+    )
+    nl, nr = len(left_cols), len(right_cols)
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                (spec_cols,) * nl,
+                spec_cols,
+                (spec_cols,) * nr,
+                spec_cols,
+            ),
+            out_specs=(
+                (spec_cols,) * nl,
+                (spec_cols,) * nr,
+                spec_cols,
+                P(axis),
+                P(axis),
+            ),
+        )
+    )
+    sh = NamedSharding(mesh, spec_cols)
+    put = lambda a: jax.device_put(jnp.asarray(a), sh)  # noqa: E731
+    lo, ro, v, tot, drop = fn(
+        tuple(put(c) for c in left_cols),
+        put(left_valid),
+        tuple(put(c) for c in right_cols),
+        put(right_valid),
+    )
+    return lo, ro, v, int(tot[0]), int(drop[0])
+
+
+def dist_bgp_join_count(store, p1: int, p2: int) -> int:
+    """COUNT of the 2-pattern BGP join ``(?x p1 ?y) . (?y p2 ?z)``.
+
+    Exploits the dual partitioning of :class:`ShardedTripleStore`: the left
+    side (keyed by object) lives object-hashed, the right (keyed by subject)
+    subject-hashed — matching keys are ALREADY co-located, so the join runs
+    with zero exchange and one scalar psum.  This is the headline
+    BGP-join benchmark path (BASELINE.md config 1/5).
+    """
+    mesh = store.mesh
+    axis = store.axis
+
+    def body(os_, op, oo, ov, ss, sp, so, sv):
+        os_, op, oo, ov = os_[0], op[0], oo[0], ov[0]
+        ss, sp, so, sv = ss[0], sp[0], so[0], sv[0]
+        lv = ov & (op == jnp.uint32(p1))
+        rv = sv & (sp == jnp.uint32(p2))
+        lkey = jnp.where(lv, oo, _LPAD32)
+        rkey = jnp.where(rv, ss, _RPAD32)
+        rsorted = jnp.sort(rkey)
+        lo = jnp.searchsorted(rsorted, lkey, side="left")
+        hi = jnp.searchsorted(rsorted, lkey, side="right")
+        total = jnp.sum((hi - lo).astype(jnp.int32))
+        return lax.psum(total, axis)[None]
+
+    spec = P(axis, None)
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=P(axis),
+        )
+    )
+    out = fn(
+        *store.by_obj,
+        store.by_obj_valid,
+        *store.by_subj,
+        store.by_subj_valid,
+    )
+    return int(out[0])

@@ -1,0 +1,40 @@
+"""Mesh construction helpers.
+
+One first-class distribution axis: data partitioning of the triple store
+(SURVEY.md §2.6 — the analogous axis to DP; the reference has no distributed
+execution at all).  A second optional axis ("batch") is used by the neural
+training step for data parallelism over samples.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_SHARDS = "shards"  # triple-store partitioning axis (ICI all-to-all)
+AXIS_BATCH = "batch"  # ML data-parallel axis
+
+
+def mesh_axis() -> str:
+    return AXIS_SHARDS
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis_name: str = AXIS_SHARDS,
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (axis_name,))

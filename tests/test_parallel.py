@@ -1,0 +1,239 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (conftest.py).
+
+Mirrors the reference's agreement-test pattern (SURVEY.md §4): the
+distributed fast path must agree exactly with the host reasoner / host joins.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kolibrie_tpu.core.rule import Rule
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.parallel import (
+    DistRuleSet,
+    DistributedReasoner,
+    ShardedTripleStore,
+    dist_bgp_join_count,
+    dist_equi_join,
+    distributed_seminaive,
+    dp_train_step,
+    make_mesh,
+    make_train_state,
+    neurosymbolic_step,
+)
+from kolibrie_tpu.parallel.sharded_store import partition_rows, shard_of
+
+V = Term.variable
+C = Term.constant
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def _chain_store(mesh, n, pred=100, cap=1024):
+    s = np.arange(1, n, dtype=np.uint32)
+    o = np.arange(2, n + 1, dtype=np.uint32)
+    p = np.full(n - 1, pred, dtype=np.uint32)
+    return ShardedTripleStore.from_columns(mesh, s, p, o, cap_per_shard=cap)
+
+
+def _trans_rule(pred=100, head=None):
+    head = pred if head is None else head
+    return Rule(
+        premise=[
+            TriplePattern(V("x"), C(pred), V("y")),
+            TriplePattern(V("y"), C(pred), V("z")),
+        ],
+        conclusion=[TriplePattern(V("x"), C(head), V("z"))],
+    )
+
+
+class TestShardedStore:
+    def test_partition_roundtrip(self, mesh):
+        st = _chain_store(mesh, 40)
+        assert st.n_triples == 39
+        s, p, o = st.gather_host()
+        assert set(zip(s.tolist(), o.tolist())) == {
+            (i, i + 1) for i in range(1, 40)
+        }
+
+    def test_shard_of_matches_device(self, mesh):
+        from kolibrie_tpu.parallel.dist_join import shard_of_dev
+
+        keys = np.arange(1, 1000, dtype=np.uint32)
+        host = shard_of(keys, 8)
+        dev = np.asarray(shard_of_dev(keys, 8))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_balanced_partitioning(self, mesh):
+        st = _chain_store(mesh, 1000, cap=512)
+        per_shard = np.asarray(st.by_subj_valid).sum(axis=1)
+        assert per_shard.min() > 0.5 * per_shard.mean()
+
+
+class TestDistJoin:
+    def test_equi_join_agrees_with_host(self, mesh):
+        rng = np.random.default_rng(1)
+        lk = rng.integers(1, 30, 100).astype(np.uint32)
+        la = rng.integers(1, 1000, 100).astype(np.uint32)
+        rk = rng.integers(1, 30, 80).astype(np.uint32)
+        rb = rng.integers(1, 1000, 80).astype(np.uint32)
+        lcols, lvalid = partition_rows((la, lk), la, 8, 64)
+        rcols, rvalid = partition_rows((rk, rb), rb, 8, 64)
+        lo, ro, v, tot, drop = dist_equi_join(
+            mesh, lcols, lvalid, rcols, rvalid,
+            lkey_i=1, rkey_i=0, bucket_cap=64, out_cap=512,
+        )
+        want = sum(1 for a in lk for b in rk if a == b)
+        assert drop == 0
+        assert tot == want
+        vv = np.asarray(v)
+        assert (np.asarray(lo[1])[vv] == np.asarray(ro[0])[vv]).all()
+
+    def test_bucket_overflow_detected(self, mesh):
+        # all rows share one key -> one destination bucket overflows
+        lk = np.full(100, 7, dtype=np.uint32)
+        la = np.arange(100, dtype=np.uint32) + 1
+        lcols, lvalid = partition_rows((la, lk), la, 8, 64)
+        rcols, rvalid = partition_rows((lk, la), la, 8, 64)
+        _, _, _, _, drop = dist_equi_join(
+            mesh, lcols, lvalid, rcols, rvalid,
+            lkey_i=1, rkey_i=0, bucket_cap=4, out_cap=512,
+        )
+        assert drop > 0
+
+    def test_bgp_join_count(self, mesh):
+        st = _chain_store(mesh, 50)
+        # (?x p ?y)(?y p ?z) over the chain: 48 2-hop paths
+        assert dist_bgp_join_count(st, 100, 100) == 48
+
+
+class TestDistributedFixpoint:
+    def test_transitive_closure_exact(self, mesh):
+        n = 40
+        st = _chain_store(mesh, n)
+        rs = DistRuleSet.from_rules([_trans_rule()])
+        assert rs is not None and rs.binary == [(100, 100, 100)]
+        dr = DistributedReasoner(
+            mesh, rs, fact_cap=1024, delta_cap=1024, join_cap=2048, bucket_cap=512
+        )
+        dr.infer(st)
+        s, _, o = st.gather_host()
+        got = set(zip(s.tolist(), o.tolist()))
+        want = {(i, j) for i in range(1, n + 1) for j in range(i + 1, n + 1)}
+        assert got == want
+
+    def test_agrees_with_host_reasoner(self, mesh):
+        """naive-vs-optimized agreement — the reference's own key pattern."""
+        from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+        rng = np.random.default_rng(3)
+        edges = {(int(a), int(b)) for a, b in
+                 zip(rng.integers(1, 25, 60), rng.integers(1, 25, 60)) if a != b}
+        s = np.array([e[0] for e in edges], dtype=np.uint32)
+        o = np.array([e[1] for e in edges], dtype=np.uint32)
+        p = np.full(len(edges), 100, dtype=np.uint32)
+
+        from kolibrie_tpu.core.triple import Triple
+
+        host = Reasoner()
+        for a, b in edges:
+            host.insert_ground_triple(Triple(int(a), 100, int(b)))
+        host.add_rule(_trans_rule())
+        host.infer_new_facts_semi_naive()
+        hs, hp, ho = host.facts.match(p=100)
+        want = set(zip(hs.tolist(), ho.tolist()))
+
+        st = ShardedTripleStore.from_columns(mesh, s, p, o, cap_per_shard=2048)
+        distributed_seminaive(
+            mesh, st, [_trans_rule()],
+            delta_cap=2048, join_cap=8192, bucket_cap=1024,
+        )
+        gs, _, go = st.gather_host()
+        got = set(zip(gs.tolist(), go.tolist()))
+        assert got == want
+
+    def test_unary_rule(self, mesh):
+        st = _chain_store(mesh, 10, pred=5, cap=512)
+        rule = Rule(
+            premise=[TriplePattern(V("x"), C(5), V("y"))],
+            conclusion=[TriplePattern(V("x"), C(6), V("y"))],
+        )
+        distributed_seminaive(mesh, st, [rule], delta_cap=512,
+                              join_cap=512, bucket_cap=256)
+        s, p, o = st.gather_host()
+        assert (p == 6).sum() == 9 and (p == 5).sum() == 9
+
+    def test_unsupported_rules_rejected(self, mesh):
+        bad = Rule(
+            premise=[TriplePattern(V("x"), V("p"), V("y"))],  # variable pred
+            conclusion=[TriplePattern(V("x"), C(6), V("y"))],
+        )
+        assert DistRuleSet.from_rules([bad]) is None
+        st = _chain_store(mesh, 4, cap=64)
+        with pytest.raises(NotImplementedError):
+            distributed_seminaive(mesh, st, [bad])
+
+    def test_overflow_raises(self, mesh):
+        st = _chain_store(mesh, 64, cap=16)  # fact_cap too small for closure
+        rs = DistRuleSet.from_rules([_trans_rule()])
+        dr = DistributedReasoner(
+            mesh, rs, fact_cap=16, delta_cap=16, join_cap=32, bucket_cap=8
+        )
+        with pytest.raises(OverflowError):
+            dr.infer(st)
+
+    def test_join_cap_overflow_detected(self, mesh):
+        # star graph: hub->leaves + leaves->hub gives quadratic join output
+        k = 40
+        s = np.concatenate([np.full(k, 1), np.arange(2, k + 2)]).astype(np.uint32)
+        o = np.concatenate([np.arange(2, k + 2), np.full(k, 1)]).astype(np.uint32)
+        p = np.full(2 * k, 100, dtype=np.uint32)
+        st = ShardedTripleStore.from_columns(mesh, s, p, o, cap_per_shard=4096)
+        rs = DistRuleSet.from_rules([_trans_rule()])
+        dr = DistributedReasoner(
+            mesh, rs, fact_cap=4096, delta_cap=4096, join_cap=8, bucket_cap=4096
+        )
+        with pytest.raises(OverflowError):
+            dr.infer(st)
+
+    def test_initial_delta_overflow_refused(self, mesh):
+        st = _chain_store(mesh, 200, cap=64)
+        rs = DistRuleSet.from_rules([_trans_rule()])
+        dr = DistributedReasoner(
+            mesh, rs, fact_cap=64, delta_cap=8, join_cap=64, bucket_cap=64
+        )
+        with pytest.raises(OverflowError):
+            dr.infer(st)
+
+
+class TestTrainStep:
+    def test_dp_loss_decreases(self, mesh):
+        st = make_train_state(jax.random.PRNGKey(0), in_dim=4, hidden=(8,))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4))
+        y = (x.sum(1) > 0).astype(np.float32)
+        st, loss0 = dp_train_step(mesh, st, x, y)
+        for _ in range(30):
+            st, loss = dp_train_step(mesh, st, x, y)
+        assert float(loss) < float(loss0)
+
+    def test_neurosymbolic_combined_step(self, mesh):
+        st_ml = make_train_state(jax.random.PRNGKey(0), in_dim=3, hidden=(8,))
+        store = _chain_store(mesh, 16, pred=7, cap=512)
+        dr = DistributedReasoner(
+            mesh,
+            DistRuleSet.from_rules([_trans_rule(7)]),
+            fact_cap=512, delta_cap=512, join_cap=1024, bucket_cap=256,
+        )
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(32, 3))
+        y = (x.sum(1) > 0).astype(np.float32)
+        _, loss, count = neurosymbolic_step(mesh, st_ml, x, y, dr, store)
+        assert np.isfinite(loss)
+        assert count == 14  # 2-hop facts derived in round 1
