@@ -395,9 +395,9 @@ class DistributedReasoner:
                     "distributed fixpoint buffer overflow — grow "
                     "fact_cap/delta_cap/join_cap/bucket_cap"
                 )
-            rounds += 1
             if int(count[0]) == 0:
                 break
+            rounds += 1
         store.by_subj = tuple(state[0:3])
         store.by_subj_valid = state[3]
         store.by_obj = tuple(state[4:7])

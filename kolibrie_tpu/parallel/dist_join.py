@@ -18,7 +18,7 @@ never collide with real IDs.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Sequence, Tuple
 
 import jax
@@ -143,6 +143,9 @@ def _dist_join_body(
     li, ri, jvalid, total = local_join_u32(
         lr[lkey_i], rr[rkey_i], out_cap, lrv, rrv
     )
+    # a shard whose local match count exceeds out_cap truncates its output —
+    # count the overrun so the caller's dropped>0 retry protocol catches it
+    out_ovf = lax.psum(jnp.maximum(total - out_cap, 0).astype(jnp.int32), axis)
     louts = tuple(jnp.where(jvalid, c[li], 0)[None] for c in lr)
     routs = tuple(jnp.where(jvalid, c[ri], 0)[None] for c in rr)
     return (
@@ -150,28 +153,14 @@ def _dist_join_body(
         routs,
         jvalid[None],
         lax.psum(total, axis)[None],
-        (ldrop + rdrop)[None],
+        (ldrop + rdrop + out_ovf)[None],
     )
 
 
-def dist_equi_join(
-    mesh: Mesh,
-    left_cols: Sequence[np.ndarray],
-    left_valid: np.ndarray,
-    right_cols: Sequence[np.ndarray],
-    right_valid: np.ndarray,
-    lkey_i: int,
-    rkey_i: int,
-    bucket_cap: int = 1024,
-    out_cap: int = 4096,
-):
-    """Distributed equi-join of two sharded row sets on one u32 key column.
-
-    Inputs are global ``[n_shards, L]`` arrays (host numpy or device).
-    Returns ``(left_out, right_out, valid, global_total, dropped)`` with
-    per-shard static capacity ``out_cap``; ``dropped > 0`` means bucket
-    overflow — retry with a larger ``bucket_cap``.
-    """
+@lru_cache(maxsize=64)
+def _equi_join_fn(mesh, nl, nr, lkey_i, rkey_i, bucket_cap, out_cap):
+    """Compiled-program cache: repeated joins with the same mesh/arity/caps
+    reuse one jitted shard_map program instead of retracing per call."""
     axis = mesh.axis_names[0]
     n = mesh.devices.size
     spec_cols = P(axis, None)
@@ -184,8 +173,7 @@ def dist_equi_join(
         bucket_cap=bucket_cap,
         out_cap=out_cap,
     )
-    nl, nr = len(left_cols), len(right_cols)
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
@@ -204,7 +192,30 @@ def dist_equi_join(
             ),
         )
     )
-    sh = NamedSharding(mesh, spec_cols)
+
+
+def dist_equi_join(
+    mesh: Mesh,
+    left_cols: Sequence[np.ndarray],
+    left_valid: np.ndarray,
+    right_cols: Sequence[np.ndarray],
+    right_valid: np.ndarray,
+    lkey_i: int,
+    rkey_i: int,
+    bucket_cap: int = 1024,
+    out_cap: int = 4096,
+):
+    """Distributed equi-join of two sharded row sets on one u32 key column.
+
+    Inputs are global ``[n_shards, L]`` arrays (host numpy or device).
+    Returns ``(left_out, right_out, valid, global_total, dropped)`` with
+    per-shard static capacity ``out_cap``; ``dropped > 0`` means rows were
+    lost to exchange-bucket OR join-output capacity — retry with larger
+    ``bucket_cap`` / ``out_cap``.
+    """
+    nl, nr = len(left_cols), len(right_cols)
+    fn = _equi_join_fn(mesh, nl, nr, lkey_i, rkey_i, bucket_cap, out_cap)
+    sh = NamedSharding(mesh, P(mesh.axis_names[0], None))
     put = lambda a: jax.device_put(jnp.asarray(a), sh)  # noqa: E731
     lo, ro, v, tot, drop = fn(
         tuple(put(c) for c in left_cols),
@@ -224,14 +235,27 @@ def dist_bgp_join_count(store, p1: int, p2: int) -> int:
     with zero exchange and one scalar psum.  This is the headline
     BGP-join benchmark path (BASELINE.md config 1/5).
     """
-    mesh = store.mesh
-    axis = store.axis
+    fn = _bgp_count_fn(store.mesh)
+    out = fn(
+        jnp.uint32(p1),
+        jnp.uint32(p2),
+        *store.by_obj,
+        store.by_obj_valid,
+        *store.by_subj,
+        store.by_subj_valid,
+    )
+    return int(out[0])
 
-    def body(os_, op, oo, ov, ss, sp, so, sv):
+
+@lru_cache(maxsize=8)
+def _bgp_count_fn(mesh):
+    axis = mesh.axis_names[0]
+
+    def body(p1, p2, os_, op, oo, ov, ss, sp, so, sv):
         os_, op, oo, ov = os_[0], op[0], oo[0], ov[0]
         ss, sp, so, sv = ss[0], sp[0], so[0], sv[0]
-        lv = ov & (op == jnp.uint32(p1))
-        rv = sv & (sp == jnp.uint32(p2))
+        lv = ov & (op == p1)
+        rv = sv & (sp == p2)
         lkey = jnp.where(lv, oo, _LPAD32)
         rkey = jnp.where(rv, ss, _RPAD32)
         rsorted = jnp.sort(rkey)
@@ -241,18 +265,11 @@ def dist_bgp_join_count(store, p1: int, p2: int) -> int:
         return lax.psum(total, axis)[None]
 
     spec = P(axis, None)
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(spec,) * 8,
+            in_specs=(P(), P()) + (spec,) * 8,
             out_specs=P(axis),
         )
     )
-    out = fn(
-        *store.by_obj,
-        store.by_obj_valid,
-        *store.by_subj,
-        store.by_subj_valid,
-    )
-    return int(out[0])

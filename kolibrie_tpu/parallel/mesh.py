@@ -2,8 +2,8 @@
 
 One first-class distribution axis: data partitioning of the triple store
 (SURVEY.md §2.6 — the analogous axis to DP; the reference has no distributed
-execution at all).  A second optional axis ("batch") is used by the neural
-training step for data parallelism over samples.
+execution at all).  The neural training step shards its batch over this same
+axis.
 """
 
 from __future__ import annotations
@@ -14,7 +14,6 @@ import jax
 from jax.sharding import Mesh
 
 AXIS_SHARDS = "shards"  # triple-store partitioning axis (ICI all-to-all)
-AXIS_BATCH = "batch"  # ML data-parallel axis
 
 
 def mesh_axis() -> str:

@@ -69,26 +69,25 @@ def _adam_update(state, grads, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
     return {"params": params, "m": m, "v": v, "t": t}
 
 
+@jax.jit
+def _dp_step(st, xb, yb, lr):
+    loss, grads = jax.value_and_grad(_bce)(st["params"], xb, yb)
+    return _adam_update(st, grads, lr=lr), loss
+
+
 def dp_train_step(mesh: Mesh, state: Dict, x: np.ndarray, y: np.ndarray, lr=1e-3):
     """One data-parallel Adam step: batch sharded over the mesh axis, params
-    replicated; XLA inserts the gradient all-reduce."""
+    replicated; XLA inserts the gradient all-reduce.  The jitted program is
+    module-level, so repeated calls (a training loop) hit the compile cache;
+    lr is a traced scalar — schedules don't recompile."""
     axis = mesh.axis_names[0]
     xsh = NamedSharding(mesh, P(axis, None))
     ysh = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
-
-    @partial(jax.jit, static_argnames="lr_")
-    def step(st, xb, yb, lr_):
-        loss, grads = jax.value_and_grad(_bce)(st["params"], xb, yb)
-        new = _adam_update(
-            {**st, "params": st["params"]}, grads, lr=lr_
-        )
-        return new, loss
-
     state = jax.device_put(state, rep)
     xb = jax.device_put(jnp.asarray(x, dtype=jnp.float32), xsh)
     yb = jax.device_put(jnp.asarray(y, dtype=jnp.float32), ysh)
-    return step(state, xb, yb, float(lr))
+    return _dp_step(state, xb, yb, jnp.float32(lr))
 
 
 def neurosymbolic_step(
@@ -141,6 +140,11 @@ def neurosymbolic_step(
     new_state, loss, out_state, count, overflow = step(
         state, xb, yb, *fixpoint_state
     )
+    if int(overflow[0]) > 0:
+        raise OverflowError(
+            "fixpoint round buffer overflow inside neurosymbolic_step — "
+            "grow the reasoner's fact_cap/delta_cap/join_cap/bucket_cap"
+        )
     store.by_subj = tuple(out_state[0:3])
     store.by_subj_valid = out_state[3]
     store.by_obj = tuple(out_state[4:7])
