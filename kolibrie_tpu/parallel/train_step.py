@@ -14,7 +14,6 @@ loss) compiles as a single multi-chip program; it is the step
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Tuple
 
 import jax

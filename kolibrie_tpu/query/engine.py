@@ -1,0 +1,105 @@
+"""QueryEngine facade — load + query + explain + stats.
+
+Parity: ``kolibrie/src/query_engine.rs:17-158`` — ``QueryEngine`` (new /
+load_ntriples_to_memory / add_triple / query via the Volcano path),
+``explain`` with ``StorageMode`` Static/Streaming/Hybrid decided by keyword
+detection (:117-156), and ``QueryEngineStats`` (:114-116).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List
+
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+
+class StorageMode:
+    STATIC = "Static"
+    STREAMING = "Streaming"
+    HYBRID = "Hybrid"
+
+
+@dataclass
+class QueryExplanation:
+    storage_mode: str
+    will_use_volcano: bool
+    has_windowing: bool
+    window_clauses: List[str] = field(default_factory=list)
+
+
+@dataclass
+class QueryEngineStats:
+    memory_triple_count: int
+
+
+_WINDOWING_KEYWORDS = (
+    "WINDOW", "FROM NAMED WINDOW", "SLIDING", "TUMBLING", "RANGE",
+    "RSTREAM", "ISTREAM", "DSTREAM", "SLIDE",
+)
+
+# Whole-word matching: a literal like "strange" must not trigger on RANGE.
+_WINDOWING_RE = re.compile(
+    r"\b(" + "|".join(re.escape(k) for k in _WINDOWING_KEYWORDS) + r")\b"
+)
+
+
+def has_windowing_operations(query: str) -> bool:
+    return _WINDOWING_RE.search(query.upper()) is not None
+
+
+def is_rspql_query(query: str) -> bool:
+    upper = query.upper()
+    return "REGISTER" in upper and any(
+        s in upper for s in ("RSTREAM", "ISTREAM", "DSTREAM")
+    )
+
+
+def extract_window_clauses(query: str) -> List[str]:
+    clauses = []
+    start = query.upper().find("FROM NAMED WINDOW")
+    if start >= 0:
+        remaining = query[start:]
+        end = remaining.upper().find("WHERE")
+        clauses.append((remaining[:end] if end >= 0 else remaining).strip())
+    return clauses
+
+
+class QueryEngine:
+    """Simple facade: an in-memory database plus the Volcano query path."""
+
+    def __init__(self) -> None:
+        self.db = SparqlDatabase()
+
+    def load_ntriples_to_memory(self, data: str) -> int:
+        return self.db.parse_ntriples(data)
+
+    def load_turtle_to_memory(self, data: str) -> int:
+        return self.db.parse_turtle(data)
+
+    def add_triple(self, subject: str, predicate: str, obj: str) -> None:
+        self.db.add_triple_parts(subject, predicate, obj)
+
+    def query(self, sparql: str) -> List[List[str]]:
+        return execute_query_volcano(sparql, self.db)
+
+    def explain(self, sparql: str) -> QueryExplanation:
+        windowing = has_windowing_operations(sparql)
+        rspql = is_rspql_query(sparql)
+        if rspql:
+            mode = StorageMode.STREAMING
+        elif windowing:
+            mode = StorageMode.HYBRID
+        else:
+            mode = StorageMode.STATIC
+        return QueryExplanation(
+            storage_mode=mode,
+            will_use_volcano=not rspql,
+            has_windowing=windowing,
+            window_clauses=extract_window_clauses(sparql),
+        )
+
+    def stats(self) -> QueryEngineStats:
+        return QueryEngineStats(memory_triple_count=len(self.db))

@@ -78,6 +78,23 @@ class SparqlDatabase:
             return self.dictionary.encode(term[1:-1])
         return self.dictionary.encode(term)
 
+    def lookup_term_str(self, term: str) -> Optional[int]:
+        """Non-interning counterpart of :meth:`encode_term_str` — same
+        normalization (``<iri>`` brackets, ``<< s p o >>`` quoted triples),
+        but returns ``None`` for unknown terms instead of allocating IDs."""
+        term = term.strip()
+        if term.startswith("<<") and term.endswith(">>"):
+            parts = split_quoted_triple_content(term[2:-2].strip())
+            if len(parts) != 3:
+                return None
+            ids = [self.lookup_term_str(p) for p in parts]
+            if any(i is None for i in ids):
+                return None
+            return self.quoted.lookup(*ids)
+        if term.startswith("<") and term.endswith(">"):
+            term = term[1:-1]
+        return self.dictionary.lookup(term)
+
     def decode_term(self, term_id: int) -> Optional[str]:
         return self.dictionary.decode_term(term_id, self.quoted)
 
@@ -266,6 +283,12 @@ class SparqlDatabase:
             self._stats = DatabaseStats.gather_stats_fast(self)
             self._stats_version = v
         return self._stats
+
+    def query(self):
+        """Fluent builder entry point (python/src/py_query_builder.rs surface)."""
+        from kolibrie_tpu.query.builder import QueryBuilder
+
+        return QueryBuilder(self)
 
     def clone(self) -> "SparqlDatabase":
         db = SparqlDatabase()

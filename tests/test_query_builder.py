@@ -1,0 +1,248 @@
+"""Fluent QueryBuilder + QueryEngine facade tests.
+
+Parity targets: kolibrie/tests/querybuilder_test.rs (streaming ISTREAM) and
+the QueryBuilder coverage inside integration_test.rs; query_engine.rs inline
+tests (basic query / stats / explain).
+"""
+
+from kolibrie_tpu.query.builder import QueryBuilder
+from kolibrie_tpu.query.engine import QueryEngine, StorageMode
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+from kolibrie_tpu.rsp.r2s import StreamOperator
+from kolibrie_tpu.rsp.s2r import ReportStrategy
+
+EX = "http://example.org/"
+
+
+def make_db():
+    db = SparqlDatabase()
+    db.add_triple_parts(f"{EX}alice", f"{EX}knows", f"{EX}bob")
+    db.add_triple_parts(f"{EX}alice", f"{EX}name", '"Alice"')
+    db.add_triple_parts(f"{EX}bob", f"{EX}knows", f"{EX}carol")
+    db.add_triple_parts(f"{EX}bob", f"{EX}name", '"Bob"')
+    db.add_triple_parts(f"{EX}carol", f"{EX}name", '"Carol"')
+    return db
+
+
+def test_with_subject_exact():
+    db = make_db()
+    rows = db.query().with_subject(f"{EX}alice").get_decoded_triples()
+    assert len(rows) == 2
+    assert all(s == f"{EX}alice" for s, _, _ in rows)
+
+
+def test_with_predicate_and_object():
+    db = make_db()
+    rows = (
+        db.query()
+        .with_predicate(f"{EX}knows")
+        .with_object(f"{EX}carol")
+        .get_decoded_triples()
+    )
+    assert rows == [(f"{EX}bob", f"{EX}knows", f"{EX}carol")]
+
+
+def test_like_starting_ending():
+    db = make_db()
+    assert db.query().with_subject_like("ali").count() == 2
+    assert db.query().with_object_ending("ob").count() == 1  # ex:bob
+    assert db.query().with_predicate_starting(f"{EX}kn").count() == 2
+    assert db.query().with_subject_starting(f"{EX}c").count() == 1
+
+
+def test_exact_filter_unknown_term_matches_nothing():
+    db = make_db()
+    assert db.query().with_subject(f"{EX}nobody").count() == 0
+
+
+def test_exact_filter_bracketed_iri_normalized():
+    db = SparqlDatabase()
+    db.add_triple_parts("<http://e/a>", "<http://e/p>", "<http://e/b>")
+    # The write path strips angle brackets; the read path must do the same.
+    assert db.query().with_subject("<http://e/a>").count() == 1
+    assert db.query().with_subject("http://e/a").count() == 1
+
+
+def test_streaming_custom_filter_applies():
+    db = SparqlDatabase()
+    qb = (
+        db.query()
+        .filter(lambda t: db.dictionary.decode(t.subject) == "keep")
+        .window(4, 2)
+        .with_stream_operator(StreamOperator.RSTREAM)
+        .as_stream()
+    )
+    for ts in range(9):
+        qb.add_stream_triple("keep" if ts % 2 == 0 else "drop", "p", f"o{ts}", ts)
+    subs = {
+        db.dictionary.decode(t.subject)
+        for batch in qb.get_stream_results()
+        for t in batch
+    }
+    assert subs <= {"keep"}
+
+
+def test_custom_filter():
+    db = make_db()
+    alice = db.dictionary.lookup(f"{EX}alice")
+    rows = db.query().filter(lambda t: t.subject == alice).get_triples()
+    assert len(rows) == 2
+
+
+def test_distinct_subjects_predicates_objects():
+    db = make_db()
+    subs = db.query().distinct().get_subjects()
+    assert subs == sorted({f"{EX}alice", f"{EX}bob", f"{EX}carol"})
+    preds = db.query().distinct().get_predicates()
+    assert preds == sorted({f"{EX}knows", f"{EX}name"})
+    objs = db.query().with_predicate(f"{EX}name").distinct().get_objects()
+    assert objs == ['"Alice"', '"Bob"', '"Carol"']
+
+
+def test_order_limit_offset():
+    db = make_db()
+    all_rows = db.query().order_by(lambda t: t).get_triples()
+    assert all_rows == sorted(all_rows)
+    desc_rows = db.query().order_by(lambda t: t).desc().get_triples()
+    assert desc_rows == sorted(all_rows, reverse=True)
+    assert db.query().limit(2).count() == 2
+    assert db.query().offset(3).count() == len(all_rows) - 3
+    assert db.query().offset(2).limit(2).get_triples() == all_rows[2:4]
+
+
+def test_group_by():
+    db = make_db()
+    groups = db.query().group_by(lambda t: t.subject)
+    assert len(groups) == 3
+    assert sum(len(v) for v in groups.values()) == 5
+
+
+def test_join_on_subject():
+    db = make_db()
+    other = SparqlDatabase()
+    other.dictionary = db.dictionary  # shared dictionary like the pyo3 surface
+    other.add_triple_parts(f"{EX}alice", f"{EX}age", '"30"')
+    rows = (
+        db.query()
+        .with_predicate(f"{EX}knows")
+        .join(other)
+        .join_on_subject()
+        .get_decoded_triples()
+    )
+    # left (alice knows bob) ⋈_s right (alice age 30) → (alice, knows, "30")
+    assert rows == [(f"{EX}alice", f"{EX}knows", '"30"')]
+
+
+def test_join_with_custom_condition():
+    db = make_db()
+    other = SparqlDatabase()
+    other.dictionary = db.dictionary
+    other.add_triple_parts(f"{EX}bob", f"{EX}age", '"25"')
+    bob = db.dictionary.lookup(f"{EX}bob")
+    rows = (
+        db.query()
+        .join(other)
+        .join_with(lambda lt, rt: lt.object == rt.subject == bob)
+        .get_decoded_triples()
+    )
+    assert rows == [(f"{EX}alice", f"{EX}age", '"25"')]
+
+
+def test_streaming_istream():
+    db = SparqlDatabase()
+    qb = (
+        db.query()
+        .with_predicate("p")
+        .window(10, 2)
+        .with_report_strategy(ReportStrategy.ON_WINDOW_CLOSE)
+        .with_stream_operator(StreamOperator.ISTREAM)
+        .as_stream()
+    )
+    assert qb.is_streaming()
+    assert qb.get_triples() == []
+    for ts in range(13):
+        qb.add_stream_triple(f"s{ts}", "p", f"o{ts}", ts)
+    batches = qb.get_stream_results()
+    assert batches, "window closings should have produced ISTREAM batches"
+    seen = {db.dictionary.decode(t.subject) for batch in batches for t in batch}
+    assert seen  # additions only, each subject at most once across ISTREAM
+    assert qb.get_all_stream_results() == batches
+    qb.clear_stream_results()
+    assert qb.get_all_stream_results() == []
+    qb.stop_stream()
+    assert not qb.is_streaming()
+
+
+def test_streaming_filter_excludes_nonmatching():
+    db = SparqlDatabase()
+    qb = (
+        db.query()
+        .with_predicate("p")
+        .window(4, 2)
+        .with_stream_operator(StreamOperator.RSTREAM)
+        .as_stream()
+    )
+    for ts in range(9):
+        qb.add_stream_triple(f"s{ts}", "p" if ts % 2 == 0 else "q", f"o{ts}", ts)
+    batches = qb.get_stream_results()
+    preds = {
+        db.dictionary.decode(t.predicate) for batch in batches for t in batch
+    }
+    assert preds <= {"p"}
+
+
+def test_add_stream_triple_requires_stream_mode():
+    db = make_db()
+    qb = db.query()
+    try:
+        qb.add_stream_triple("s", "p", "o", 0)
+        assert False, "expected RuntimeError"
+    except RuntimeError:
+        pass
+
+
+# --------------------------------------------------------------- QueryEngine
+
+
+def test_query_engine_basic_in_memory():
+    engine = QueryEngine()
+    engine.load_ntriples_to_memory(
+        '<http://example.org/john> <http://example.org/name> "John" .\n'
+    )
+    results = engine.query(
+        "PREFIX ex: <http://example.org/>\nSELECT ?name WHERE { ?person ex:name ?name }"
+    )
+    assert results == [["John"]]
+
+
+def test_query_engine_stats():
+    engine = QueryEngine()
+    engine.add_triple("s", "p", "o")
+    assert engine.stats().memory_triple_count == 1
+
+
+def test_query_engine_explain_static():
+    engine = QueryEngine()
+    exp = engine.explain("SELECT ?s ?p ?o WHERE { ?s ?p ?o . }")
+    assert exp.storage_mode == StorageMode.STATIC
+    assert exp.will_use_volcano
+    assert not exp.has_windowing
+
+
+def test_query_engine_explain_streaming():
+    engine = QueryEngine()
+    q = (
+        "REGISTER RSTREAM <out> AS SELECT ?s FROM NAMED WINDOW <w> ON <st> "
+        "[RANGE 10 STEP 2] WHERE { WINDOW <w> { ?s ?p ?o } }"
+    )
+    exp = engine.explain(q)
+    assert exp.storage_mode == StorageMode.STREAMING
+    assert not exp.will_use_volcano
+    assert exp.has_windowing
+    assert exp.window_clauses
+
+
+def test_query_engine_explain_hybrid():
+    engine = QueryEngine()
+    exp = engine.explain("SELECT ?s WHERE { ?s ?p ?o } # RANGE")
+    assert exp.storage_mode == StorageMode.HYBRID
