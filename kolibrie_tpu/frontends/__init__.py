@@ -1,0 +1,14 @@
+"""User-facing frontends: command-line interface and HTTP server.
+
+Parity: ``cli/src/main.rs`` (clap CLI) and
+``kolibrie-http-server/src/main.rs`` (hand-rolled HTTP server with /query,
+/rsp-query, /rsp/register, /rsp/push, SSE /rsp/events/<id>, playground).
+"""
+
+
+def cli_main(argv=None):
+    """Lazy forward to :func:`kolibrie_tpu.frontends.cli.main` (keeps
+    ``python -m kolibrie_tpu.frontends.cli`` free of double-import warnings)."""
+    from kolibrie_tpu.frontends.cli import main
+
+    return main(argv)

@@ -1,0 +1,102 @@
+"""Command-line interface: load RDF, run SPARQL, print a result table.
+
+Parity: ``cli/src/main.rs:15-41`` (``--file RDF --query SPARQL``), extended
+with format override, rule application (SPARQL RULE and N3 logic), and an
+``--serve`` flag that starts the HTTP server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def _read_arg(value: str) -> str:
+    """Accept either inline text or a path to a file holding the text."""
+    if os.path.exists(value):
+        with open(value, "r", encoding="utf-8") as f:
+            return f.read()
+    return value
+
+
+def _print_table(rows: List[List[str]], out) -> None:
+    if not rows:
+        print("(no results)", file=out)
+        return
+    widths = [
+        max(len(str(r[i])) for r in rows if i < len(r))
+        for i in range(max(len(r) for r in rows))
+    ]
+    for row in rows:
+        print(
+            "  ".join(str(v).ljust(w) for v, w in zip(row, widths)).rstrip(),
+            file=out,
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kolibrie-tpu",
+        description="TPU-native SPARQL/RDF + RSP + probabilistic-Datalog engine",
+    )
+    ap.add_argument("--file", help="RDF data file (format by extension)")
+    ap.add_argument("--format", help="override data format: turtle|ntriples|rdfxml|n3")
+    ap.add_argument("--query", help="SPARQL query text or path to a .rq file")
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=[],
+        help="SPARQL RULE definition (text or path); may repeat",
+    )
+    ap.add_argument("--n3logic", help="N3 logic rules (text or path)")
+    ap.add_argument("--legacy", action="store_true", help="use the legacy join path")
+    ap.add_argument("--time", action="store_true", help="print execution time")
+    ap.add_argument("--serve", action="store_true", help="start the HTTP server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7878)
+    args = ap.parse_args(argv)
+
+    if args.serve:
+        from kolibrie_tpu.frontends.http_server import serve
+
+        serve(args.host, args.port)
+        return 0
+
+    if not args.query:
+        ap.error("--query is required (unless --serve)")
+
+    from kolibrie_tpu.query.executor import execute_query, execute_query_volcano
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    db = SparqlDatabase()
+    if args.file:
+        db.load_file(args.file, args.format)
+
+    if args.n3logic:
+        from kolibrie_tpu.frontends.rules import apply_n3_logic
+
+        inferred = apply_n3_logic(db, _read_arg(args.n3logic))
+        print(f"# n3logic inferred {inferred} fact(s)", file=sys.stderr)
+
+    for rule_text in args.rule:
+        from kolibrie_tpu.frontends.rules import apply_sparql_rules
+
+        inferred = apply_sparql_rules(db, [_read_arg(rule_text)])
+        print(f"# rule inferred {inferred} fact(s)", file=sys.stderr)
+
+    sparql = _read_arg(args.query)
+    start = time.perf_counter()
+    run = execute_query if args.legacy else execute_query_volcano
+    rows = run(sparql, db)
+    elapsed_ms = (time.perf_counter() - start) * 1000.0
+    _print_table(rows, sys.stdout)
+    if args.time:
+        print(f"# {len(rows)} row(s) in {elapsed_ms:.2f} ms", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

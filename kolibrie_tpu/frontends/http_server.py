@@ -1,0 +1,489 @@
+"""HTTP frontend: /query, /rsp-query, /rsp/register, /rsp/push, SSE events.
+
+Parity: ``kolibrie-http-server/src/main.rs`` — routes (:593-624), request/
+response JSON shapes (:55-158), results table with first-seen header order
+(:189-213), persistent RSP sessions in a locked map with a monotone counter
+(:32-40, :743-756), SSE result streaming (:306-307, :828-878), 64MB request
+cap (:42-44), CORS headers, and the playground served at ``/``.
+
+Rebuild notes: built on stdlib ``ThreadingHTTPServer`` (one thread per
+connection, like the reference's thread-per-conn TCP loop); sessions hold an
+``RSPEngine`` plus per-subscriber SSE queues.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from kolibrie_tpu.frontends.rules import (
+    apply_n3_logic,
+    apply_sparql_rules,
+    strip_hash_comments,
+)
+
+MAX_REQUEST_BYTES = 64 * 1024 * 1024  # main.rs:42-44
+SSE_KEEPALIVE_SECONDS = 15.0
+
+_PLAYGROUND_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "web",
+    "playground.html",
+)
+
+
+def results_to_table(results: List[Tuple[Tuple[str, str], ...]]) -> List[List[str]]:
+    """Binding rows → [header, row, row...] with first-seen var order
+    (main.rs:189-213)."""
+    if not results:
+        return []
+    headers: List[str] = []
+    for row in results:
+        for key, _ in row:
+            if key not in headers:
+                headers.append(key)
+    table = [list(headers)]
+    for row in results:
+        m = dict(row)
+        table.append([m.get(h, "") for h in headers])
+    return table
+
+
+def _parsed_term_to_str(term) -> str:
+    """ParsedTerm → text form an RSP WindowTriple carries (<< >> for quoted)."""
+    if isinstance(term, tuple):
+        _, s, p, o = term
+        return (
+            f"<< {_parsed_term_to_str(s)} {_parsed_term_to_str(p)} "
+            f"{_parsed_term_to_str(o)} >>"
+        )
+    return term
+
+
+def _load_rdf_into(db, data: str, fmt: str) -> int:
+    data = data or ""
+    if not data.strip():
+        return 0
+    if fmt in ("ntriples", "turtle"):
+        data = strip_hash_comments(data)
+    if fmt == "ntriples":
+        return db.parse_ntriples(data)
+    if fmt == "turtle":
+        return db.parse_turtle(data)
+    if fmt == "n3":
+        return db.parse_n3(data)
+    return db.parse_rdf(data)
+
+
+class EngineSession:
+    """One persistent RSP session: engine + result log + SSE subscribers."""
+
+    def __init__(self, engine, streams: List[str]):
+        self.engine = engine
+        self.streams = streams
+        self.results: List[List[List[str]]] = []
+        self.subscribers: List["queue.Queue[str]"] = []
+        self.lock = threading.Lock()
+        # serializes engine mutation: the RSP engine's single-thread drain
+        # path is not safe under concurrent /rsp/push handler threads
+        self.push_lock = threading.Lock()
+
+    def emit(self, row: Tuple[Tuple[str, str], ...]) -> None:
+        table = results_to_table([row])
+        payload = json.dumps({"results": table})
+        with self.lock:
+            self.results.append(table)
+            for q in self.subscribers:
+                q.put(payload)
+
+    def subscribe_with_backlog(self) -> Tuple["queue.Queue[str]", List[str]]:
+        """Atomically add a subscriber and snapshot prior results — a row
+        emitted between the two would otherwise be delivered twice."""
+        q: "queue.Queue[str]" = queue.Queue()
+        with self.lock:
+            self.subscribers.append(q)
+            backlog = [json.dumps({"results": t}) for t in self.results]
+        return q, backlog
+
+    def unsubscribe(self, q) -> None:
+        with self.lock:
+            if q in self.subscribers:
+                self.subscribers.remove(q)
+
+
+class _ServerState:
+    def __init__(self):
+        self.sessions: Dict[str, EngineSession] = {}
+        self.lock = threading.Lock()
+        self.counter = itertools.count(1)
+
+
+def _build_rsp_engine(
+    query: str,
+    static_rdf: Optional[str],
+    static_format: str,
+    n3logic: Optional[str],
+    sparql_rules: Optional[List[str]],
+    consumer,
+):
+    """Build an RSPEngine for /rsp-query and /rsp/register (main.rs:648-756)."""
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+    from kolibrie_tpu.rsp.builder import RSPBuilder
+    from kolibrie_tpu.rsp.engine import OperationMode
+
+    builder = (
+        RSPBuilder(strip_hash_comments(query))
+        .set_operation_mode(OperationMode.SINGLE_THREAD)
+        .with_consumer(consumer)
+    )
+    if n3logic and n3logic.strip():
+        builder.add_rules(strip_hash_comments(n3logic))
+    engine = builder.build()
+    if static_rdf and static_rdf.strip():
+        if static_format == "turtle":
+            engine.static_db.parse_turtle(strip_hash_comments(static_rdf))
+        else:
+            tmp = SparqlDatabase()
+            _load_rdf_into(tmp, static_rdf, static_format)
+            engine.static_db.parse_ntriples(tmp.to_ntriples())
+    if sparql_rules:
+        apply_sparql_rules(engine.static_db, sparql_rules)
+    return engine
+
+
+def _push_event(engine, stream: str, timestamp: int, ntriples: str) -> int:
+    """Parse N-Triples and route each triple to the stream's windows."""
+    from kolibrie_tpu.query.rdf_parsers import parse_ntriples
+    from kolibrie_tpu.rsp.s2r import WindowTriple
+
+    cleaned = strip_hash_comments(ntriples)
+    if not cleaned.strip():
+        return 0
+    triples = parse_ntriples(cleaned)
+    for s, p, o in triples:
+        engine.add_to_stream(
+            stream,
+            WindowTriple(
+                _parsed_term_to_str(s),
+                _parsed_term_to_str(p),
+                _parsed_term_to_str(o),
+            ),
+            timestamp,
+        )
+    engine.process_single_thread_window_results()
+    return len(triples)
+
+
+class KolibrieHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _ServerState = None  # set by serve()
+    quiet = False
+
+    # ------------------------------------------------------------- plumbing
+
+    def log_message(self, fmt, *args):
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
+        self.send_header("Access-Control-Allow-Headers", "Content-Type")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload, code: int = 200) -> None:
+        self._send(code, json.dumps(payload).encode(), "application/json")
+
+    def _send_error_json(self, message: str, code: int = 400) -> None:
+        self._send_json({"error": message}, code)
+
+    def _read_body(self) -> Optional[bytes]:
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_REQUEST_BYTES:
+            self._send_error_json("request too large", 413)
+            return None
+        return self.rfile.read(length)
+
+    def _read_json(self) -> Optional[dict]:
+        body = self._read_body()
+        if body is None:
+            return None
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            self._send_error_json(f"Invalid JSON: {e}")
+            return None
+        if not isinstance(payload, dict):
+            self._send_error_json("Invalid JSON: expected an object")
+            return None
+        return payload
+
+    # --------------------------------------------------------------- routes
+
+    def do_OPTIONS(self):
+        self._send(204, b"", "text/plain")
+
+    def do_GET(self):
+        if self.path == "/" or self.path == "/playground":
+            try:
+                with open(_PLAYGROUND_PATH, "rb") as f:
+                    self._send(200, f.read(), "text/html; charset=utf-8")
+            except OSError:
+                self._send_error_json("playground not available", 404)
+            return
+        if self.path.startswith("/rsp/events/"):
+            self._handle_sse(self.path[len("/rsp/events/"):])
+            return
+        self._send_error_json("not found", 404)
+
+    def do_POST(self):
+        if self.path == "/query":
+            self._handle_query()
+        elif self.path == "/rsp-query":
+            self._handle_rsp_query()
+        elif self.path == "/rsp/register":
+            self._handle_rsp_register()
+        elif self.path == "/rsp/push":
+            self._handle_rsp_push()
+        else:
+            self._send_error_json("not found", 404)
+
+    # ---------------------------------------------------------------- /query
+
+    def _handle_query(self):
+        from kolibrie_tpu.query.executor import (
+            execute_query,
+            execute_query_volcano,
+        )
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        req = self._read_json()
+        if req is None:
+            return
+        queries: List[str] = []
+        if req.get("sparql"):
+            queries.append(req["sparql"])
+        queries.extend(req.get("queries") or [])
+        if not queries:
+            self._send_error_json("No queries provided")
+            return
+        rules: List[str] = []
+        if req.get("rule"):
+            rules.append(req["rule"])
+        rules.extend(req.get("rules") or [])
+        fmt = req.get("format", "rdfxml")
+
+        db = SparqlDatabase()
+        try:
+            _load_rdf_into(db, req.get("rdf") or "", fmt)
+        except Exception as e:
+            self._send_error_json(f"RDF parse error: {e}")
+            return
+
+        n3logic = req.get("n3logic")
+        if n3logic:
+            try:
+                apply_n3_logic(db, n3logic)
+            except Exception as e:
+                self._send_error_json(f"N3 rule error: {e}")
+                return
+        if rules:
+            try:
+                apply_sparql_rules(db, rules)
+            except Exception as e:
+                self._send_error_json(f"Rule error: {e}")
+                return
+
+        results = []
+        # The reference routes only pre-indexed ntriples loads through the
+        # Volcano optimizer (main.rs:941); here Volcano IS the default path
+        # and {"legacy": true} opts into the sequential agreement path.
+        run = execute_query if req.get("legacy") else execute_query_volcano
+        for idx, q in enumerate(queries):
+            start = time.perf_counter()
+            try:
+                rows = run(strip_hash_comments(q), db)
+            except Exception as e:
+                self._send_error_json(f"Query {idx} failed: {e}")
+                return
+            results.append(
+                {
+                    "query_index": idx,
+                    "query": q,
+                    "data": rows,
+                    "execution_time_ms": (time.perf_counter() - start) * 1000.0,
+                }
+            )
+        self._send_json({"results": results})
+
+    # ------------------------------------------------------------ /rsp-query
+
+    def _handle_rsp_query(self):
+        req = self._read_json()
+        if req is None:
+            return
+        if not req.get("query"):
+            self._send_error_json("No query provided")
+            return
+        collected: List = []
+        start = time.perf_counter()
+        try:
+            engine = _build_rsp_engine(
+                req["query"],
+                req.get("static_rdf"),
+                req.get("static_format", "rdfxml"),
+                None,
+                None,
+                collected.append,
+            )
+        except Exception as e:
+            self._send_error_json(f"Failed to build RSP engine: {e}")
+            return
+        events = [e for e in (req.get("events") or []) if isinstance(e, dict)]
+        events.sort(key=lambda e: e.get("timestamp", 0))
+        try:
+            for ev in events:
+                _push_event(
+                    engine,
+                    ev.get("stream", ""),
+                    int(ev.get("timestamp", 0)),
+                    ev.get("ntriples", ""),
+                )
+        except Exception as e:
+            self._send_error_json(f"Event error: {e}")
+            return
+        engine.stop()
+        table = results_to_table(collected)
+        self._send_json(
+            {
+                "data": table,
+                "total_results": max(0, len(table) - 1),
+                "execution_time_ms": (time.perf_counter() - start) * 1000.0,
+            }
+        )
+
+    # --------------------------------------------------------- /rsp sessions
+
+    def _handle_rsp_register(self):
+        req = self._read_json()
+        if req is None:
+            return
+        if not req.get("query"):
+            self._send_error_json("No query provided")
+            return
+        holder: List[EngineSession] = []
+
+        def consumer(row):
+            if holder:
+                holder[0].emit(row)
+
+        try:
+            engine = _build_rsp_engine(
+                req["query"],
+                req.get("static_rdf"),
+                req.get("static_format", "rdfxml"),
+                req.get("n3logic"),
+                req.get("sparql_rules"),
+                consumer,
+            )
+        except Exception as e:
+            self._send_error_json(f"Failed to build RSP engine: {e}")
+            return
+        streams = [cfg.stream_iri for cfg in engine.window_configs]
+        session = EngineSession(engine, streams)
+        holder.append(session)
+        state = self.state
+        with state.lock:
+            session_id = str(next(state.counter))
+            state.sessions[session_id] = session
+        self._send_json({"session_id": session_id, "streams": streams})
+
+    def _handle_rsp_push(self):
+        req = self._read_json()
+        if req is None:
+            return
+        state = self.state
+        with state.lock:
+            session = state.sessions.get(str(req.get("session_id")))
+        if session is None:
+            self._send_error_json("session not found", 404)
+            return
+        try:
+            with session.push_lock:
+                n = _push_event(
+                    session.engine,
+                    req.get("stream", ""),
+                    int(req.get("timestamp", 0)),
+                    req.get("ntriples", ""),
+                )
+        except Exception as e:
+            self._send_error_json(f"Push error: {e}")
+            return
+        self._send_json({"ok": True, "triples": n})
+
+    def _handle_sse(self, session_id: str):
+        state = self.state
+        with state.lock:
+            session = state.sessions.get(session_id)
+        if session is None:
+            self._send_error_json("session not found", 404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Access-Control-Allow-Origin", "*")
+        # SSE is an unbounded stream: no Content-Length, close to terminate.
+        self.send_header("Connection", "close")
+        self.end_headers()
+        q, backlog = session.subscribe_with_backlog()
+        try:
+            # replay results that arrived before the client connected
+            for payload in backlog:
+                self.wfile.write(f"data: {payload}\n\n".encode())
+            self.wfile.flush()
+            while True:
+                try:
+                    payload = q.get(timeout=SSE_KEEPALIVE_SECONDS)
+                    self.wfile.write(f"data: {payload}\n\n".encode())
+                except queue.Empty:
+                    self.wfile.write(b": keepalive\n\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            session.unsubscribe(q)
+
+
+def make_server(host: str = "127.0.0.1", port: int = 7878, quiet: bool = False):
+    handler = type(
+        "BoundHandler", (KolibrieHandler,), {"state": _ServerState(), "quiet": quiet}
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(host: str = "127.0.0.1", port: int = 7878) -> None:
+    httpd = make_server(host, port)
+    print(f"kolibrie-tpu server listening on http://{host}:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(
+        sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1",
+        int(sys.argv[2]) if len(sys.argv) > 2 else 7878,
+    )

@@ -36,7 +36,6 @@ class TripleFilter:
     CONTAINS = "contains"
     STARTS_WITH = "starts_with"
     ENDS_WITH = "ends_with"
-    CUSTOM = "custom"
 
     def __init__(self, kind: str, value=None):
         self.kind = kind
@@ -51,7 +50,7 @@ class TripleFilter:
             return s.startswith(self.value)
         if self.kind == TripleFilter.ENDS_WITH:
             return s.endswith(self.value)
-        return bool(self.value(s))
+        raise ValueError(f"unknown filter kind {self.kind!r}")
 
 
 class JoinCondition:
@@ -220,8 +219,24 @@ class QueryBuilder:
 
     def _apply_join(self, left: List[Triple]) -> List[Triple]:
         """Hash-join against the second DB (reference semantics: the output
-        triple mixes left/right fields per condition, query_builder.rs:562-618)."""
-        right = list(self._join_db.store)
+        triple mixes left/right fields per condition, query_builder.rs:562-618).
+
+        If the two databases do not share a dictionary, the right side is
+        re-encoded into the left dictionary first — raw IDs from different
+        dictionaries are not comparable."""
+        if self._join_db.dictionary is self.db.dictionary:
+            right = list(self._join_db.store)
+        else:
+            enc = self.db.encode_term_str
+            rdec = self._join_db.decode_term
+            right = [
+                Triple(
+                    enc(rdec(t.subject) or ""),
+                    enc(rdec(t.predicate) or ""),
+                    enc(rdec(t.object) or ""),
+                )
+                for t in self._join_db.store
+            ]
         out = set()
         for cond in self._join_conditions:
             if callable(cond):
@@ -338,34 +353,52 @@ class QueryBuilder:
         self._runner.add_to_window(WindowTriple(subject, predicate, obj), timestamp)
         self._current_ts = timestamp
 
+    @classmethod
+    def _norm_term_text(cls, term: str) -> str:
+        """Text-level counterpart of encode_term_str normalization: strip
+        surrounding ``<...>`` and recursively normalize each component of
+        ``<< s p o >>`` (so bracketed and bare spellings compare equal)."""
+        from kolibrie_tpu.query.sparql_database import split_quoted_triple_content
+
+        term = term.strip()
+        if term.startswith("<<") and term.endswith(">>"):
+            parts = split_quoted_triple_content(term[2:-2].strip())
+            return "<< " + " ".join(cls._norm_term_text(p) for p in parts) + " >>"
+        if term.startswith("<") and term.endswith(">"):
+            return term[1:-1]
+        return term
+
     def _execute_on_window_content(self, content: ContentContainer) -> List[Triple]:
         """Apply the configured s/p/o filters to the window's string triples
         and intern matches into the database dictionary (query_builder.rs:757+).
 
-        Terms are interned first so filters see the same normalization
-        (bracket stripping, quoted triples) as the static path: exact
-        filters compare IDs, pattern filters match the decoded string."""
+        Filters run BEFORE interning so rejected stream triples never grow
+        the dictionary; exact filters compare normalized text so they agree
+        with the static path's ID-based semantics."""
         out = []
+        norm_exact = {
+            pos: self._norm_term_text(f.value)
+            for pos, f in self._filters.items()
+            if f is not None and f.kind == TripleFilter.EXACT
+        }
         enc = self.db.encode_term_str
         for wt in content:
-            t = Triple(enc(wt.s), enc(wt.p), enc(wt.o))
             ok = True
-            for pos, tid in (
-                ("subject", t.subject),
-                ("predicate", t.predicate),
-                ("object", t.object),
-            ):
+            for pos, val in (("subject", wt.s), ("predicate", wt.p), ("object", wt.o)):
                 filt = self._filters[pos]
                 if filt is None:
                     continue
                 if filt.kind == TripleFilter.EXACT:
-                    if self.db.lookup_term_str(filt.value) != tid:
+                    if self._norm_term_text(val) != norm_exact[pos]:
                         ok = False
                         break
-                elif not filt.matches(self.db.decode_term(tid) or ""):
+                elif not filt.matches(self._norm_term_text(val)):
                     ok = False
                     break
-            if ok and (self._custom_filter is None or self._custom_filter(t)):
+            if not ok:
+                continue
+            t = Triple(enc(wt.s), enc(wt.p), enc(wt.o))
+            if self._custom_filter is None or self._custom_filter(t):
                 out.append(t)
         return out
 

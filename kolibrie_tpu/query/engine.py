@@ -40,21 +40,41 @@ _WINDOWING_KEYWORDS = (
     "RSTREAM", "ISTREAM", "DSTREAM", "SLIDE",
 )
 
-# Whole-word matching: a literal like "strange" must not trigger on RANGE.
+# Keywords must be standalone tokens: not part of a larger word, not the
+# local part of a prefixed name (ex:range), not a variable (?range) — and
+# IRIs, string literals, and # comments are scrubbed before matching.
 _WINDOWING_RE = re.compile(
-    r"\b(" + "|".join(re.escape(k) for k in _WINDOWING_KEYWORDS) + r")\b"
+    r"(?<![\w:?$])("
+    + "|".join(re.escape(k) for k in _WINDOWING_KEYWORDS)
+    + r")(?![\w:])"
+)
+_SCRUB_RE = re.compile(
+    r"""<[^>\s]*>              # IRIs
+      | "(?:[^"\\]|\\.)*"      # double-quoted literals
+      | '(?:[^'\\]|\\.)*'      # single-quoted literals
+      | \#[^\n]*               # comments
+    """,
+    re.VERBOSE,
 )
 
 
+def _scrub(query: str) -> str:
+    """Blank out IRIs, literals and comments so keyword detection only sees
+    real syntax."""
+    return _SCRUB_RE.sub(" ", query)
+
+
 def has_windowing_operations(query: str) -> bool:
-    return _WINDOWING_RE.search(query.upper()) is not None
+    return _WINDOWING_RE.search(_scrub(query).upper()) is not None
+
+
+_RSPQL_RE = re.compile(
+    r"(?<![\w:?$])REGISTER\s+(R|I|D)STREAM(?![\w:])", re.IGNORECASE
+)
 
 
 def is_rspql_query(query: str) -> bool:
-    upper = query.upper()
-    return "REGISTER" in upper and any(
-        s in upper for s in ("RSTREAM", "ISTREAM", "DSTREAM")
-    )
+    return _RSPQL_RE.search(_scrub(query)) is not None
 
 
 def extract_window_clauses(query: str) -> List[str]:

@@ -1,0 +1,292 @@
+"""Frontend tests: CLI table output and the HTTP server routes
+(/query with rdf+rules+timing, /rsp-query replay, /rsp/register + /rsp/push
+sessions, SSE events).
+
+Parity: cli/src/main.rs and kolibrie-http-server/src/main.rs routes
+(:593-624); request/response JSON shapes (:55-158).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kolibrie_tpu.frontends.cli import main as cli_main
+from kolibrie_tpu.frontends.http_server import make_server
+from kolibrie_tpu.frontends.rules import (
+    apply_n3_logic,
+    has_n3_rule_text,
+    strip_hash_comments,
+)
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+TTL = """
+@prefix ex: <http://example.org/> .
+ex:alice ex:knows ex:bob .
+ex:bob ex:knows ex:carol .
+"""
+
+
+# ------------------------------------------------------------------ helpers
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = make_server("127.0.0.1", 0, quiet=True)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def post(base, path, payload, expect_error=False):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        if not expect_error:
+            raise AssertionError(f"unexpected error response: {body}")
+        return body
+
+
+# -------------------------------------------------------------------- rules
+
+
+def test_strip_hash_comments():
+    text = '<http://e/a#frag> <http://e/p> "x # not comment" . # real comment\n'
+    out = strip_hash_comments(text)
+    assert "#frag" in out
+    assert "# not comment" in out
+    assert "real comment" not in out
+
+
+def test_has_n3_rule_text():
+    assert has_n3_rule_text("{ ?a ex:p ?b } => { ?b ex:q ?a } .")
+    assert not has_n3_rule_text("# => inside comment only")
+
+
+def test_apply_n3_logic_infers():
+    db = SparqlDatabase()
+    db.parse_turtle(TTL)
+    n3 = (
+        "@prefix ex: <http://example.org/> .\n"
+        "{ ?a ex:knows ?b . ?b ex:knows ?c } => { ?a ex:knows2 ?c } ."
+    )
+    inferred = apply_n3_logic(db, n3)
+    assert inferred == 1
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    rows = execute_query_volcano(
+        "PREFIX ex: <http://example.org/> SELECT ?a ?c WHERE { ?a ex:knows2 ?c }",
+        db,
+    )
+    assert rows == [["http://example.org/alice", "http://example.org/carol"]]
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+def test_cli_query(tmp_path, capsys):
+    data = tmp_path / "data.ttl"
+    data.write_text(TTL)
+    rc = cli_main(
+        [
+            "--file",
+            str(data),
+            "--query",
+            "PREFIX ex: <http://example.org/> SELECT ?a WHERE { ?a ex:knows ex:bob }",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "http://example.org/alice" in out
+
+
+def test_cli_n3logic(tmp_path, capsys):
+    data = tmp_path / "data.ttl"
+    data.write_text(TTL)
+    n3 = tmp_path / "rules.n3"
+    n3.write_text(
+        "@prefix ex: <http://example.org/> .\n"
+        "{ ?a ex:knows ?b . ?b ex:knows ?c } => { ?a ex:reach ?c } ."
+    )
+    rc = cli_main(
+        [
+            "--file",
+            str(data),
+            "--n3logic",
+            str(n3),
+            "--query",
+            "PREFIX ex: <http://example.org/> SELECT ?c WHERE { ex:alice ex:reach ?c }",
+        ]
+    )
+    assert rc == 0
+    assert "http://example.org/carol" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------- HTTP
+
+
+def test_http_query_turtle(server):
+    body = post(
+        server,
+        "/query",
+        {
+            "rdf": TTL,
+            "format": "turtle",
+            "sparql": "PREFIX ex: <http://example.org/> SELECT ?a ?b WHERE { ?a ex:knows ?b }",
+        },
+    )
+    result = body["results"][0]
+    assert result["query_index"] == 0
+    assert result["execution_time_ms"] >= 0
+    assert sorted(result["data"]) == [
+        ["http://example.org/alice", "http://example.org/bob"],
+        ["http://example.org/bob", "http://example.org/carol"],
+    ]
+
+
+def test_http_query_multiple_and_n3logic(server):
+    body = post(
+        server,
+        "/query",
+        {
+            "rdf": TTL,
+            "format": "turtle",
+            "n3logic": (
+                "@prefix ex: <http://example.org/> .\n"
+                "{ ?a ex:knows ?b . ?b ex:knows ?c } => { ?a ex:reach ?c } ."
+            ),
+            "queries": [
+                "PREFIX ex: <http://example.org/> SELECT ?c WHERE { ex:alice ex:reach ?c }",
+                "PREFIX ex: <http://example.org/> SELECT ?b WHERE { ex:alice ex:knows ?b }",
+            ],
+        },
+    )
+    assert len(body["results"]) == 2
+    assert body["results"][0]["data"] == [["http://example.org/carol"]]
+    assert body["results"][1]["data"] == [["http://example.org/bob"]]
+
+
+def test_http_query_no_queries_error(server):
+    body = post(server, "/query", {"rdf": TTL}, expect_error=True)
+    assert "No queries" in body["error"]
+
+
+def test_http_query_non_object_json_error(server):
+    req = urllib.request.Request(
+        server + "/query",
+        data=b"[]",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "expected an object" in json.loads(e.read())["error"]
+
+
+def test_http_query_legacy_flag(server):
+    body = post(
+        server,
+        "/query",
+        {
+            "rdf": TTL,
+            "format": "turtle",
+            "legacy": True,
+            "sparql": "PREFIX ex: <http://example.org/> SELECT ?b WHERE { ex:alice ex:knows ?b }",
+        },
+    )
+    assert body["results"][0]["data"] == [["http://example.org/bob"]]
+
+
+def test_http_query_bad_json_error(server):
+    req = urllib.request.Request(
+        server + "/query",
+        data=b"{not json",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "Invalid JSON" in json.loads(e.read())["error"]
+
+
+RSP_QUERY = (
+    "REGISTER RSTREAM <out> AS SELECT * "
+    "FROM NAMED WINDOW <w> ON <stream1> [RANGE 10 STEP 2] "
+    "WHERE { WINDOW <w> { ?s ?p ?o } }"
+)
+
+
+def test_http_rsp_query_replay(server):
+    events = [
+        {
+            "stream": "stream1",
+            "timestamp": ts,
+            "ntriples": f"<http://e/s{ts}> <http://e/p> <http://e/o{ts}> .",
+        }
+        for ts in range(1, 7)
+    ]
+    body = post(server, "/rsp-query", {"query": RSP_QUERY, "events": events})
+    assert body["total_results"] >= 1
+    header = body["data"][0]
+    assert set(header) >= {"s", "p", "o"}
+
+
+def test_http_rsp_session_and_sse(server):
+    reg = post(server, "/rsp/register", {"query": RSP_QUERY})
+    sid = reg["session_id"]
+    assert reg["streams"] == ["stream1"]
+
+    for ts in range(1, 7):
+        body = post(
+            server,
+            "/rsp/push",
+            {
+                "session_id": sid,
+                "stream": "stream1",
+                "timestamp": ts,
+                "ntriples": f"<http://e/s{ts}> <http://e/p> <http://e/o{ts}> .",
+            },
+        )
+        assert body["ok"]
+
+    # SSE replays the backlog for late subscribers; read the first event.
+    req = urllib.request.Request(server + f"/rsp/events/{sid}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        line = resp.readline().decode()
+        assert line.startswith("data: ")
+        payload = json.loads(line[len("data: "):])
+        assert "results" in payload
+
+
+def test_http_rsp_push_unknown_session(server):
+    body = post(
+        server,
+        "/rsp/push",
+        {"session_id": "999999", "stream": "s", "timestamp": 1, "ntriples": ""},
+        expect_error=True,
+    )
+    assert "session not found" in body["error"]
+
+
+def test_http_playground_served(server):
+    with urllib.request.urlopen(server + "/") as resp:
+        html = resp.read().decode()
+    assert "kolibrie-tpu playground" in html

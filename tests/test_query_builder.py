@@ -117,6 +117,21 @@ def test_group_by():
     assert sum(len(v) for v in groups.values()) == 5
 
 
+def test_join_on_subject_independent_dictionaries():
+    db = make_db()
+    other = SparqlDatabase()  # its own dictionary: IDs must be re-encoded
+    other.add_triple_parts(f"{EX}zebra", f"{EX}stripes", '"many"')
+    other.add_triple_parts(f"{EX}alice", f"{EX}age", '"30"')
+    rows = (
+        db.query()
+        .with_predicate(f"{EX}knows")
+        .join(other)
+        .join_on_subject()
+        .get_decoded_triples()
+    )
+    assert rows == [(f"{EX}alice", f"{EX}knows", '"30"')]
+
+
 def test_join_on_subject():
     db = make_db()
     other = SparqlDatabase()
@@ -191,6 +206,22 @@ def test_streaming_filter_excludes_nonmatching():
     assert preds <= {"p"}
 
 
+def test_streaming_exact_filter_quoted_triple_spellings():
+    db = SparqlDatabase()
+    qb = (
+        db.query()
+        .with_subject("<< <http://a> <http://p> <http://o> >>")
+        .window(4, 2)
+        .with_stream_operator(StreamOperator.RSTREAM)
+        .as_stream()
+    )
+    for ts in range(5):
+        # bare spelling must match the bracketed filter (same interned ID)
+        qb.add_stream_triple("<< http://a http://p http://o >>", "q", f"o{ts}", ts)
+    batches = qb.get_stream_results()
+    assert batches and all(len(b) > 0 for b in batches)
+
+
 def test_add_stream_triple_requires_stream_mode():
     db = make_db()
     qb = db.query()
@@ -244,5 +275,21 @@ def test_query_engine_explain_streaming():
 
 def test_query_engine_explain_hybrid():
     engine = QueryEngine()
-    exp = engine.explain("SELECT ?s WHERE { ?s ?p ?o } # RANGE")
+    exp = engine.explain("SELECT ?s WHERE { WINDOW ?w { ?s ?p ?o } }")
     assert exp.storage_mode == StorageMode.HYBRID
+
+
+def test_query_engine_explain_no_false_positives():
+    engine = QueryEngine()
+    # RANGE inside an IRI, a literal, a prefixed name, or a comment is data,
+    # not windowing syntax.
+    for q in (
+        "SELECT ?s WHERE { ?s <http://ex/range> ?o }",
+        'SELECT ?s WHERE { ?s ex:label "strange window" }',
+        "SELECT ?s WHERE { ?s ex:range ?o }",
+        "SELECT ?s WHERE { ?s ?p ?o } # RANGE ISTREAM",
+        "SELECT ?range WHERE { ?range ex:p ?o }",
+    ):
+        exp = engine.explain(q)
+        assert exp.storage_mode == StorageMode.STATIC, q
+        assert exp.will_use_volcano, q
