@@ -1,0 +1,59 @@
+"""Native N-Triples bulk load: tokenize + unique-term interning in C++, so
+Python interns only the document's UNIQUE terms (then remaps the per-triple
+term indices with one vectorized gather).
+
+Fast path for :meth:`SparqlDatabase.parse_ntriples`; returns None when the
+native library is unavailable or the document uses constructs the native
+tokenizer does not handle (RDF-star, Turtle shorthand) — the caller then
+falls back to the Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from kolibrie_tpu.native import load
+
+
+def bulk_parse_ntriples(data: str) -> Optional[tuple]:
+    """Parse a plain N-Triples document natively.
+
+    Returns ``(ids, terms)`` where ``ids`` is an ``(n, 3) uint32`` array of
+    1-based indices into ``terms`` (the unique term strings, in first-seen
+    order), or None to request the Python fallback.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    raw = data.encode("utf-8")
+    session = ctypes.c_void_p()
+    n = int(lib.kn_nt_parse(raw, len(raw), ctypes.byref(session)))
+    if n < 0:
+        return None  # -1 syntax error / -2 unsupported: Python decides
+    try:
+        ids = np.empty(n * 3, dtype=np.uint32)
+        if n:
+            lib.kn_nt_ids(
+                session, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+            )
+        n_terms = int(lib.kn_nt_nterms(session))
+        nbytes = int(lib.kn_nt_term_bytes(session))
+        buf = ctypes.create_string_buffer(nbytes)
+        offsets = (ctypes.c_int64 * (n_terms + 1))()
+        lib.kn_nt_terms(session, buf, offsets)
+        blob = buf.raw
+        try:
+            # surrogatepass: lone-surrogate \uXXXX escapes decode to the same
+            # string the Python parser's chr() produces
+            terms = [
+                blob[offsets[i]: offsets[i + 1]].decode("utf-8", "surrogatepass")
+                for i in range(n_terms)
+            ]
+        except UnicodeDecodeError:
+            return None  # out-of-range escape: let the Python parser decide
+    finally:
+        lib.kn_nt_free(session)
+    return ids.reshape(n, 3), terms

@@ -144,7 +144,30 @@ class SparqlDatabase:
         return self._ingest(triples)
 
     def parse_ntriples(self, data: str) -> int:
+        native = self._parse_ntriples_native(data)
+        if native is not None:
+            return native
         return self._ingest(rdf_parsers.parse_ntriples(data))
+
+    def _parse_ntriples_native(self, data: str) -> Optional[int]:
+        """Bulk fast path: C++ tokenizer + unique-term interning; Python
+        interns only unique terms, then one vectorized remap.  Returns None
+        (fall back) for RDF-star / Turtle constructs or if native is off."""
+        try:
+            from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+        except ImportError:
+            return None
+        result = bulk_parse_ntriples(data)
+        if result is None:
+            return None
+        ids, terms = result
+        remap = np.empty(len(terms) + 1, dtype=np.uint32)
+        enc = self.dictionary.encode
+        for i, t in enumerate(terms):
+            remap[i + 1] = enc(t)
+        cols = remap[ids]
+        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
+        return int(ids.shape[0])
 
     def parse_rdf(self, data: str) -> int:
         """RDF/XML. Parity: ``sparql_database.rs:401`` ``parse_rdf``."""

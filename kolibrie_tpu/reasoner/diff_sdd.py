@@ -25,6 +25,10 @@ def wmc_gradient(
     """Gradient of WMC(nid) w.r.t. each variable's success probability."""
     if var_indices is None:
         var_indices = range(len(manager.vars))
+    native = getattr(manager, "wmc_gradient", None)
+    if native is not None:
+        # native engine computes the substitution sweep in C++
+        return native(nid, list(var_indices))
     grads: Dict[int, float] = {}
     for v in var_indices:
         vi = manager.vars[v]

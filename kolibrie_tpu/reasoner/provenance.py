@@ -402,7 +402,7 @@ def make_provenance(name: str, k: int = 8) -> Provenance:
     if name in ("wmc", "dnf"):
         return DnfWmcProvenance()
     if name == "sdd":
-        from kolibrie_tpu.reasoner.sdd import SddManager, SddProvenance
+        from kolibrie_tpu.reasoner.sdd import SddProvenance
 
-        return SddProvenance(SddManager())
+        return SddProvenance()
     raise ValueError(f"unknown provenance semiring {name!r}")

@@ -244,13 +244,25 @@ class SddManager:
         return len(seen)
 
 
+def make_sdd_manager():
+    """SddManager factory: native C++ engine when available (the
+    neurosymbolic training hot path), pure-Python otherwise.  Both expose
+    the identical interface and node semantics (tests/test_native.py)."""
+    try:
+        from kolibrie_tpu.native.sdd_native import NativeSddManager
+
+        return NativeSddManager()
+    except (ImportError, RuntimeError):
+        return SddManager()
+
+
 class SddProvenance:
     """Provenance semiring with SDD-node tags (sdd.rs:705-777)."""
 
     name = "sdd"
 
     def __init__(self, manager: Optional[SddManager] = None):
-        self.manager = manager or SddManager()
+        self.manager = manager if manager is not None else make_sdd_manager()
         self.seed_vars: Dict[int, int] = {}  # seed_id -> var index
 
     def zero(self):

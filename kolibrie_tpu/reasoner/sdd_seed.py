@@ -12,7 +12,7 @@ from typing import List, Tuple
 
 from kolibrie_tpu.core.triple import Triple
 from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
-from kolibrie_tpu.reasoner.sdd import SddManager, SddProvenance
+from kolibrie_tpu.reasoner.sdd import SddProvenance
 from kolibrie_tpu.reasoner.seed_spec import ExclusiveGroupSeed, IndependentSeed
 from kolibrie_tpu.reasoner.tag_store import TagStore
 
@@ -21,7 +21,7 @@ def infer_new_facts_with_sdd_seed_specs(
     reasoner, seed_specs: List[object]
 ) -> Tuple[TagStore, SddProvenance]:
     """Returns (tag store after closure, the SddProvenance used)."""
-    prov = SddProvenance(SddManager())
+    prov = SddProvenance()
     store = TagStore(prov)
     mgr = prov.manager
     for spec in seed_specs:

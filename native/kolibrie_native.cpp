@@ -1,0 +1,552 @@
+// kolibrie_tpu native runtime: host-side hot paths in C++.
+//
+// Components (parity with the reference's native-Rust components; the Python
+// package dispatches here when the shared library is available):
+//
+//  1. SDD engine  — hash-consed decision-diagram arena with apply/negate
+//     caches, WMC with skipped-level weight correction, exactly-one
+//     encoding, model enumeration, and the weight-substitution WMC gradient.
+//     (reference: shared/src/sdd.rs, shared/src/diff_sdd.rs; Python twin:
+//     kolibrie_tpu/reasoner/sdd.py — the two implementations must agree,
+//     see tests/test_native.py)
+//
+//  2. N-Triples bulk tokenizer/interner — parses an N-Triples document into
+//     a session-local unique-term table plus per-triple term indices in one
+//     call, so the Python side interns only UNIQUE terms.
+//     (reference: the parse hot path of kolibrie/src/sparql_database.rs;
+//     Python twin: kolibrie_tpu/query/rdf_parsers.py)
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ───────────────────────────── SDD engine ────────────────────────────────
+
+namespace {
+
+constexpr int64_t FALSE_ID = 0;
+constexpr int64_t TRUE_ID = 1;
+
+struct Node {
+  int64_t var, hi, lo;
+};
+
+struct NodeKey {
+  int64_t var, hi, lo;
+  bool operator==(const NodeKey &o) const {
+    return var == o.var && hi == o.hi && lo == o.lo;
+  }
+};
+
+struct NodeKeyHash {
+  size_t operator()(const NodeKey &k) const {
+    uint64_t h = 1469598103934665603ull;
+    for (uint64_t x : {(uint64_t)k.var, (uint64_t)k.hi, (uint64_t)k.lo}) {
+      h ^= x + 0x9e3779b97f4a7c15ull + (h << 6) + (h >> 2);
+    }
+    return (size_t)h;
+  }
+};
+
+struct PairKey {
+  int64_t a, b;
+  int op;  // 0 = and, 1 = or
+  bool operator==(const PairKey &o) const {
+    return a == o.a && b == o.b && op == o.op;
+  }
+};
+
+struct PairKeyHash {
+  size_t operator()(const PairKey &k) const {
+    uint64_t h = (uint64_t)k.a * 0x9e3779b97f4a7c15ull;
+    h ^= (uint64_t)k.b + 0x9e3779b97f4a7c15ull + (h << 6) + (h >> 2);
+    return (size_t)(h * 2 + k.op);
+  }
+};
+
+struct VarInfo {
+  double w_pos, w_neg;
+  int kind;  // 0 = independent, 1 = exclusive
+};
+
+struct SddManager {
+  std::vector<Node> nodes{{-1, 0, 0}, {-1, 1, 1}};
+  std::unordered_map<NodeKey, int64_t, NodeKeyHash> unique;
+  std::unordered_map<PairKey, int64_t, PairKeyHash> apply_cache;
+  std::unordered_map<int64_t, int64_t> negate_cache;
+  std::vector<VarInfo> vars;
+
+  int64_t mk(int64_t var, int64_t hi, int64_t lo) {
+    if (hi == lo) return hi;  // trimming rule
+    NodeKey key{var, hi, lo};
+    auto it = unique.find(key);
+    if (it != unique.end()) return it->second;
+    int64_t nid = (int64_t)nodes.size();
+    nodes.push_back({var, hi, lo});
+    unique.emplace(key, nid);
+    return nid;
+  }
+
+  int64_t apply(int64_t a, int64_t b, int op) {
+    if (op == 0) {
+      if (a == FALSE_ID || b == FALSE_ID) return FALSE_ID;
+      if (a == TRUE_ID) return b;
+      if (b == TRUE_ID) return a;
+    } else {
+      if (a == TRUE_ID || b == TRUE_ID) return TRUE_ID;
+      if (a == FALSE_ID) return b;
+      if (b == FALSE_ID) return a;
+    }
+    if (a == b) return a;
+    if (a > b) std::swap(a, b);
+    PairKey key{a, b, op};
+    auto it = apply_cache.find(key);
+    if (it != apply_cache.end()) return it->second;
+    int64_t va = nodes[a].var, vb = nodes[b].var;
+    int64_t res;
+    if (va == vb) {
+      res = mk(va, apply(nodes[a].hi, nodes[b].hi, op),
+               apply(nodes[a].lo, nodes[b].lo, op));
+    } else if (va < vb) {
+      res = mk(va, apply(nodes[a].hi, b, op), apply(nodes[a].lo, b, op));
+    } else {
+      res = mk(vb, apply(a, nodes[b].hi, op), apply(a, nodes[b].lo, op));
+    }
+    apply_cache.emplace(key, res);
+    return res;
+  }
+
+  int64_t negate(int64_t a) {
+    if (a == FALSE_ID) return TRUE_ID;
+    if (a == TRUE_ID) return FALSE_ID;
+    auto it = negate_cache.find(a);
+    if (it != negate_cache.end()) return it->second;
+    const Node n = nodes[a];
+    int64_t res = mk(n.var, negate(n.hi), negate(n.lo));
+    negate_cache[a] = res;
+    negate_cache[res] = a;
+    return res;
+  }
+
+  // WMC with skipped-level correction.  Level weights use a suffix scan
+  // with zero-counting so a zero (w_pos + w_neg) cannot poison divisions.
+  struct LevelWeights {
+    std::vector<double> nzprod;  // product of nonzero sums in vars[0..i)
+    std::vector<int> zeros;      // count of zero sums in vars[0..i)
+    double range(int64_t a, int64_t b) const {  // product over vars[a..b)
+      if (zeros[b] - zeros[a] > 0) return 0.0;
+      return nzprod[b] / nzprod[a];
+    }
+  };
+
+  LevelWeights level_weights() const {
+    LevelWeights lw;
+    size_t n = vars.size();
+    lw.nzprod.resize(n + 1);
+    lw.zeros.resize(n + 1);
+    lw.nzprod[0] = 1.0;
+    lw.zeros[0] = 0;
+    for (size_t i = 0; i < n; i++) {
+      double s = vars[i].w_pos + vars[i].w_neg;
+      lw.zeros[i + 1] = lw.zeros[i] + (s == 0.0 ? 1 : 0);
+      lw.nzprod[i + 1] = lw.nzprod[i] * (s == 0.0 ? 1.0 : s);
+    }
+    return lw;
+  }
+
+  double wmc_with(const LevelWeights &lw, int64_t root,
+                  std::unordered_map<int64_t, double> &memo) const {
+    int64_t n_vars = (int64_t)vars.size();
+    // iterative post-order to avoid deep recursion on long chains
+    struct Frame {
+      int64_t node;
+      int state;
+    };
+    std::vector<Frame> stack{{root, 0}};
+    while (!stack.empty()) {
+      Frame &f = stack.back();
+      int64_t node = f.node;
+      if (node == TRUE_ID || node == FALSE_ID || memo.count(node)) {
+        stack.pop_back();
+        continue;
+      }
+      const Node &n = nodes[node];
+      if (f.state == 0) {
+        f.state = 1;
+        stack.push_back({n.hi, 0});
+        stack.push_back({n.lo, 0});
+        continue;
+      }
+      stack.pop_back();
+      auto value_level = [&](int64_t child) -> std::pair<double, int64_t> {
+        if (child == TRUE_ID) return {1.0, n_vars};
+        if (child == FALSE_ID) return {0.0, n_vars};
+        return {memo.at(child), nodes[child].var};
+      };
+      auto [whi, lhi] = value_level(n.hi);
+      auto [wlo, llo] = value_level(n.lo);
+      const VarInfo &vi = vars[n.var];
+      memo[node] = vi.w_pos * whi * lw.range(n.var + 1, lhi) +
+                   vi.w_neg * wlo * lw.range(n.var + 1, llo);
+    }
+    if (root == TRUE_ID) return lw.range(0, n_vars);
+    if (root == FALSE_ID) return 0.0;
+    return memo.at(root) * lw.range(0, nodes[root].var);
+  }
+
+  double wmc(int64_t root) const {
+    LevelWeights lw = level_weights();
+    std::unordered_map<int64_t, double> memo;
+    return wmc_with(lw, root, memo);
+  }
+};
+
+// ─────────────────────── N-Triples bulk tokenizer ────────────────────────
+
+struct NtSession {
+  std::vector<uint32_t> ids;  // n_triples * 3, 1-based term indices
+  std::vector<std::string> terms;
+  std::unordered_map<std::string, uint32_t> term_map;
+  int64_t term_bytes = 0;
+
+  uint32_t intern(std::string &&s) {
+    auto it = term_map.find(s);
+    if (it != term_map.end()) return it->second;
+    uint32_t id = (uint32_t)terms.size() + 1;
+    term_bytes += (int64_t)s.size();
+    term_map.emplace(s, id);
+    terms.push_back(std::move(s));
+    return id;
+  }
+};
+
+// Append one unescaped char sequence (\t \n \r \" \' \\ \b \f \uXXXX
+// \UXXXXXXXX — matching kolibrie_tpu/query/rdf_parsers._unescape).
+bool append_unescaped(const char *s, int64_t len, std::string &out) {
+  auto utf8_append = [&](uint32_t cp) {
+    if (cp < 0x80) {
+      out.push_back((char)cp);
+    } else if (cp < 0x800) {
+      out.push_back((char)(0xC0 | (cp >> 6)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back((char)(0xE0 | (cp >> 12)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back((char)(0xF0 | (cp >> 18)));
+      out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  };
+  auto hexval = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  for (int64_t i = 0; i < len; i++) {
+    char c = s[i];
+    if (c != '\\' || i + 1 >= len) {
+      out.push_back(c);
+      continue;
+    }
+    char nxt = s[i + 1];
+    switch (nxt) {
+      case 't': out.push_back('\t'); i++; continue;
+      case 'n': out.push_back('\n'); i++; continue;
+      case 'r': out.push_back('\r'); i++; continue;
+      case '"': out.push_back('"'); i++; continue;
+      case '\'': out.push_back('\''); i++; continue;
+      case '\\': out.push_back('\\'); i++; continue;
+      case 'b': out.push_back('\b'); i++; continue;
+      case 'f': out.push_back('\f'); i++; continue;
+      case 'u':
+      case 'U': {
+        int ndig = nxt == 'u' ? 4 : 8;
+        if (i + 2 + ndig <= len) {
+          uint32_t cp = 0;
+          bool ok = true;
+          for (int d = 0; d < ndig; d++) {
+            int hv = hexval(s[i + 2 + d]);
+            if (hv < 0) { ok = false; break; }
+            cp = cp * 16 + (uint32_t)hv;
+          }
+          if (ok) {
+            utf8_append(cp);
+            i += 1 + ndig;
+            continue;
+          }
+        }
+        out.push_back(c);
+        continue;
+      }
+      default: out.push_back(c); continue;
+    }
+  }
+  return true;
+}
+
+// Parser over raw bytes.  Returns 0 on success, -1 on syntax error, -2 on a
+// construct the fast path does not support (caller falls back to Python).
+int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
+  int64_t i = 0;
+  int term_in_line = 0;
+  uint32_t line_ids[3];
+  while (i < len) {
+    char c = data[i];
+    if (c == ' ' || c == '\t' || c == '\r' || c == '\n') { i++; continue; }
+    if (c == '#') {  // comment to end of line
+      while (i < len && data[i] != '\n') i++;
+      continue;
+    }
+    if (c == '.') {
+      if (term_in_line != 3) return -1;
+      out.ids.insert(out.ids.end(), line_ids, line_ids + 3);
+      term_in_line = 0;
+      i++;
+      continue;
+    }
+    if (term_in_line == 3) return -1;  // missing '.'
+    std::string term;
+    if (c == '<') {
+      if (i + 1 < len && data[i + 1] == '<') return -2;  // RDF-star: fallback
+      int64_t j = i + 1;
+      while (j < len && data[j] != '>') {
+        if (data[j] == '\n') return -1;
+        j++;
+      }
+      if (j >= len) return -1;
+      term.assign(data + i + 1, (size_t)(j - i - 1));
+      i = j + 1;
+    } else if (c == '_') {
+      if (i + 1 >= len || data[i + 1] != ':') return -1;
+      int64_t j = i + 2;
+      while (j < len && (isalnum((unsigned char)data[j]) || data[j] == '_' ||
+                         data[j] == '-' || data[j] == '.')) {
+        j++;
+      }
+      // a trailing '.' belongs to the statement, not the label
+      while (j > i + 2 && data[j - 1] == '.') j--;
+      term.assign(data + i, (size_t)(j - i));
+      i = j;
+    } else if (c == '"') {
+      int64_t j = i + 1;
+      while (j < len) {
+        if (data[j] == '\\') { j += 2; continue; }
+        if (data[j] == '"') break;
+        j++;
+      }
+      if (j >= len) return -1;
+      term.push_back('"');
+      if (!append_unescaped(data + i + 1, j - i - 1, term)) return -1;
+      term.push_back('"');
+      i = j + 1;
+      if (i + 1 < len && data[i] == '^' && data[i + 1] == '^') {
+        i += 2;
+        if (i >= len || data[i] != '<') return -2;  // prefixed datatype
+        int64_t k = i + 1;
+        while (k < len && data[k] != '>') k++;
+        if (k >= len) return -1;
+        term.append("^^");
+        term.append(data + i + 1, (size_t)(k - i - 1));
+        i = k + 1;
+      } else if (i < len && data[i] == '@') {
+        int64_t k = i + 1;
+        while (k < len && (isalnum((unsigned char)data[k]) || data[k] == '-')) {
+          k++;
+        }
+        term.append(data + i, (size_t)(k - i));
+        i = k;
+      }
+    } else {
+      return -2;  // prefixed name / directive / number: Turtle, not N-Triples
+    }
+    line_ids[term_in_line++] = out.intern(std::move(term));
+  }
+  if (term_in_line != 0) return -1;  // unterminated statement
+  return 0;
+}
+
+}  // namespace
+
+// ────────────────────────────── C ABI ────────────────────────────────────
+
+extern "C" {
+
+// SDD
+void *kn_sdd_new() { return new SddManager(); }
+void kn_sdd_free(void *h) { delete (SddManager *)h; }
+
+int64_t kn_sdd_new_var(void *h, double w_pos, double w_neg, int kind) {
+  auto *m = (SddManager *)h;
+  m->vars.push_back({w_pos, w_neg, kind});
+  return (int64_t)m->vars.size() - 1;
+}
+
+void kn_sdd_set_weight(void *h, int64_t var, double w_pos, double w_neg) {
+  auto *m = (SddManager *)h;
+  m->vars[(size_t)var].w_pos = w_pos;
+  m->vars[(size_t)var].w_neg = w_neg;
+}
+
+int64_t kn_sdd_literal(void *h, int64_t var, int positive) {
+  auto *m = (SddManager *)h;
+  return positive ? m->mk(var, TRUE_ID, FALSE_ID) : m->mk(var, FALSE_ID, TRUE_ID);
+}
+
+int64_t kn_sdd_apply(void *h, int64_t a, int64_t b, int op) {
+  return ((SddManager *)h)->apply(a, b, op);
+}
+
+int64_t kn_sdd_negate(void *h, int64_t a) { return ((SddManager *)h)->negate(a); }
+
+int64_t kn_sdd_exactly_one(void *h, const int64_t *vars, int64_t n) {
+  auto *m = (SddManager *)h;
+  int64_t result = FALSE_ID;
+  for (int64_t ci = 0; ci < n; ci++) {
+    int64_t term = TRUE_ID;
+    for (int64_t vi = 0; vi < n; vi++) {
+      term = m->apply(term, kn_sdd_literal(h, vars[vi], vars[vi] == vars[ci]), 0);
+    }
+    result = m->apply(result, term, 1);
+  }
+  return result;
+}
+
+double kn_sdd_wmc(void *h, int64_t nid) { return ((SddManager *)h)->wmc(nid); }
+
+// ∂WMC/∂p per variable by weight substitution (diff_sdd.rs:15-46 semantics).
+void kn_sdd_wmc_gradient(void *h, int64_t nid, const int64_t *vars, int64_t n,
+                         double *out) {
+  auto *m = (SddManager *)h;
+  for (int64_t i = 0; i < n; i++) {
+    size_t v = (size_t)vars[i];
+    VarInfo saved = m->vars[v];
+    m->vars[v] = {1.0, 0.0, saved.kind};
+    double a = m->wmc(nid);
+    m->vars[v] = {0.0, 1.0, saved.kind};
+    double b = m->wmc(nid);
+    m->vars[v] = saved;
+    out[i] = saved.kind == 0 ? a - b : a;
+  }
+}
+
+int64_t kn_sdd_size(void *h, int64_t nid) {
+  auto *m = (SddManager *)h;
+  if (nid == TRUE_ID || nid == FALSE_ID) return 0;
+  std::vector<int64_t> stack{nid};
+  std::unordered_map<int64_t, bool> seen;
+  while (!stack.empty()) {
+    int64_t n = stack.back();
+    stack.pop_back();
+    if (n == TRUE_ID || n == FALSE_ID || seen.count(n)) continue;
+    seen[n] = true;
+    stack.push_back(m->nodes[(size_t)n].hi);
+    stack.push_back(m->nodes[(size_t)n].lo);
+  }
+  return (int64_t)seen.size();
+}
+
+int64_t kn_sdd_node_count(void *h) {
+  return (int64_t)((SddManager *)h)->nodes.size();
+}
+
+// Model enumeration: paths to TRUE, DFS hi-before-lo (sdd.rs:661 semantics).
+// Flattened output: per assignment pair (var, value); out_offsets has
+// n_models+1 entries.  Returns the model count (≤ limit), or -1 if the
+// flattened pairs exceed pair_cap (caller retries with a larger buffer).
+int64_t kn_sdd_enumerate_models(void *h, int64_t nid, int64_t limit,
+                                int64_t *out_vars, int8_t *out_vals,
+                                int64_t pair_cap, int64_t *out_offsets) {
+  auto *m = (SddManager *)h;
+  int64_t n_models = 0, n_pairs = 0;
+  std::vector<std::pair<int64_t, bool>> assignment;
+  // explicit DFS: frame = (node, branch_state)
+  struct Frame {
+    int64_t node;
+    int state;  // 0 = enter, 1 = after hi, 2 = after lo
+  };
+  std::vector<Frame> stack{{nid, 0}};
+  out_offsets[0] = 0;
+  while (!stack.empty() && n_models < limit) {
+    Frame &f = stack.back();
+    if (f.node == FALSE_ID) {
+      stack.pop_back();
+      continue;
+    }
+    if (f.node == TRUE_ID) {
+      if (n_pairs + (int64_t)assignment.size() > pair_cap) return -1;
+      for (auto &[v, val] : assignment) {
+        out_vars[n_pairs] = v;
+        out_vals[n_pairs] = val ? 1 : 0;
+        n_pairs++;
+      }
+      out_offsets[++n_models] = n_pairs;
+      stack.pop_back();
+      continue;
+    }
+    const Node &n = m->nodes[(size_t)f.node];
+    if (f.state == 0) {
+      f.state = 1;
+      assignment.emplace_back(n.var, true);
+      stack.push_back({n.hi, 0});
+    } else if (f.state == 1) {
+      f.state = 2;
+      assignment.back() = {n.var, false};
+      stack.push_back({n.lo, 0});
+    } else {
+      assignment.pop_back();
+      stack.pop_back();
+    }
+  }
+  return n_models;
+}
+
+// N-Triples bulk parse
+int64_t kn_nt_parse(const char *data, int64_t len, void **out_session) {
+  auto *s = new NtSession();
+  int rc = nt_parse_impl(data, len, *s);
+  if (rc != 0) {
+    delete s;
+    *out_session = nullptr;
+    return rc;
+  }
+  *out_session = s;
+  return (int64_t)(s->ids.size() / 3);
+}
+
+int64_t kn_nt_nterms(void *session) {
+  return (int64_t)((NtSession *)session)->terms.size();
+}
+
+int64_t kn_nt_term_bytes(void *session) {
+  return ((NtSession *)session)->term_bytes;
+}
+
+void kn_nt_ids(void *session, uint32_t *out) {
+  auto *s = (NtSession *)session;
+  std::memcpy(out, s->ids.data(), s->ids.size() * sizeof(uint32_t));
+}
+
+void kn_nt_terms(void *session, char *out, int64_t *offsets) {
+  auto *s = (NtSession *)session;
+  int64_t pos = 0;
+  int64_t i = 0;
+  for (auto &t : s->terms) {
+    offsets[i++] = pos;
+    std::memcpy(out + pos, t.data(), t.size());
+    pos += (int64_t)t.size();
+  }
+  offsets[i] = pos;
+}
+
+void kn_nt_free(void *session) { delete (NtSession *)session; }
+
+}  // extern "C"

@@ -1,0 +1,214 @@
+"""Native C++ runtime agreement tests: the native SDD engine and N-Triples
+bulk parser must agree exactly with their pure-Python twins.
+
+The native library is built on demand (native/Makefile) by the loader; if
+the toolchain is unavailable these tests are skipped, and the package keeps
+running pure-Python.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu import native as native_loader
+from kolibrie_tpu.reasoner.diff_sdd import wmc_gradient
+from kolibrie_tpu.reasoner.sdd import FALSE, TRUE, SddManager, make_sdd_manager
+
+pytestmark = pytest.mark.skipif(
+    not native_loader.available(), reason="native library unavailable"
+)
+
+
+def make_native():
+    from kolibrie_tpu.native.sdd_native import NativeSddManager
+
+    return NativeSddManager()
+
+
+def random_formula(mgr, n_vars, rng, n_ops=40):
+    """Build the same random formula against any manager; returns node id."""
+    vars_ = [mgr.new_var(w_pos=rng.uniform(0.1, 0.9)) for _ in range(n_vars)]
+    pool = [mgr.literal(v, rng.random() < 0.5) for v in vars_]
+    for _ in range(n_ops):
+        a, b = rng.choice(pool), rng.choice(pool)
+        op = rng.choice(["and", "or"])
+        node = mgr.apply(a, b, op)
+        if rng.random() < 0.3:
+            node = mgr.negate(node)
+        pool.append(node)
+    return pool[-1]
+
+
+def test_factory_returns_native():
+    mgr = make_sdd_manager()
+    assert type(mgr).__name__ == "NativeSddManager"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_sdd_agreement_random_formulas(seed):
+    rng_a, rng_b = random.Random(seed), random.Random(seed)
+    py, nat = SddManager(), make_native()
+    node_py = random_formula(py, 6, rng_a)
+    node_nat = random_formula(nat, 6, rng_b)
+    # identical construction order => identical arena => identical node ids
+    assert node_py == node_nat
+    assert py.wmc(node_py) == pytest.approx(nat.wmc(node_nat), abs=1e-12)
+    assert py.size(node_py) == nat.size(node_nat)
+
+
+def test_terminals_and_literals():
+    nat = make_native()
+    v = nat.new_var(0.3)
+    lit = nat.literal(v)
+    assert nat.apply(lit, FALSE, "and") == FALSE
+    assert nat.apply(lit, TRUE, "and") == lit
+    assert nat.apply(lit, TRUE, "or") == TRUE
+    assert nat.negate(nat.negate(lit)) == lit
+    assert nat.wmc(lit) == pytest.approx(0.3)
+    assert nat.wmc(nat.negate(lit)) == pytest.approx(0.7)
+
+
+def test_conjoin_disjoin_wmc():
+    nat = make_native()
+    a, b = nat.new_var(0.5), nat.new_var(0.4)
+    la, lb = nat.literal(a), nat.literal(b)
+    assert nat.wmc(nat.conjoin(la, lb)) == pytest.approx(0.2)
+    assert nat.wmc(nat.disjoin(la, lb)) == pytest.approx(0.5 + 0.4 - 0.2)
+
+
+def test_exactly_one_semantics():
+    py, nat = SddManager(), make_native()
+    for mgr in (py, nat):
+        vs = [mgr.new_var(p, kind="exclusive", group_id=1) for p in (0.2, 0.3, 0.5)]
+        node = mgr.exactly_one(vs)
+        # WMC of the constraint over exclusive weights (w_neg=1):
+        # sum_i p_i * prod_{j!=i} 1 = 1.0
+        assert mgr.wmc(node) == pytest.approx(1.0)
+    # same arena state
+    assert py.wmc(py.literal(0)) == pytest.approx(nat.wmc(nat.literal(0)))
+
+
+def test_set_weight_updates_wmc():
+    nat = make_native()
+    v = nat.new_var(0.5)
+    lit = nat.literal(v)
+    nat.set_weight(v, 0.9)
+    assert nat.wmc(lit) == pytest.approx(0.9)
+    assert nat.vars[v].w_neg == pytest.approx(0.1)
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_gradient_agreement_and_finite_differences(seed):
+    rng_a, rng_b = random.Random(seed), random.Random(seed)
+    py, nat = SddManager(), make_native()
+    node_py = random_formula(py, 5, rng_a, n_ops=25)
+    node_nat = random_formula(nat, 5, rng_b, n_ops=25)
+    g_py = wmc_gradient(py, node_py)
+    g_nat = wmc_gradient(nat, node_nat)
+    assert set(g_py) == set(g_nat)
+    for v in g_py:
+        assert g_py[v] == pytest.approx(g_nat[v], abs=1e-12)
+    # finite differences on the native engine
+    eps = 1e-6
+    for v in range(5):
+        p0 = nat.vars[v].w_pos
+        nat.set_weight(v, p0 + eps)
+        up = nat.wmc(node_nat)
+        nat.set_weight(v, p0 - eps)
+        dn = nat.wmc(node_nat)
+        nat.set_weight(v, p0)
+        assert g_nat[v] == pytest.approx((up - dn) / (2 * eps), abs=1e-5)
+
+
+def test_enumerate_models_agreement():
+    rng_a, rng_b = random.Random(3), random.Random(3)
+    py, nat = SddManager(), make_native()
+    node_py = random_formula(py, 5, rng_a, n_ops=20)
+    node_nat = random_formula(nat, 5, rng_b, n_ops=20)
+    assert py.enumerate_models(node_py) == nat.enumerate_models(node_nat)
+
+
+def test_enumerate_models_respects_limit():
+    nat = make_native()
+    vs = [nat.new_var(0.5) for _ in range(8)]
+    node = FALSE
+    for v in vs:
+        node = nat.disjoin(node, nat.literal(v))
+    assert len(nat.enumerate_models(node, limit=3)) == 3
+
+
+# ------------------------------------------------------------- N-Triples
+
+
+NT_DOC = """
+# a comment line
+<http://e/a> <http://e/p> <http://e/b> .
+<http://e/a> <http://e/name> "Alice \\"quoted\\" \\u00e9" .
+_:b1 <http://e/p> "30"^^<http://www.w3.org/2001/XMLSchema#integer> .
+<http://e/a> <http://e/label> "bonjour"@fr .
+<http://e/a> <http://e/p> <http://e/b> .
+"""
+
+
+def test_nt_bulk_parse_agreement():
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+    from kolibrie_tpu.query.rdf_parsers import parse_ntriples
+
+    result = bulk_parse_ntriples(NT_DOC)
+    assert result is not None
+    ids, terms = result
+    native_triples = [
+        (terms[ids[i, 0] - 1], terms[ids[i, 1] - 1], terms[ids[i, 2] - 1])
+        for i in range(ids.shape[0])
+    ]
+    assert native_triples == parse_ntriples(NT_DOC)
+
+
+def test_nt_bulk_parse_falls_back_on_rdf_star():
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+
+    assert (
+        bulk_parse_ntriples("<< <http://a> <http://p> <http://o> >> <http://q> <http://r> .")
+        is None
+    )
+
+
+def test_nt_lone_surrogate_escape_matches_python():
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+    from kolibrie_tpu.query.rdf_parsers import parse_ntriples
+
+    doc = '<http://a> <http://b> "\\uD800" .'
+    result = bulk_parse_ntriples(doc)
+    assert result is not None
+    ids, terms = result
+    native = (terms[ids[0, 0] - 1], terms[ids[0, 1] - 1], terms[ids[0, 2] - 1])
+    assert native == parse_ntriples(doc)[0]
+
+
+def test_nt_bulk_parse_falls_back_on_turtle():
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+
+    assert bulk_parse_ntriples("@prefix ex: <http://e/> . ex:a ex:p ex:b .") is None
+
+
+def test_sparql_database_native_load_equivalence():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    db_native = SparqlDatabase()
+    assert db_native._parse_ntriples_native(NT_DOC) == 5
+
+    db_py = SparqlDatabase()
+    from kolibrie_tpu.query import rdf_parsers
+
+    db_py._ingest(rdf_parsers.parse_ntriples(NT_DOC))
+
+    assert sorted(db_native.iter_decoded()) == sorted(db_py.iter_decoded())
+
+
+def test_sparql_database_parse_ntriples_empty_and_comment_only():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    db = SparqlDatabase()
+    assert db.parse_ntriples("# only a comment\n") == 0
+    assert len(db) == 0
