@@ -7,5 +7,17 @@ diff_sdd,tag_store,seed_spec}.rs``.
 """
 
 from kolibrie_tpu.reasoner.reasoner import Reasoner
+from kolibrie_tpu.reasoner.hierarchy import (
+    HierarchicalRule,
+    ReasoningHierarchy,
+    ReasoningLevel,
+)
+from kolibrie_tpu.reasoner.to_dot import to_dot
 
-__all__ = ["Reasoner"]
+__all__ = [
+    "Reasoner",
+    "ReasoningHierarchy",
+    "ReasoningLevel",
+    "HierarchicalRule",
+    "to_dot",
+]

@@ -26,7 +26,7 @@ class Reasoner:
     """Knowledge graph with forward/backward inference."""
 
     def __init__(self, dictionary: Optional[Dictionary] = None) -> None:
-        self.dictionary = dictionary or Dictionary()
+        self.dictionary = dictionary if dictionary is not None else Dictionary()
         self.quoted = QuotedTripleStore()
         self.facts = ColumnarTripleStore()
         self.rules: List[Rule] = []

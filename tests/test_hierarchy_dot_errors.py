@@ -1,0 +1,219 @@
+"""Tests for hierarchical reasoning, DOT export, and the parse-error
+formatter.
+
+Parity: datalog/src/reasoning_experimental.rs, datalog/src/reasoning/to_dot.rs,
+kolibrie/src/error_handler.rs.
+"""
+
+import pytest
+
+from kolibrie_tpu.query.error_handler import (
+    detect_specific_sparql_error,
+    format_parse_error,
+)
+from kolibrie_tpu.query.parser import SparqlParseError, parse_sparql_query
+from kolibrie_tpu.reasoner import (
+    HierarchicalRule,
+    Reasoner,
+    ReasoningHierarchy,
+    ReasoningLevel,
+    to_dot,
+)
+from kolibrie_tpu.core.triple import Triple
+
+
+class TestReasoningHierarchy:
+    def _hierarchy(self):
+        h = ReasoningHierarchy()
+        h.add_fact_at_level(ReasoningLevel.BASE, ":alice", ":parentOf", ":bob")
+        h.add_fact_at_level(ReasoningLevel.BASE, ":bob", ":parentOf", ":carol")
+        return h
+
+    def test_in_level_inference(self):
+        h = self._hierarchy()
+        kg = h.levels[ReasoningLevel.BASE]
+        rule = kg.rule_from_strings(
+            [("?x", ":parentOf", "?y"), ("?y", ":parentOf", "?z")],
+            [("?x", ":grandparentOf", "?z")],
+        )
+        h.add_rule_at_level(ReasoningLevel.BASE, rule)
+        inferred = h.hierarchical_inference()
+        decoded = {
+            kg.decode_triple(t) for t in inferred[ReasoningLevel.BASE]
+        }
+        assert (":alice", ":grandparentOf", ":carol") in decoded
+
+    def test_cross_level_rule_pulls_base_facts(self):
+        # A Deductive-level rule sees Base facts through its dependencies.
+        h = self._hierarchy()
+        kg = h.levels[ReasoningLevel.DEDUCTIVE]
+        rule = kg.rule_from_strings(
+            [("?x", ":parentOf", "?y")], [("?x", ":ancestorOf", "?y")]
+        )
+        h.add_rule_at_level(ReasoningLevel.DEDUCTIVE, rule)
+        inferred = h.hierarchical_inference()
+        decoded = {
+            kg.decode_triple(t) for t in inferred[ReasoningLevel.DEDUCTIVE]
+        }
+        assert (":alice", ":ancestorOf", ":bob") in decoded
+        assert (":bob", ":ancestorOf", ":carol") in decoded
+        # Derived facts land at the Deductive level, not Base.
+        assert h.levels[ReasoningLevel.BASE].query_abox(None, ":ancestorOf", None) == []
+        assert len(h.levels[ReasoningLevel.DEDUCTIVE].query_abox(None, ":ancestorOf", None)) == 2
+
+    def test_certainty_by_level(self):
+        h = self._hierarchy()
+        kg = h.levels[ReasoningLevel.DEDUCTIVE]
+        rule = kg.rule_from_strings(
+            [("?x", ":parentOf", "?y")], [("?x", ":ancestorOf", "?y")]
+        )
+        h.add_rule_at_level(ReasoningLevel.DEDUCTIVE, rule)
+        h.hierarchical_inference()
+        base_fact = h.levels[ReasoningLevel.BASE].query_abox(":alice", ":parentOf", None)[0]
+        derived = h.levels[ReasoningLevel.DEDUCTIVE].query_abox(":alice", ":ancestorOf", None)[0]
+        assert h.get_fact_certainty(base_fact) == 1.0
+        assert h.get_fact_certainty(derived) == 0.9
+        assert h.get_fact_certainty(Triple(999999, 999999, 999999)) == 0.0
+
+    def test_query_hierarchy_all_levels(self):
+        h = self._hierarchy()
+        h.add_fact_at_level(
+            ReasoningLevel.ABDUCTIVE, ":hyp", ":explains", ":obs"
+        )
+        results = h.query_hierarchy()
+        levels = {lv for lv, _ in results}
+        assert ReasoningLevel.BASE in levels
+        assert ReasoningLevel.ABDUCTIVE in levels
+        only_abd = h.query_hierarchy(ReasoningLevel.ABDUCTIVE)
+        assert len(only_abd) == 1 and only_abd[0][0] == ReasoningLevel.ABDUCTIVE
+
+    def test_cross_level_rule_honors_naf(self):
+        h = self._hierarchy()
+        h.add_fact_at_level(ReasoningLevel.BASE, ":alice", ":excluded", ":bob")
+        kg = h.levels[ReasoningLevel.DEDUCTIVE]
+        rule = kg.rule_from_strings(
+            [("?x", ":parentOf", "?y")],
+            [("?x", ":candidate", "?y")],
+            negative=[("?x", ":excluded", "?y")],
+        )
+        h.add_rule_at_level(ReasoningLevel.DEDUCTIVE, rule)
+        h.hierarchical_inference()
+        decoded = {
+            kg.decode_triple(t)
+            for t in kg.query_abox(None, ":candidate", None)
+        }
+        assert (":bob", ":candidate", ":carol") in decoded
+        assert (":alice", ":candidate", ":bob") not in decoded
+
+    def test_unsupported_premise_count_warns(self):
+        import warnings as _w
+
+        h = self._hierarchy()
+        kg = h.levels[ReasoningLevel.BASE]
+        rule = kg.rule_from_strings(
+            [
+                ("?x", ":parentOf", "?y"),
+                ("?y", ":parentOf", "?z"),
+                ("?z", ":parentOf", "?w"),
+            ],
+            [("?x", ":greatGrandparentOf", "?w")],
+        )
+        h.add_cross_level_rule(
+            HierarchicalRule(rule, ReasoningLevel.BASE, 0, [ReasoningLevel.BASE])
+        )
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            h.hierarchical_inference()
+        assert any("premise" in str(w.message) for w in caught)
+
+    def test_two_premise_cross_level_rule(self):
+        h = self._hierarchy()
+        kg = h.levels[ReasoningLevel.META_REASONING]
+        rule = kg.rule_from_strings(
+            [("?x", ":parentOf", "?y"), ("?y", ":parentOf", "?z")],
+            [("?x", ":grandparentOf", "?z")],
+        )
+        h.add_cross_level_rule(
+            HierarchicalRule(
+                rule,
+                ReasoningLevel.META_REASONING,
+                priority=5,
+                dependencies=[ReasoningLevel.BASE],
+            )
+        )
+        inferred = h.hierarchical_inference()
+        decoded = {
+            kg.decode_triple(t)
+            for t in inferred[ReasoningLevel.META_REASONING]
+        }
+        assert (":alice", ":grandparentOf", ":carol") in decoded
+
+
+class TestToDot:
+    def test_nodes_edges_rules(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":knows", ":b")
+        rule = r.rule_from_strings(
+            [("?x", ":knows", "?y")], [("?y", ":knownBy", "?x")]
+        )
+        r.add_rule(rule)
+        dot = to_dot(r)
+        assert dot.startswith("digraph {")
+        assert dot.endswith("}")
+        assert '[label=":a"]' in dot
+        assert '[label=":b"]' in dot
+        assert '[label=":knows"]' in dot  # edge label
+        assert "Rule0_premise" in dot and "Rule0_conclusion" in dot
+        assert "(x, :knows, y)" in dot
+        assert "Rule0_premise -> Rule0_conclusion" in dot
+
+    def test_empty_reasoner(self):
+        assert to_dot(Reasoner()) == "digraph {\n\n}"
+
+    def test_literal_labels_escaped(self):
+        r = Reasoner()
+        r.add_abox_triple(":a", ":age", '"25"')
+        dot = to_dot(r)
+        assert '[label="\\"25\\""]' in dot
+
+
+class TestErrorFormatter:
+    def test_position_and_caret(self):
+        src = "SELECT ?x WHERE { ?x ?p ?o"
+        try:
+            parse_sparql_query(src)
+            pytest.fail("expected parse error")
+        except SparqlParseError as e:
+            msg = format_parse_error(src, e)
+        assert "error:" in msg
+        assert "query:" in msg
+        assert "^" in msg
+
+    def test_unbalanced_brace_hint(self):
+        src = "SELECT ?x WHERE { ?x ?p ?o"
+        hit = detect_specific_sparql_error(src, len(src))
+        assert hit is not None
+        assert "Unclosed brace" in hit[0]
+
+    def test_select_without_where(self):
+        src = "SELECT ?x"
+        hit = detect_specific_sparql_error(src, len(src))
+        assert hit is not None and "missing WHERE" in hit[0]
+
+    def test_undefined_prefix(self):
+        src = "SELECT ?x WHERE { ?x unknownpfx:name ?o . }"
+        hit = detect_specific_sparql_error(
+            src, src.index("unknownpfx") + len("unknownpfx:name")
+        )
+        assert hit is not None and "Undefined prefix 'unknownpfx'" in hit[0]
+
+    def test_unterminated_string(self):
+        src = 'SELECT ?x WHERE { ?x ?p "open . }'
+        hit = detect_specific_sparql_error(src, len(src))
+        assert hit is not None and "Unterminated string" in hit[0]
+
+    def test_formatter_renders_hint_footer(self):
+        src = "SELECT ?x WHERE { ?x ?p ?o"
+        err = SparqlParseError("unexpected end of input", line=1, col=len(src))
+        msg = format_parse_error(src, err)
+        assert "help:" in msg
