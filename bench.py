@@ -105,7 +105,6 @@ def device_bench(ls, lo_, rs, ro_):
     args = tuple(jnp.asarray(a) for a in (ls, lo_, rs, ro_))
     out = merge_join_k(*args, JOIN_CAP, SCAN_K)
     jax.block_until_ready(out)  # compile + warm
-    n_results = int(out[3][0])
     times = []
     for _ in range(N_DISPATCH):
         t0 = time.perf_counter()
@@ -113,6 +112,11 @@ def device_bench(ls, lo_, rs, ro_):
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
         time.sleep(DISPATCH_GAP_S)
+    # Result readback AFTER all timing: through the axon tunnel, a single
+    # host read of any output element degrades every subsequent dispatch of
+    # the same executable from ~0.1ms to a stable ~380ms (measured), so the
+    # correctness check must not precede the measurement loop.
+    n_results = int(out[3][0])
     per_join = min(times) / SCAN_K
     return per_join, n_results, str(jax.devices()[0].platform)
 
