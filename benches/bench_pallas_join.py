@@ -1,0 +1,104 @@
+"""Pallas merge-join kernel vs XLA searchsorted join, employee-100K shape.
+
+Mirrors the headline bench workload (``bench.py``); compares the Mosaic
+kernel path (:func:`kolibrie_tpu.ops.pallas_kernels.merge_join`) against the
+pure-XLA formulation on the same PSO-sorted predicate slices.
+
+Prints one JSON line per variant.  Timing discipline as in bench.py: all
+host readback happens after the measurement loops (through the axon tunnel
+a single element read degrades subsequent dispatches of an executable by
+~3000x).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from bench import (  # noqa: E402
+    JOIN_CAP,
+    N_TRIPLES,
+    pso_slices,
+    synth_employee_columns,
+)
+
+N_DISPATCH = 20
+GAP_S = 0.1
+
+
+def time_fn(fn, *args):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(N_DISPATCH):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+        time.sleep(GAP_S)
+    return min(times), out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from kolibrie_tpu.ops.pallas_kernels import merge_join
+
+    s, p, o = synth_employee_columns()
+    (ls, lo_), (rs, ro_) = pso_slices(s, p, o)
+    args = tuple(jnp.asarray(a.astype(np.int32)) for a in (ls, lo_, rs, ro_))
+
+    pallas_fn = partial(merge_join, cap=JOIN_CAP)
+    t_pallas, out_p = time_fn(lambda *a: pallas_fn(*a), *args)
+
+    @partial(jax.jit, static_argnames="cap")
+    def xla_join(lk, lv, rk, rv, cap):
+        low = jnp.searchsorted(rk, lk, side="left")
+        high = jnp.searchsorted(rk, lk, side="right")
+        counts = (high - low).astype(jnp.int32)
+        cum = jnp.cumsum(counts)
+        total = cum[-1]
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        row = jnp.clip(
+            jnp.searchsorted(cum, idx, side="right"), 0, lk.shape[0] - 1
+        )
+        pos = low[row] + (idx - (cum[row] - counts[row]))
+        valid = idx < total
+        return (
+            jnp.where(valid, lk[row], 0),
+            jnp.where(valid, lv[row], 0),
+            jnp.where(valid, rv[jnp.clip(pos, 0, rk.shape[0] - 1)], 0),
+            valid,
+            total,
+        )
+
+    t_xla, out_x = time_fn(lambda *a: xla_join(*a, JOIN_CAP), *args)
+
+    # Readback + cross-check after ALL timing.
+    n_p = int(np.asarray(out_p[3]).sum())
+    n_x = int(np.asarray(out_x[3]).sum())
+    assert n_p == n_x, (n_p, n_x)
+    platform = jax.devices()[0].platform
+    for name, t in (("pallas_merge_join", t_pallas), ("xla_merge_join", t_xla)):
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name}_employee100k_triples_per_sec_{platform}",
+                    "value": round(N_TRIPLES / t, 1),
+                    "unit": "triples/sec/chip",
+                    "vs_baseline": round(t_xla / t, 3),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

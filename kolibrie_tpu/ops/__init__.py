@@ -13,3 +13,13 @@ from kolibrie_tpu.ops.join import equi_join_tables, multi_key_pack
 from kolibrie_tpu.ops.unique import unique_rows
 
 __all__ = ["equi_join_tables", "multi_key_pack", "unique_rows"]
+
+
+def __getattr__(name):
+    # Pallas kernels import jax.experimental.pallas; load lazily so the
+    # numpy-only host paths stay importable in minimal environments.
+    if name in ("merge_join", "filter_mask", "tag_combine"):
+        from kolibrie_tpu.ops import pallas_kernels
+
+        return getattr(pallas_kernels, name)
+    raise AttributeError(name)

@@ -1,0 +1,189 @@
+"""Pallas kernel tests (run on the CPU interpreter via conftest's platform
+override; the same code Mosaic-compiles on TPU).
+
+Parity: the kernels replace the reference's hot loops —
+``shared/src/join_algorithm.rs:19-131`` (sorted merge join),
+``kolibrie/src/sparql_database.rs:1497-1785`` (SIMD filters), and the f64
+semiring combines of ``shared/src/provenance.rs``.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from kolibrie_tpu.ops.pallas_kernels import (
+    TILE,
+    filter_mask,
+    merge_join,
+    tag_combine,
+)
+
+
+def ref_join(lk, lv, rk, rv):
+    return sorted(
+        (int(lk[i]), int(lv[i]), int(rv[j]))
+        for i in range(len(lk))
+        for j in range(len(rk))
+        if lk[i] == rk[j]
+    )
+
+
+def run_join(lk, lv, rk, rv, cap):
+    out = merge_join(*map(jnp.asarray, (lk, lv, rk, rv)), cap)
+    key, lval, rval, valid, total = (np.asarray(x) for x in out)
+    got = sorted(
+        zip(key[valid].tolist(), lval[valid].tolist(), rval[valid].tolist())
+    )
+    return got, int(total)
+
+
+class TestMergeJoin:
+    def test_nm_join_with_gaps(self):
+        rng = np.random.default_rng(1)
+        lk = np.sort(rng.integers(0, 60, 40).astype(np.int32))
+        lv = (np.arange(40) + 1000).astype(np.int32)
+        rk = np.sort(rng.integers(0, 60, 50).astype(np.int32))
+        rv = (np.arange(50) + 5000).astype(np.int32)
+        got, total = run_join(lk, lv, rk, rv, 512)
+        exp = ref_join(lk, lv, rk, rv)
+        assert got == exp and total == len(exp)
+
+    def test_large_random_multi_tile(self):
+        # Forces many output tiles and windows crossing tile boundaries.
+        rng = np.random.default_rng(7)
+        lk = np.sort(rng.integers(0, 400, 700).astype(np.int32))
+        lv = rng.integers(0, 1 << 20, 700).astype(np.int32)
+        rk = np.sort(rng.integers(0, 400, 600).astype(np.int32))
+        rv = rng.integers(0, 1 << 20, 600).astype(np.int32)
+        exp = ref_join(lk, lv, rk, rv)
+        got, total = run_join(lk, lv, rk, rv, 8192)
+        assert total == len(exp)
+        assert got == exp
+
+    def test_heavy_fanout_single_key(self):
+        # One key with fanout far beyond a tile: 3 left x 300 right = 900.
+        lk = np.array([5, 5, 5], np.int32)
+        lv = np.array([1, 2, 3], np.int32)
+        rk = np.full(300, 5, np.int32)
+        rv = np.arange(300, dtype=np.int32)
+        got, total = run_join(lk, lv, rk, rv, 1024)
+        assert total == 900
+        assert got == ref_join(lk, lv, rk, rv)
+
+    def test_no_matches(self):
+        lk = np.array([1, 2, 3], np.int32)
+        rk = np.array([10, 20], np.int32)
+        got, total = run_join(lk, lk, rk, rk, TILE)
+        assert total == 0 and got == []
+
+    def test_empty_sides(self):
+        e = np.zeros(0, np.int32)
+        k = np.array([1], np.int32)
+        assert run_join(e, e, k, k, TILE) == ([], 0)
+        assert run_join(k, k, e, e, TILE) == ([], 0)
+
+    def test_overflow_reports_true_total(self):
+        lk = np.full(20, 9, np.int32)
+        rk = np.full(20, 9, np.int32)
+        _, total = run_join(lk, lk, rk, rk, TILE)
+        assert total == 400  # > cap: caller re-runs with larger capacity
+
+    def test_cap_rounds_up_not_down(self):
+        # cap=200 with 150 matches: capacity must not shrink below request.
+        lk = np.arange(150, dtype=np.int32)
+        rk = np.arange(150, dtype=np.int32)
+        got, total = run_join(lk, lk, rk, rk, 200)
+        assert total == 150 and len(got) == 150
+
+    def test_u32_keys_above_2_31(self):
+        # Dictionary IDs can use the full u32 range (bit 31 = quoted
+        # triples); keys must not wrap negative and break sortedness.
+        lk = np.array([10, 2**31 + 5, 2**31 + 9], np.uint32)
+        lv = np.array([1, 2, 3], np.uint32)
+        rk = np.array([2**31 + 5, 2**31 + 9, 2**31 + 9], np.uint32)
+        rv = np.array([7, 8, 9], np.uint32)
+        got, total = run_join(lk, lv, rk, rv, TILE)
+        assert total == 3
+        assert got == ref_join(lk, lv, rk, rv)
+
+    def test_xla_fallback_agrees(self):
+        from kolibrie_tpu.ops.pallas_kernels import _xla_merge_join
+
+        rng = np.random.default_rng(11)
+        lk = np.sort(rng.integers(0, 80, 60).astype(np.uint32))
+        lv = rng.integers(0, 1000, 60).astype(np.uint32)
+        rk = np.sort(rng.integers(0, 80, 70).astype(np.uint32))
+        rv = rng.integers(0, 1000, 70).astype(np.uint32)
+        out = _xla_merge_join(*map(jnp.asarray, (lk, lv, rk, rv)), 1024)
+        key, lval, rval, valid, total = (np.asarray(x) for x in out)
+        got = sorted(
+            zip(key[valid].tolist(), lval[valid].tolist(), rval[valid].tolist())
+        )
+        assert got == ref_join(lk, lv, rk, rv) and total == len(got)
+
+    def test_sparse_matches_zero_count_runs(self):
+        # Long stretches of unmatched left rows between matches: exercises
+        # the counts>0 compaction that keeps tile windows bounded.
+        lk = np.arange(0, 2000, 2, dtype=np.int32)  # evens
+        lv = lk + 1
+        rk = np.array([100, 1000, 1998], np.int32)  # three evens
+        rv = rk + 7
+        got, total = run_join(lk, lv, rk, rv, 256)
+        assert total == 3
+        assert got == ref_join(lk, lv, rk, rv)
+
+
+class TestFilterMask:
+    def test_pattern_and_range(self):
+        rng = np.random.default_rng(3)
+        s = rng.integers(0, 10, 500).astype(np.int32)
+        p = rng.integers(0, 5, 500).astype(np.int32)
+        o = rng.integers(0, 100, 500).astype(np.int32)
+        m = np.asarray(
+            filter_mask(
+                jnp.asarray(s), jnp.asarray(p), jnp.asarray(o),
+                s_const=3, o_op=4, o_cmp=50,
+            )
+        )
+        assert (m == ((s == 3) & (o > 50))).all()
+
+    @pytest.mark.parametrize(
+        "op,fn",
+        [
+            (0, np.equal), (1, np.not_equal), (2, np.less),
+            (3, np.less_equal), (4, np.greater), (5, np.greater_equal),
+        ],
+    )
+    def test_all_comparators(self, op, fn):
+        o = np.arange(40, dtype=np.int32)
+        m = np.asarray(
+            filter_mask(
+                jnp.asarray(o), jnp.asarray(o), jnp.asarray(o),
+                o_op=op, o_cmp=17,
+            )
+        )
+        assert (m == fn(o, 17)).all()
+
+    def test_wildcards_pass_everything(self):
+        o = np.arange(10, dtype=np.int32)
+        m = np.asarray(filter_mask(jnp.asarray(o), jnp.asarray(o), jnp.asarray(o)))
+        assert m.all()
+
+
+class TestTagCombine:
+    def test_ops(self):
+        rng = np.random.default_rng(5)
+        a = rng.random(333).astype(np.float32)
+        b = rng.random(333).astype(np.float32)
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        assert np.allclose(np.asarray(tag_combine(ja, jb, "min")), np.minimum(a, b))
+        assert np.allclose(np.asarray(tag_combine(ja, jb, "max")), np.maximum(a, b))
+        assert np.allclose(np.asarray(tag_combine(ja, jb, "mul")), a * b)
+        assert np.allclose(
+            np.asarray(tag_combine(ja, jb, "noisy_or")), 1 - (1 - a) * (1 - b)
+        )
+
+    def test_unknown_op_raises(self):
+        a = jnp.zeros(4)
+        with pytest.raises(ValueError):
+            tag_combine(a, a, "xor")
