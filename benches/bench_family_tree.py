@@ -1,0 +1,129 @@
+"""Family-tree recursive rules: incremental vs naive SDS+ maintenance.
+
+Mirrors ``kolibrie/benches/family_tree_cross_window_compare.rs``: seven
+rules over two streams (parentOf events; asserted family facts) including a
+RECURSIVE ancestorOf rule, sweeping the new-data ratio.  Recursive closure
+is where delta-driven incremental maintenance pays: naive recomputes the
+whole ancestor chain per cycle, incremental only extends from new facts.
+
+Prints one JSON line per (chain length, new-ratio).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.core.dictionary import Dictionary  # noqa: E402
+from kolibrie_tpu.reasoner.cross_window import (  # noqa: E402
+    Sds,
+    WindowData,
+    WindowedTriple,
+    incremental_sds_plus,
+    naive_sds_plus,
+    sds_with_expiry_to_external,
+)
+from kolibrie_tpu.reasoner.n3_parser import parse_n3_rules_for_sds  # noqa: E402
+
+S1 = "http://stream1/"
+S2 = "http://stream2/"
+OUT = "http://result/"
+CURRENT_TIME = 1000
+ALPHA = 10_000  # wide windows: everything stays alive
+
+FAMILY_RULES = """
+@prefix s1: <http://stream1/> .
+@prefix s2: <http://stream2/> .
+{ ?p s1:parentOf ?c } => { ?p s2:ancestorOf ?c }
+{ ?a s1:parentOf ?b . ?b s2:ancestorOf ?c } => { ?a s2:ancestorOf ?c }
+{ ?gp s1:parentOf ?p . ?p s1:parentOf ?c } => { ?gp s2:grandparentOf ?c }
+"""
+
+
+def make_sds(chain: int, new_ratio_percent: int) -> Sds:
+    """A parentOf chain person_0 -> ... -> person_chain; the newest slice
+    (by event time) is `new_ratio_percent` of the edges."""
+    new_count = chain * new_ratio_percent // 100
+    triples = []
+    for i in range(chain):
+        et = CURRENT_TIME - 1 if i >= chain - new_count else 1 + i % 500
+        triples.append(
+            WindowedTriple(f"person_{i}", "parentOf", f"person_{i+1}", et)
+        )
+    sds = Sds()
+    sds.output_iris.add(OUT)
+    sds.windows[S1] = WindowData(alpha=ALPHA, triples=triples)
+    sds.windows[S2] = WindowData(alpha=ALPHA, triples=[])
+    return sds
+
+
+def run(chains=(20, 60, 120), ratios=(2, 10, 50)):
+    for chain in chains:
+        for ratio in ratios:
+            dictionary = Dictionary()
+            rules, _ = parse_n3_rules_for_sds(
+                FAMILY_RULES, dictionary, [S1, S2]
+            )
+            sds = make_sds(chain, ratio)
+
+            t_naive = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                naive_out = naive_sds_plus(
+                    rules, sds, dictionary, CURRENT_TIME
+                )
+                t_naive = min(t_naive, time.perf_counter() - t0)
+
+            old_sds = Sds()
+            old_sds.output_iris.add(OUT)
+            for iri, wd in sds.windows.items():
+                old_sds.windows[iri] = WindowData(
+                    alpha=wd.alpha,
+                    triples=[
+                        t for t in wd.triples if t.event_time < CURRENT_TIME - 1
+                    ],
+                )
+            prior = incremental_sds_plus(
+                rules, old_sds, {}, dictionary, CURRENT_TIME - 1
+            )
+            t_inc = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                inc_out = incremental_sds_plus(
+                    rules, sds, prior, dictionary, CURRENT_TIME
+                )
+                t_inc = min(t_inc, time.perf_counter() - t0)
+
+            ext = sds_with_expiry_to_external(
+                inc_out, dictionary, [S1, S2, OUT]
+            )
+            naive_set = {
+                tuple(t)
+                for comp in (S2, OUT)
+                for t in naive_out.get(comp, [])
+            }
+            inc_set = {
+                tuple(t)
+                for comp in (S2, OUT)
+                for t in ext.get(comp, [])
+            }
+            print(
+                json.dumps(
+                    {
+                        "metric": "family_tree_recursive_sds_plus",
+                        "chain": chain,
+                        "new_ratio_pct": ratio,
+                        "naive_ms": round(1000 * t_naive, 2),
+                        "incremental_ms": round(1000 * t_inc, 2),
+                        "speedup": round(t_naive / max(t_inc, 1e-9), 2),
+                        "agree": naive_set == inc_set,
+                        "derived": len(naive_set),
+                    }
+                )
+            )
+
+
+if __name__ == "__main__":
+    run()

@@ -20,6 +20,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from kolibrie_tpu.core.dictionary import Dictionary
 from kolibrie_tpu.core.rule import Rule
 from kolibrie_tpu.core.triple import Triple
@@ -88,21 +90,18 @@ def translate_sds_to_datalog(
     """Alive facts annotated with expiry; static facts get expiry = ∞."""
     out: List[Tuple[Triple, int]] = []
     enc = dictionary.encode
+    pred_ids: Dict[Tuple[str, str], int] = {}  # (window, local) → encoded
     for window_iri, wd in sds.windows.items():
         for wt in wd.triples:
             expiry = wt.event_time + wd.alpha
             if expiry <= current_time:
                 continue
-            out.append(
-                (
-                    Triple(
-                        enc(wt.subject),
-                        enc(annotate_predicate(window_iri, wt.predicate)),
-                        enc(wt.object),
-                    ),
-                    expiry,
-                )
-            )
+            pkey = (window_iri, wt.predicate)
+            pid = pred_ids.get(pkey)
+            if pid is None:
+                pid = enc(annotate_predicate(window_iri, wt.predicate))
+                pred_ids[pkey] = pid
+            out.append((Triple(enc(wt.subject), pid, enc(wt.object)), expiry))
     for graph_iri, triples in sds.static_graphs.items():
         for s, p, o in triples:
             out.append(
@@ -117,21 +116,50 @@ def translate_sds_to_datalog(
 def translate_datalog_back(
     facts: List[Triple], dictionary: Dictionary, sds: Sds
 ) -> Dict[str, List[Triple]]:
-    """Strip window-IRI prefixes; route triples to component buckets."""
-    component_iris = all_component_iris(sds)
+    """Strip window-IRI prefixes; route triples to component buckets.
+
+    Distinct predicates are few; decode/strip/re-encode each once."""
+    router = _PredicateRouter(dictionary, all_component_iris(sds))
     out: Dict[str, List[Triple]] = {}
     for t in facts:
-        pred = dictionary.decode(t.predicate)
-        if pred is None:
+        route = router.route(t.predicate)
+        if route is None:
             continue
-        hit = strip_window_prefix(pred, component_iris)
-        if hit is None:
-            continue
-        comp, local = hit
-        out.setdefault(comp, []).append(
-            Triple(t.subject, dictionary.encode(local), t.object)
-        )
+        comp, local_id = route
+        out.setdefault(comp, []).append(Triple(t.subject, local_id, t.object))
     return out
+
+
+_MISS = object()  # sentinel distinguishing "unseen predicate" from None
+
+
+class _PredicateRouter:
+    """Cached annotated-predicate-ID → (component IRI, local-name ID) map.
+
+    The decode → longest-prefix strip → re-encode round trip runs once per
+    DISTINCT predicate, not once per fact."""
+
+    def __init__(self, dictionary: Dictionary, component_iris: List[str]):
+        self._dictionary = dictionary
+        self._component_iris = component_iris
+        self._cache: Dict[int, Optional[Tuple[str, int]]] = {}
+
+    def route(self, pred_id: int) -> Optional[Tuple[str, int]]:
+        hit = self._cache.get(pred_id, _MISS)
+        if hit is _MISS:
+            pred = self._dictionary.decode(pred_id)
+            stripped = (
+                strip_window_prefix(pred, self._component_iris)
+                if pred
+                else None
+            )
+            hit = (
+                (stripped[0], self._dictionary.encode(stripped[1]))
+                if stripped is not None
+                else None
+            )
+            self._cache[pred_id] = hit
+        return hit
 
 
 # Internal incremental state: component IRI -> {annotated triple -> expiry}
@@ -141,20 +169,15 @@ SdsWithExpiry = Dict[str, Dict[Tuple[int, int, int], int]]
 def sds_with_expiry_to_external(
     internal: SdsWithExpiry, dictionary: Dictionary, component_iris: List[str]
 ) -> Dict[str, List[Triple]]:
+    router = _PredicateRouter(dictionary, component_iris)
     out: Dict[str, List[Triple]] = {}
     for comp, fact_map in internal.items():
         for key in fact_map:
-            t = Triple(*key)
-            pred = dictionary.decode(t.predicate)
-            if pred is None:
-                continue
-            hit = strip_window_prefix(pred, component_iris)
+            hit = router.route(key[1])
             if hit is None:
                 continue
-            _, local = hit
-            out.setdefault(comp, []).append(
-                Triple(t.subject, dictionary.encode(local), t.object)
-            )
+            _, local_id = hit
+            out.setdefault(comp, []).append(Triple(key[0], local_id, key[2]))
     return out
 
 
@@ -164,8 +187,9 @@ def naive_sds_plus(
     """Full SDS+ recomputation (cross_window_naive.rs:20-43)."""
     annotated = translate_sds_to_datalog(sds, dictionary, current_time)
     reasoner = Reasoner(dictionary)
-    for t, _ in annotated:
-        reasoner.insert_ground_triple(t)
+    if annotated:
+        arr = np.array([tuple(t) for t, _ in annotated], dtype=np.uint32)
+        reasoner.facts.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
     for rule in rules:
         reasoner.add_rule(rule)
     reasoner.infer_new_facts_semi_naive()
@@ -203,41 +227,40 @@ def incremental_sds_plus(
     ]
 
     reasoner = Reasoner(dictionary)
-    for key in d_old_map:
-        reasoner.insert_ground_triple(Triple(*key))
-    for t, _ in d_new:
-        reasoner.insert_ground_triple(t)
+    all_keys = list(d_old_map) + [tuple(t) for t, _ in d_new]
+    if all_keys:
+        arr = np.array(all_keys, dtype=np.uint32)
+        reasoner.facts.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
     for rule in rules:
         reasoner.add_rule(rule)
 
     prov = ExpirationProvenance()
     initial_tags = TagStore(prov)
+    tags = initial_tags.tags  # direct dict access in the per-fact loops
     for key, e in d_old_map.items():
         if e < U64_MAX:
-            initial_tags.set(Triple(*key), e)
+            tags[key] = e
     for t, e in d_new:
         if e < U64_MAX:
             # a re-arrival may improve expiry over D_old
-            old = initial_tags.get_opt(t)
-            initial_tags.set(t, e if old is None else max(old, e))
+            key = tuple(t)
+            old = tags.get(key)
+            tags[key] = e if old is None else max(old, e)
 
     delta = {tuple(t) for t, _ in d_new}
     tag_store = semi_naive_with_initial_tags_and_delta(
         reasoner, prov, initial_tags, delta
     )
 
-    component_iris = all_component_iris(sds_current)
+    router = _PredicateRouter(dictionary, all_component_iris(sds_current))
     result: SdsWithExpiry = {}
+    final_tags = tag_store.tags
     for key in reasoner.facts.triples_set():
-        pred = dictionary.decode(key[1])
-        if pred is None:
-            continue
-        hit = strip_window_prefix(pred, component_iris)
+        hit = router.route(key[1])
         if hit is None:
             continue
-        comp, _ = hit
-        expiry = tag_store.get_opt(Triple(*key))
+        expiry = final_tags.get(key)
         if expiry is None:
             expiry = U64_MAX
-        result.setdefault(comp, {})[key] = expiry
+        result.setdefault(hit[0], {})[key] = expiry
     return result

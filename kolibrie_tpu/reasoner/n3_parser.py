@@ -17,7 +17,9 @@ from kolibrie_tpu.core.rule import Rule
 from kolibrie_tpu.core.terms import Term, TriplePattern
 
 _PREFIX_RE = re.compile(r"@prefix\s+([\w-]*):\s*<([^>]*)>\s*\.")
-_RULE_RE = re.compile(r"\{(.*?)\}\s*=>\s*\{(.*?)\}\s*\.", re.S)
+# Trailing '.' after a rule is optional, as in the reference's nom parser
+# (its own benches write rules without one, parser_n3_logic.rs:135).
+_RULE_RE = re.compile(r"\{(.*?)\}\s*=>\s*\{(.*?)\}\s*\.?", re.S)
 _TERM_RE = re.compile(
     r"""\?(?P<var>[\w-]+)
       | <(?P<iri>[^>]*)>

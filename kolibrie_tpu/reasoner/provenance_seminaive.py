@@ -60,6 +60,29 @@ def _derivation_rows(
     ]
 
 
+def _pattern_key_rows(
+    table, pattern, n: int, quoted
+) -> Optional[List[TripleKey]]:
+    """Substitute a pattern under all binding rows at once: one key tuple
+    per row (columnar — no per-row dicts).  None when a variable is unbound
+    (the caller skips the pattern wholesale, as _subst would row-wise)."""
+    cols = []
+    for t in (pattern.subject, pattern.predicate, pattern.object):
+        if t.is_variable:
+            c = table.get(t.value)
+            if c is None:
+                return None
+            cols.append(c.tolist())
+        elif t.is_quoted:
+            inner_rows = _pattern_key_rows(table, t.value, n, quoted)
+            if inner_rows is None or quoted is None:
+                return None
+            cols.append([quoted.intern(*k) for k in inner_rows])
+        else:
+            cols.append([int(t.value)] * n)
+    return list(zip(*cols))
+
+
 def _subst(pattern, row: Dict[str, int], quoted=None) -> Optional[TripleKey]:
     def term_id(t) -> Optional[int]:
         if t.is_variable:
@@ -126,13 +149,35 @@ def infer_with_provenance(
 def _positive_fixpoint(
     reasoner, provenance, tag_store, pos_rules, facts, delta_keys
 ) -> Set[TripleKey]:
+    # old = facts \ delta, so each derivation is found exactly once
+    # (non-idempotent ⊕ must not see duplicates).  Both the old-store and
+    # the membership set are maintained INCREMENTALLY across rounds — a
+    # per-round rebuild makes deep (recursive-rule) fixpoints quadratic.
+    all_keys = facts.triples_set()  # membership set, maintained per round
+    old_store = None
+    prev_delta: Set[TripleKey] = set()
+    prev_new: Set[TripleKey] = set()
     while delta_keys:
         arr = np.asarray(sorted(delta_keys), dtype=np.uint32)
         delta_cols = (arr[:, 0], arr[:, 1], arr[:, 2])
-        # old = facts \ delta, so each derivation is found exactly once
-        # (non-idempotent ⊕ must not see duplicates)
-        old_keys = facts.triples_set() - delta_keys
-        old_store = reasoner._store_from(old_keys)
+        # Invariant: old_store = committed facts \ current delta, updated in
+        # O(|delta|) per round (a full rebuild per round makes deep
+        # recursive fixpoints quadratic):
+        #   ADD    prev_delta \ delta   (left the delta → becomes old; the
+        #          previous round's new facts all re-enter the delta, so
+        #          nothing else grows old)
+        #   REMOVE (delta \ prev_new) \ prev_delta   (an OLD fact whose tag
+        #          improved re-enters the delta → hide from old)
+        if old_store is None:
+            old_store = reasoner._store_from(all_keys - delta_keys)
+        else:
+            grown = prev_delta - delta_keys
+            if grown:
+                g = np.asarray(sorted(grown), dtype=np.uint32)
+                old_store.add_batch(g[:, 0], g[:, 1], g[:, 2])
+            for k in (delta_keys - prev_new) - prev_delta:
+                old_store.remove(*k)
+        prev_delta = set(delta_keys)
         next_delta: Set[TripleKey] = set()
         round_new: Set[TripleKey] = set()  # buffered until the round ends
         for rule in pos_rules:
@@ -142,37 +187,58 @@ def _positive_fixpoint(
             n = table_len(table)
             if n == 0:
                 continue
-            rows = _derivation_rows(reasoner, rule, table, n)
-            for row in rows:
-                # ⊗ of premise tags (all ways the body matched this row)
-                tag = provenance.one()
-                for prem in rule.premise:
-                    key = _subst(prem, row, reasoner.quoted)
-                    if key is None:
-                        tag = provenance.zero()
-                        break
-                    tag = provenance.conjunction(
-                        tag, _premise_tag(provenance, tag_store, key)
-                    )
-                if provenance.is_zero(tag):
+            # Columnar substitution: per-premise/conclusion key rows built
+            # once; the remaining per-row work is tag algebra only.
+            prem_rows = [
+                _pattern_key_rows(table, p, n, reasoner.quoted)
+                for p in rule.premise
+            ]
+            if any(pr is None for pr in prem_rows):
+                continue
+            concl_rows = [
+                _pattern_key_rows(table, c, n, reasoner.quoted)
+                for c in rule.conclusion
+            ]
+            tags_get = tag_store.tags.get
+            one = provenance.one()
+            conj = provenance.conjunction
+            disj = provenance.disjunction
+            is_zero = provenance.is_zero
+            # Pre-aggregate this round's derivations per conclusion key
+            # (⊕ is associative and saturate() is the identity for every
+            # semiring, so one final update_disjunction per key is exact).
+            acc: Dict[TripleKey, object] = {}
+            for i in range(n):
+                tag = one
+                for pr in prem_rows:
+                    ptag = tags_get(pr[i])
+                    if ptag is not None:
+                        tag = conj(tag, ptag)
+                if is_zero(tag):
                     continue  # zero-tag pruning (:171)
-                for concl in rule.conclusion:
-                    ckey = _subst(concl, row, reasoner.quoted)
-                    if ckey is None:
+                for cr in concl_rows:
+                    if cr is None:
                         continue
-                    existed = facts.contains(*ckey) or ckey in round_new
-                    changed = tag_store.update_disjunction(Triple(*ckey), tag)
-                    if not existed:
-                        round_new.add(ckey)
-                        next_delta.add(ckey)
-                    elif changed:
-                        # tag improved: re-include in delta (:26-34)
-                        next_delta.add(ckey)
+                    ckey = cr[i]
+                    prev = acc.get(ckey)
+                    acc[ckey] = tag if prev is None else disj(prev, tag)
+            for ckey, tag in acc.items():
+                existed = ckey in all_keys or ckey in round_new
+                changed = tag_store.update_disjunction(Triple(*ckey), tag)
+                if not existed:
+                    round_new.add(ckey)
+                    next_delta.add(ckey)
+                elif changed:
+                    # tag improved: re-include in delta (:26-34)
+                    next_delta.add(ckey)
         # commit this round's facts only now, so the full-store scans within
         # the round never see mid-round additions (each derivation must be
         # found exactly once — non-idempotent ⊕ safety)
-        for ckey in round_new:
-            facts.add(*ckey)
+        if round_new:
+            rn = np.asarray(sorted(round_new), dtype=np.uint32)
+            facts.add_batch(rn[:, 0], rn[:, 1], rn[:, 2])
+            all_keys |= round_new
+        prev_new = round_new
         delta_keys = next_delta
     return set()
 
