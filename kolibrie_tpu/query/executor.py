@@ -356,7 +356,11 @@ def table_header(table: BindingTable, q: SelectQuery) -> List[str]:
 
 
 def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
-    """Final parallel ID→string decode (engine.rs:34-50 parity)."""
+    """Final ID→string decode (engine.rs:34-50 parity).
+
+    Each DISTINCT id per column is decoded once (np.unique + inverse map) —
+    RDF columns are heavily repetitive, so this is the decode analogue of
+    the reference's deferred final rayon pass."""
     header = table_header(table, q)
     n = table_len(table)
     dec = db.decode_term
@@ -365,8 +369,12 @@ def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
         col = table.get(h)
         if col is None:
             cols.append([""] * n)
-        else:
-            cols.append([_format_value(dec(int(i))) if i != UNBOUND else "" for i in col])
+            continue
+        uniq, inv = np.unique(np.asarray(col), return_inverse=True)
+        decoded = [
+            _format_value(dec(int(i))) if i != UNBOUND else "" for i in uniq
+        ]
+        cols.append([decoded[j] for j in inv.tolist()])
     return [list(row) for row in zip(*cols)] if n else []
 
 
