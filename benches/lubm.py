@@ -1,0 +1,145 @@
+"""Deterministic LUBM-style dataset generator.
+
+The reference repo has no LUBM data (BASELINE.md: "LUBM data not in the
+reference repo — generate with the standard LUBM generator"); this is a
+self-contained, deterministic miniature with the same schema shape used by
+LUBM queries Q2/Q9: universities, departments, faculty, students, courses,
+and the predicates those queries join over.
+
+``generate(n_universities)`` yields dictionary-encoded ID columns directly
+(strings never materialized for the bulk of the data) — the TPU-native
+ingest path.
+"""
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+UB = "http://swat.cse.lehigh.edu/onto/univ-bench.owl#"
+RDF_TYPE = "http://www.w3.org/1999/02/22-rdf-syntax-ns#type"
+
+DEPTS_PER_UNIV = 8
+PROFS_PER_DEPT = 12
+STUDENTS_PER_DEPT = 80
+GRAD_RATIO = 4  # every 4th student is a graduate student
+COURSES_PER_DEPT = 15
+
+
+def generate(
+    n_universities: int, dictionary
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (s, p, o) uint32 columns for an n-university LUBM-like KG."""
+    enc = dictionary.encode
+    p_type = enc(RDF_TYPE)
+    p_sub_org = enc(UB + "subOrganizationOf")
+    p_member = enc(UB + "memberOf")
+    p_works = enc(UB + "worksFor")
+    p_advisor = enc(UB + "advisor")
+    p_takes = enc(UB + "takesCourse")
+    p_teaches = enc(UB + "teacherOf")
+    p_degree = enc(UB + "undergraduateDegreeFrom")
+    c_univ = enc(UB + "University")
+    c_dept = enc(UB + "Department")
+    c_prof = enc(UB + "FullProfessor")
+    c_grad = enc(UB + "GraduateStudent")
+    c_ugrad = enc(UB + "UndergraduateStudent")
+    c_course = enc(UB + "Course")
+
+    s, p, o = [], [], []
+
+    def emit(subj, pred, obj):
+        s.append(subj)
+        p.append(pred)
+        o.append(obj)
+
+    rng = np.random.default_rng(42)
+    for u in range(n_universities):
+        univ = enc(f"http://www.University{u}.edu")
+        emit(univ, p_type, c_univ)
+        for d in range(DEPTS_PER_UNIV):
+            dept = enc(f"http://www.Department{d}.University{u}.edu")
+            emit(dept, p_type, c_dept)
+            emit(dept, p_sub_org, univ)
+            courses = []
+            for c in range(COURSES_PER_DEPT):
+                crs = enc(
+                    f"http://www.Department{d}.University{u}.edu/Course{c}"
+                )
+                emit(crs, p_type, c_course)
+                courses.append(crs)
+            profs = []
+            for f in range(PROFS_PER_DEPT):
+                prof = enc(
+                    f"http://www.Department{d}.University{u}.edu/FullProfessor{f}"
+                )
+                emit(prof, p_type, c_prof)
+                emit(prof, p_works, dept)
+                crs = courses[f % COURSES_PER_DEPT]
+                emit(prof, p_teaches, crs)
+                profs.append(prof)
+            for st in range(STUDENTS_PER_DEPT):
+                stu = enc(
+                    f"http://www.Department{d}.University{u}.edu/Student{st}"
+                )
+                grad = st % GRAD_RATIO == 0
+                emit(stu, p_type, c_grad if grad else c_ugrad)
+                emit(stu, p_member, dept)
+                advisor = profs[st % PROFS_PER_DEPT]
+                emit(stu, p_advisor, advisor)
+                # every student takes the course their advisor teaches plus
+                # one other — Q9's triangle closes for the former
+                emit(stu, p_takes, courses[st % PROFS_PER_DEPT])
+                emit(stu, p_takes, courses[(st + 7) % COURSES_PER_DEPT])
+                if grad:
+                    # Q2's triangle: degree from the university owning the
+                    # department the student is a member of (every 3rd), or
+                    # a random other university
+                    if st % 3 == 0:
+                        emit(stu, p_degree, univ)
+                    else:
+                        other = int(rng.integers(0, n_universities))
+                        emit(
+                            stu,
+                            p_degree,
+                            enc(f"http://www.University{other}.edu"),
+                        )
+    return (
+        np.asarray(s, dtype=np.uint32),
+        np.asarray(p, dtype=np.uint32),
+        np.asarray(o, dtype=np.uint32),
+    )
+
+
+def predicate_ids(dictionary) -> Dict[str, int]:
+    return {
+        name: dictionary.encode(UB + name)
+        for name in (
+            "subOrganizationOf",
+            "memberOf",
+            "worksFor",
+            "advisor",
+            "takesCourse",
+            "teacherOf",
+            "undergraduateDegreeFrom",
+        )
+    }
+
+
+LUBM_Q2 = """PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?x ?y ?z WHERE {
+    ?x rdf:type ub:GraduateStudent .
+    ?y rdf:type ub:University .
+    ?z rdf:type ub:Department .
+    ?x ub:memberOf ?z .
+    ?z ub:subOrganizationOf ?y .
+    ?x ub:undergraduateDegreeFrom ?y
+}"""
+
+LUBM_Q9 = """PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?x ?y ?z WHERE {
+    ?x ub:advisor ?y .
+    ?y ub:teacherOf ?z .
+    ?x ub:takesCourse ?z
+}"""

@@ -235,33 +235,43 @@ def dist_bgp_join_count(store, p1: int, p2: int) -> int:
     with zero exchange and one scalar psum.  This is the headline
     BGP-join benchmark path (BASELINE.md config 1/5).
     """
+    return int(dist_bgp_join_count_device(store, p1, p2)[0])
+
+
+def dist_bgp_join_count_device(store, p1: int, p2: int):
+    """As :func:`dist_bgp_join_count` but returns the un-read device array.
+
+    Benchmarks must dispatch-and-time BEFORE any host readback (through the
+    axon tunnel a single element read degrades every later dispatch of the
+    same executable ~3000x); this variant lets callers defer the read."""
+    store.ensure_subj_index()
     fn = _bgp_count_fn(store.mesh)
-    out = fn(
-        jnp.uint32(p1),
-        jnp.uint32(p2),
-        *store.by_obj,
-        store.by_obj_valid,
-        *store.by_subj,
-        store.by_subj_valid,
-    )
-    return int(out[0])
+    with jax.enable_x64(True):
+        return fn(
+            jnp.uint32(p1),
+            jnp.uint32(p2),
+            *store.by_obj,
+            store.by_obj_valid,
+            store.subj_packed_sorted,
+        )
 
 
 @lru_cache(maxsize=8)
 def _bgp_count_fn(mesh):
     axis = mesh.axis_names[0]
 
-    def body(p1, p2, os_, op, oo, ov, ss, sp, so, sv):
-        os_, op, oo, ov = os_[0], op[0], oo[0], ov[0]
-        ss, sp, so, sv = ss[0], sp[0], so[0], sv[0]
+    def body(p1, p2, os_, op, oo, ov, subj_packed):
+        op, oo, ov = op[0], oo[0], ov[0]
+        packed = subj_packed[0]  # PRE-SORTED (pred<<32|subj) — no sort here
         lv = ov & (op == p1)
-        rv = sv & (sp == p2)
-        lkey = jnp.where(lv, oo, _LPAD32)
-        rkey = jnp.where(rv, ss, _RPAD32)
-        rsorted = jnp.sort(rkey)
-        lo = jnp.searchsorted(rsorted, lkey, side="left")
-        hi = jnp.searchsorted(rsorted, lkey, side="right")
-        total = jnp.sum((hi - lo).astype(jnp.int32))
+        p2_hi = p2.astype(jnp.uint64) << jnp.uint64(32)
+        # invalid left rows get a probe key beyond every real packed key
+        lkey = jnp.where(
+            lv, p2_hi | oo.astype(jnp.uint64), jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        )
+        lo = jnp.searchsorted(packed, lkey, side="left")
+        hi = jnp.searchsorted(packed, lkey, side="right")
+        total = jnp.sum(jnp.where(lv, hi - lo, 0).astype(jnp.int32))
         return lax.psum(total, axis)[None]
 
     spec = P(axis, None)
@@ -269,7 +279,7 @@ def _bgp_count_fn(mesh):
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P()) + (spec,) * 8,
+            in_specs=(P(), P()) + (spec,) * 5,
             out_specs=P(axis),
         )
     )

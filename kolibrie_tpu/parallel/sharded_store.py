@@ -77,6 +77,11 @@ class ShardedTripleStore:
         self.by_subj_valid = jax.device_put(f, self.sharding)
         self.by_obj = tuple(jax.device_put(z, self.sharding) for _ in range(3))
         self.by_obj_valid = jax.device_put(f, self.sharding)
+        pad = np.full(
+            (self.n_shards, cap_per_shard), 0xFFFFFFFFFFFFFFFF, dtype=np.uint64
+        )
+        with jax.enable_x64(True):
+            self.subj_packed_sorted = jax.device_put(pad, self.sharding)
 
     @classmethod
     def from_columns(
@@ -103,7 +108,42 @@ class ShardedTripleStore:
         st.by_subj_valid = put(sv)
         st.by_obj = (put(os_), put(op), put(oo))
         st.by_obj_valid = put(ov)
+        st.refresh_subj_index()
         return st
+
+    def refresh_subj_index(self) -> None:
+        """(Re)build the pre-sorted (predicate<<32 | subject) probe index
+        from the CURRENT subject-hashed shards, fully ON DEVICE — a host
+        round-trip here would both cost a transfer and poison all later
+        dispatch latency through the axon tunnel (any readback degrades
+        subsequent dispatches ~3000x).  u64 arrays require the x64 scope;
+        consumers (dist_join) run their jitted bodies under it too.
+
+        Consumers call :meth:`ensure_subj_index`, which detects stale
+        derived state structurally (array identity), so forgetting an
+        explicit refresh after a ``by_subj`` write-back cannot produce
+        wrong results — only a lazy rebuild.
+        """
+        with jax.enable_x64(True):
+            self.subj_packed_sorted = _pack_sort_device(
+                self.by_subj[0], self.by_subj[1], self.by_subj_valid
+            )
+        self._subj_index_src = (
+            id(self.by_subj[0]),
+            id(self.by_subj[1]),
+            id(self.by_subj_valid),
+        )
+
+    def ensure_subj_index(self) -> None:
+        """Rebuild the probe index iff ``by_subj`` was reassigned since the
+        last build."""
+        src = (
+            id(self.by_subj[0]),
+            id(self.by_subj[1]),
+            id(self.by_subj_valid),
+        )
+        if getattr(self, "_subj_index_src", None) != src:
+            self.refresh_subj_index()
 
     @property
     def n_triples(self) -> int:
@@ -114,3 +154,15 @@ class ShardedTripleStore:
         v = np.asarray(self.by_subj_valid).ravel()
         s, p, o = (np.asarray(c).ravel()[v] for c in self.by_subj)
         return s, p, o
+
+
+@jax.jit
+def _pack_sort_device(ss, sp, sv):
+    """Per-shard (pred<<32|subj) pack + row sort, fully on device (sharding
+    propagates from the inputs; sort is along the intra-shard axis)."""
+    packed = jnp.where(
+        sv,
+        (sp.astype(jnp.uint64) << jnp.uint64(32)) | ss.astype(jnp.uint64),
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+    return jnp.sort(packed, axis=1)

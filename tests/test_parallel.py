@@ -127,6 +127,10 @@ class TestDistributedFixpoint:
         got = set(zip(s.tolist(), o.tolist()))
         want = {(i, j) for i in range(1, n + 1) for j in range(i + 1, n + 1)}
         assert got == want
+        # the packed probe index must reflect POST-fixpoint facts: 2-hop
+        # paths over the closure = #{(i,j,k): i<j<k} = sum_j (j-1)(n-j)
+        n_paths = sum((j - 1) * (n - j) for j in range(1, n + 1))
+        assert dist_bgp_join_count(st, 100, 100) == n_paths
 
     def test_agrees_with_host_reasoner(self, mesh):
         """naive-vs-optimized agreement — the reference's own key pattern."""
