@@ -1,0 +1,31 @@
+"""Simple SELECT over Turtle data.
+
+Mirrors the reference's ``examples/sparql_syntax/simple_select`` +
+``select_semicolon`` (Turtle ``;`` shorthand).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+db = SparqlDatabase()
+db.parse_turtle("""
+@prefix ex: <http://example.org/> .
+ex:alice ex:worksAt ex:acme ;
+         ex:age "34" .
+ex:bob   ex:worksAt ex:globex ;
+         ex:age "29" .
+ex:carol ex:worksAt ex:acme .
+""")
+
+rows = execute_query_volcano(
+    """PREFIX ex: <http://example.org/>
+    SELECT ?who ?where WHERE { ?who ex:worksAt ?where }""",
+    db,
+)
+for row in rows:
+    print(row)

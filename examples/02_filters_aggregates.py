@@ -1,0 +1,36 @@
+"""FILTER expressions, GROUP BY + aggregates, ORDER BY, VALUES, BIND.
+
+Mirrors ``examples/sparql_syntax/{filter,aggregate_function,values_keyword}``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+db = SparqlDatabase()
+db.parse_ntriples("\n".join(
+    f'<http://e/emp{i}> <http://e/salary> "{30000 + i * 2500}" .\n'
+    f'<http://e/emp{i}> <http://e/dept> <http://e/dept{i % 3}> .'
+    for i in range(12)
+))
+
+print("-- salaries above 40k, ordered --")
+for row in execute_query_volcano(
+    """SELECT ?e ?s WHERE { ?e <http://e/salary> ?s .
+        FILTER (?s > 40000) } ORDER BY DESC(?s) LIMIT 5""",
+    db,
+):
+    print(row)
+
+print("-- average salary per department --")
+for row in execute_query_volcano(
+    """SELECT ?d (AVG(?s) AS ?avg) WHERE {
+        ?e <http://e/dept> ?d . ?e <http://e/salary> ?s }
+       GROUP BY ?d""",
+    db,
+):
+    print(row)

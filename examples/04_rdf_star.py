@@ -1,0 +1,26 @@
+"""RDF-star: quoted triples, annotation syntax, SPARQL-star builtins.
+
+Mirrors the reference's rdf-star support (``rdf_star_test.rs`` surface).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+db = SparqlDatabase()
+db.parse_ntriples("""
+<< <http://e/alice> <http://e/knows> <http://e/bob> >> <http://e/certainty> "0.9" .
+<http://e/alice> <http://e/knows> <http://e/bob> .
+""")
+
+print("-- who said what, with what certainty --")
+for row in execute_query_volcano(
+    """SELECT ?s ?o ?c WHERE {
+        << ?s <http://e/knows> ?o >> <http://e/certainty> ?c }""",
+    db,
+):
+    print(row)

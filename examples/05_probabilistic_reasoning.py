@@ -1,0 +1,61 @@
+"""Probabilistic Datalog: provenance semirings, SDD-backed exact WMC.
+
+Mirrors the reference's tagged-triple / PROB surface
+(``shared/src/{provenance,sdd,tag_store}.rs``).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner import Reasoner
+from kolibrie_tpu.reasoner.provenance import (
+    AddMultProbability,
+    MinMaxProbability,
+)
+from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
+from kolibrie_tpu.reasoner.sdd_seed import infer_new_facts_with_sdd_seed_specs
+from kolibrie_tpu.reasoner.seed_spec import IndependentSeed
+
+
+def build():
+    r = Reasoner()
+    r.add_tagged_triple(":sensorA", ":detects", ":smoke", 0.7)
+    r.add_tagged_triple(":sensorB", ":detects", ":smoke", 0.8)
+    r.add_rule(
+        r.rule_from_strings(
+            [("?s", ":detects", ":smoke")], [(":room", ":hasAlarm", ":fire")]
+        )
+    )
+    alarm = (
+        r.dictionary.encode(":room"),
+        r.dictionary.encode(":hasAlarm"),
+        r.dictionary.encode(":fire"),
+    )
+    return r, alarm
+
+
+# Fuzzy semantics: strength of the best single proof (max over min-paths)
+r, alarm = build()
+tags = infer_with_provenance(r, MinMaxProbability())
+print("minmax   P(alarm) =", tags.tags.get(alarm))
+
+# Noisy-OR semantics: independent evidence combines
+r, alarm = build()
+tags = infer_with_provenance(r, AddMultProbability())
+print("noisy-or P(alarm) =", round(tags.tags.get(alarm), 4))
+
+# Exact weighted model counting via the SDD engine
+r, alarm = build()
+seeds = [
+    IndependentSeed(Triple(*key), prob, i)
+    for i, (key, prob) in enumerate(sorted(r.probability_seeds.items()))
+]
+store, prov = infer_new_facts_with_sdd_seed_specs(r, seeds)
+print(
+    "exact    P(alarm) =",
+    round(prov.recover_probability(store.get(Triple(*alarm))), 4),
+    "= 1 - 0.3*0.2",
+)

@@ -1,0 +1,44 @@
+"""RSP-QL streaming: REGISTER a continuous query with windows over two
+streams and a cross-window reasoning rule.
+
+Mirrors the reference's ``examples/sparql_syntax/rsp_ql_syntax``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.rsp.builder import RSPBuilder
+from kolibrie_tpu.rsp.engine import CrossWindowReasoningMode
+from kolibrie_tpu.rsp.s2r import WindowTriple
+
+results = []
+engine = (
+    RSPBuilder(
+        """PREFIX ex: <http://e/>
+        REGISTER ISTREAM <http://out/alerts> AS
+        SELECT ?room ?v
+        FROM NAMED WINDOW <http://e/wT/> ON <http://e/temp> [RANGE 10 STEP 2]
+        FROM NAMED WINDOW <http://e/wH/> ON <http://e/hum> [RANGE 10 STEP 2]
+        WHERE {
+          WINDOW <http://e/wT/> { ?room <alerted> ?v }
+          WINDOW <http://e/wH/> { ?room <humid> ?w }
+        }"""
+    )
+    .set_cross_window_rules(
+        """@prefix t: <http://e/wT/> .
+        @prefix h: <http://e/wH/> .
+        { ?room t:hot ?v . ?room h:humid ?w . } => { ?room t:alerted ?v . } ."""
+    )
+    .set_cross_window_reasoning_mode(CrossWindowReasoningMode.INCREMENTAL)
+    .with_consumer(lambda row: results.append(row))
+    .build()
+)
+
+for ts in range(1, 9):
+    engine.add_to_stream("http://e/temp", WindowTriple("r1", "hot", '"42"'), ts)
+    engine.add_to_stream("http://e/hum", WindowTriple("r1", "humid", '"80"'), ts)
+engine.process_single_thread_window_results()
+engine.stop()
+print(f"{len(results)} alert rows, first:", results[0] if results else None)

@@ -402,7 +402,7 @@ class DistributedReasoner:
         store.by_subj_valid = state[3]
         store.by_obj = tuple(state[4:7])
         store.by_obj_valid = state[7]
-        store.refresh_subj_index()
+        # probe index rebuilds lazily on next ensure_subj_index()
         return rounds
 
 

@@ -250,7 +250,8 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
         return fn(
             jnp.uint32(p1),
             jnp.uint32(p2),
-            *store.by_obj,
+            store.by_obj[1],
+            store.by_obj[2],
             store.by_obj_valid,
             store.subj_packed_sorted,
         )
@@ -260,7 +261,7 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
 def _bgp_count_fn(mesh):
     axis = mesh.axis_names[0]
 
-    def body(p1, p2, os_, op, oo, ov, subj_packed):
+    def body(p1, p2, op, oo, ov, subj_packed):
         op, oo, ov = op[0], oo[0], ov[0]
         packed = subj_packed[0]  # PRE-SORTED (pred<<32|subj) — no sort here
         lv = ov & (op == p1)
@@ -279,7 +280,7 @@ def _bgp_count_fn(mesh):
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P()) + (spec,) * 5,
+            in_specs=(P(), P()) + (spec,) * 4,
             out_specs=P(axis),
         )
     )

@@ -12,6 +12,7 @@ key* instead of sort order.
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional, Tuple
 
 import jax
@@ -77,11 +78,10 @@ class ShardedTripleStore:
         self.by_subj_valid = jax.device_put(f, self.sharding)
         self.by_obj = tuple(jax.device_put(z, self.sharding) for _ in range(3))
         self.by_obj_valid = jax.device_put(f, self.sharding)
-        pad = np.full(
-            (self.n_shards, cap_per_shard), 0xFFFFFFFFFFFFFFFF, dtype=np.uint64
-        )
-        with jax.enable_x64(True):
-            self.subj_packed_sorted = jax.device_put(pad, self.sharding)
+        # subj_packed_sorted is built lazily by ensure_subj_index on first
+        # probe (and eagerly by from_columns).
+        self.subj_packed_sorted = None
+        self._subj_index_src = None
 
     @classmethod
     def from_columns(
@@ -128,21 +128,26 @@ class ShardedTripleStore:
             self.subj_packed_sorted = _pack_sort_device(
                 self.by_subj[0], self.by_subj[1], self.by_subj_valid
             )
+        # weakrefs keep the identity check sound: if a source array was
+        # collected and its address reused, the dead ref can never compare
+        # identical to the new object (a bare id() tuple could).
         self._subj_index_src = (
-            id(self.by_subj[0]),
-            id(self.by_subj[1]),
-            id(self.by_subj_valid),
+            weakref.ref(self.by_subj[0]),
+            weakref.ref(self.by_subj[1]),
+            weakref.ref(self.by_subj_valid),
         )
 
     def ensure_subj_index(self) -> None:
         """Rebuild the probe index iff ``by_subj`` was reassigned since the
-        last build."""
-        src = (
-            id(self.by_subj[0]),
-            id(self.by_subj[1]),
-            id(self.by_subj_valid),
-        )
-        if getattr(self, "_subj_index_src", None) != src:
+        last build (structural staleness detection — callers need not
+        remember to refresh after a write-back)."""
+        src = self._subj_index_src
+        current = (self.by_subj[0], self.by_subj[1], self.by_subj_valid)
+        if (
+            self.subj_packed_sorted is None
+            or src is None
+            or any(r() is not a for r, a in zip(src, current))
+        ):
             self.refresh_subj_index()
 
     @property

@@ -148,5 +148,5 @@ def neurosymbolic_step(
     store.by_subj_valid = out_state[3]
     store.by_obj = tuple(out_state[4:7])
     store.by_obj_valid = out_state[7]
-    store.refresh_subj_index()
+    # probe index rebuilds lazily on next ensure_subj_index()
     return new_state, float(loss), int(count[0])
