@@ -1,4 +1,4 @@
-"""Headline benchmark: BGP 2-pattern join over employee-100K, on device.
+"""Headline benchmark: the employee-100K BGP join through the ACTUAL engine.
 
 Mirrors the reference's ``execute_query_join``/``execute_query_volcano``
 criterion bench (``kolibrie/benches/my_benchmark.rs:29-100``): the query
@@ -7,158 +7,159 @@ criterion bench (``kolibrie/benches/my_benchmark.rs:29-100``): the query
         ?employee foaf:workplaceHomepage ?workplaceHomepage .
         ?employee ds:annual_salary ?salary }
 
-over 100K employee triples.  The reference repo carries the dataset only as
-a git-LFS pointer, so an equivalent dataset (same shape: 4 predicates per
-employee, 100K triples total) is synthesized deterministically.
+over 100K employee triples (the reference repo carries the dataset only as a
+git-LFS pointer, so an equivalent dataset — 4 predicates per employee,
+100K triples — is synthesized and loaded through the public N-Triples
+parser).
 
-Measurement notes:
-- The store is PSO-sorted at build time, so each predicate is a contiguous
-  slice already sorted by subject and the join is a sort-free merge
-  (searchsorted ranges + static-capacity materialization) — the TPU-native
-  analogue of the reference's PSO-index-driven merge join
-  (``shared/src/join_algorithm.rs:19-131``).
-- The shared dev TPU behind the axon tunnel has highly variable dispatch
-  latency (observed 34us..90ms) and occasional contention windows, so the
-  join is iterated K times inside ONE dispatch via ``lax.scan`` (with a
-  loop-carried dependency XLA cannot hoist) and the minimum over several
-  dispatches is taken.
+What is measured (the framework, not an inline kernel):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-value = BGP-join throughput in input triples/sec/chip on the device path
-and vs_baseline = device throughput / host-numpy throughput (the reference
-is a CPU-only engine, so the in-process numpy merge join over the same
-PSO slices stands in for its single-node baseline).
+- The query goes through the PUBLIC API: ``SparqlDatabase`` + SPARQL parse +
+  Streamertail plan + the device execution engine
+  (``kolibrie_tpu/optimizer/device_engine.py``) — the plan compiles to ONE
+  jitted XLA program over the store's device-resident sorted orders.
+- ``PreparedQuery`` separates prepare (parse/plan/lower, host) from execute
+  (device dispatch), matching the reference bench's iteration over a loaded
+  database.  Headline value = input triples/sec of the prepared device
+  execution; ``vs_baseline`` = host numpy engine time / device time for the
+  SAME operator pipeline (the reference is CPU-only, so the in-process numpy
+  engine stands in for its single-node baseline).
+- Readback discipline (shared dev TPU behind the axon tunnel): capacities
+  are calibrated HOST-side, the timed executable is never read during the
+  loop, and correctness (device rows == host rows) is verified afterwards.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 """
 
 import json
 import time
 
-import numpy as np
-
-N_TRIPLES = 100_000
-N_PRED = 4  # name, title, workplaceHomepage, annual_salary
-P_WORKS = 2
-P_SALARY = 3
-JOIN_CAP = 1 << 15  # >= n_employees
-SCAN_K = 32
+N_EMPLOYEES = 25_000  # x4 predicates = 100K triples
+N_TRIPLES = 4 * N_EMPLOYEES
 N_DISPATCH = 30
+SCAN_K = 32  # plan executions amortized into one dispatch
 DISPATCH_GAP_S = 0.2  # the shared TPU has contention windows; spread samples
 
+PREFIXES = """PREFIX ds: <https://data.example/ontology#>
+PREFIX foaf: <http://xmlns.com/foaf/0.1/>
+"""
 
-def synth_employee_columns(n_triples=N_TRIPLES, seed=7):
-    """u32 (s, p, o) columns shaped like synthetic_data_employee_100K."""
-    rng = np.random.default_rng(seed)
-    n_emp = n_triples // N_PRED
-    emp = np.arange(1, n_emp + 1, dtype=np.uint32) * np.uint32(N_PRED)
-    s = np.repeat(emp, N_PRED)
-    p = np.tile(np.arange(N_PRED, dtype=np.uint32) + np.uint32(1), n_emp)
-    base = np.uint32(n_emp * N_PRED + 10)
-    o = base + rng.integers(0, 50_000, n_emp * N_PRED).astype(np.uint32)
-    perm = rng.permutation(len(s))
-    return s[perm], p[perm], o[perm]
+JOIN_QUERY = PREFIXES + """
+SELECT ?employee ?workplaceHomepage ?salary WHERE {
+    ?employee foaf:workplaceHomepage ?workplaceHomepage .
+    ?employee ds:annual_salary ?salary
+}
+"""
 
 
-def pso_slices(s, p, o):
-    """Store-build step: PSO sort + predicate slicing (host, done once)."""
-    order = np.lexsort((o, s, p))
-    ps, pp, po = s[order], p[order], o[order]
+def build_db():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
-    def sl(pred):
-        lo = np.searchsorted(pp, pred, "left")
-        hi = np.searchsorted(pp, pred, "right")
-        return ps[lo:hi], po[lo:hi]
-
-    return sl(P_WORKS + 1), sl(P_SALARY + 1)
-
-
-def device_bench(ls, lo_, rs, ro_):
-    import jax
-    import jax.numpy as jnp
-    from functools import partial
-    from jax import lax
-
-    @partial(jax.jit, static_argnames=("cap", "k"))
-    def merge_join_k(ls, lo_, rs, ro_, cap, k):
-        def body(carry, _):
-            # carry >= 0 always, but XLA can't prove it: off == 0 at
-            # runtime yet defeats loop-invariant hoisting of the body
-            off = (carry >> 31).astype(jnp.uint32)
-            lkey = ls + off
-            low = jnp.searchsorted(rs, lkey, side="left")
-            high = jnp.searchsorted(rs, lkey, side="right")
-            counts = (high - low).astype(jnp.int32)
-            cum = jnp.cumsum(counts)
-            total = cum[-1]
-            idx = jnp.arange(cap, dtype=jnp.int32)
-            row = jnp.searchsorted(cum, idx, side="right")
-            row_c = jnp.clip(row, 0, ls.shape[0] - 1)
-            pos = low[row_c] + (idx - (cum[row_c] - counts[row_c]))
-            jv = idx < total
-            emp = jnp.where(jv, lkey[row_c], 0)
-            w = jnp.where(jv, lo_[row_c], 0)
-            sal = jnp.where(jv, ro_[jnp.clip(pos, 0, rs.shape[0] - 1)], 0)
-            return total, (emp.sum(), w.sum(), sal.sum(), total)
-
-        _, outs = lax.scan(body, jnp.int32(0), None, length=k)
-        return outs
-
-    args = tuple(jnp.asarray(a) for a in (ls, lo_, rs, ro_))
-    out = merge_join_k(*args, JOIN_CAP, SCAN_K)
-    jax.block_until_ready(out)  # compile + warm
-    times = []
-    for _ in range(N_DISPATCH):
-        t0 = time.perf_counter()
-        out = merge_join_k(*args, JOIN_CAP, SCAN_K)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-        time.sleep(DISPATCH_GAP_S)
-    # Result readback AFTER all timing: through the axon tunnel, a single
-    # host read of any output element degrades every subsequent dispatch of
-    # the same executable from ~0.1ms to a stable ~380ms (measured), so the
-    # correctness check must not precede the measurement loop.
-    n_results = int(out[3][0])
-    per_join = min(times) / SCAN_K
-    return per_join, n_results, str(jax.devices()[0].platform)
-
-
-def host_bench(ls, lo_, rs, ro_, iters=10):
-    """Same merge join, numpy on host (single-node reference stand-in)."""
-
-    def run():
-        low = np.searchsorted(rs, ls, side="left")
-        high = np.searchsorted(rs, ls, side="right")
-        counts = high - low
-        li = np.repeat(np.arange(len(ls)), counts)
-        starts = np.repeat(low, counts)
-        offs = np.arange(counts.sum()) - np.repeat(
-            np.cumsum(counts) - counts, counts
+    db = SparqlDatabase()
+    lines = []
+    for i in range(N_EMPLOYEES):
+        e = f"<https://data.example/employee/{i}>"
+        lines.append(f'{e} <http://xmlns.com/foaf/0.1/name> "Employee {i}" .')
+        lines.append(f'{e} <https://data.example/ontology#title> "Engineer" .')
+        lines.append(
+            f"{e} <http://xmlns.com/foaf/0.1/workplaceHomepage> "
+            f"<https://company{i % 500}.example/> ."
         )
-        ri = starts + offs
-        return ls[li], lo_[li], ro_[ri]
-
-    run()
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        emp, w, sal = run()
-        times.append(time.perf_counter() - t0)
-    return min(times), len(emp)
+        lines.append(
+            f'{e} <https://data.example/ontology#annual_salary> '
+            f'"{30000 + (i % 50) * 1000}" .'
+        )
+    t0 = time.perf_counter()
+    db.parse_ntriples("\n".join(lines))
+    t_load = time.perf_counter() - t0
+    return db, t_load
 
 
 def main():
-    s, p, o = synth_employee_columns()
-    (ls, lo_), (rs, ro_) = pso_slices(s, p, o)
-    dev_t, n_results, platform = device_bench(ls, lo_, rs, ro_)
-    host_t, host_n = host_bench(ls, lo_, rs, ro_)
-    assert n_results == host_n, (n_results, host_n)
-    throughput = N_TRIPLES / dev_t
+    import jax
+
+    from kolibrie_tpu.optimizer.device_engine import PreparedQuery
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    db, t_load = build_db()
+    platform = jax.devices()[0].platform
+
+    # ---- host baseline: full e2e and operator-pipeline-only --------------
+    db.execution_mode = "host"
+    host_e2e = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        host_rows = execute_query_volcano(JOIN_QUERY, db)
+        host_e2e = min(host_e2e, time.perf_counter() - t0)
+
+    prep = PreparedQuery(db, JOIN_QUERY)
+    prep.calibrate()  # host-side exact capacities; no device I/O
+    host_exec = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _table, _counts = prep.lowered.host_execute()
+        host_exec = min(host_exec, time.perf_counter() - t0)
+
+    # ---- device: warm, then timed dispatches (NO readback in the loop) ---
+    out = prep.run()
+    jax.block_until_ready(out)
+    out = prep.run()
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(N_DISPATCH):
+        t0 = time.perf_counter()
+        out = prep.run()
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+        time.sleep(DISPATCH_GAP_S)
+    dev_t = min(times)
+
+    # ---- amortized: K plan executions per dispatch (tunnel latency is
+    # ~1ms/dispatch and swamps a sub-ms plan; the scan carries a dependency
+    # so XLA cannot hoist the body) -----------------------------------------
+    outk = prep.run_amortized(SCAN_K)
+    jax.block_until_ready(outk)
+    times_k = []
+    for _ in range(N_DISPATCH):
+        t0 = time.perf_counter()
+        outk = prep.run_amortized(SCAN_K)
+        jax.block_until_ready(outk)
+        times_k.append(time.perf_counter() - t0)
+        time.sleep(DISPATCH_GAP_S)
+    dev_tk = min(times_k) / SCAN_K
+
+    # ---- correctness AFTER timing (readback poisons later dispatches) ----
+    rows = prep.fetch(out)
+    assert rows == sorted(host_rows), (
+        f"device rows ({len(rows)}) != host rows ({len(host_rows)})"
+    )
+    import numpy as np
+
+    assert int(np.asarray(outk[1])[0]) == len(host_rows)
+
+    throughput = N_TRIPLES / dev_tk
     print(
         json.dumps(
             {
-                "metric": f"bgp_join_employee100k_triples_per_sec_{platform}",
+                "metric": f"bgp_join_employee100k_engine_triples_per_sec_{platform}",
                 "value": round(throughput, 1),
                 "unit": "triples/sec/chip",
-                "vs_baseline": round(host_t / dev_t, 3),
+                "vs_baseline": round(host_exec / dev_tk, 3),
+                "secondary": {
+                    "plan_exec_amortized_ms": round(1000 * dev_tk, 4),
+                    "single_dispatch_ms": round(1000 * dev_t, 3),
+                    "single_dispatch_triples_per_sec": round(N_TRIPLES / dev_t, 1),
+                    "host_engine_exec_ms": round(1000 * host_exec, 3),
+                    "host_e2e_ms": round(1000 * host_e2e, 2),
+                    "rows": len(rows),
+                    "bulk_load_s": round(t_load, 3),
+                    "note": "public-API prepared query: SPARQL parse + "
+                    "Streamertail plan once, then the plan's single XLA "
+                    "program over device-resident store orders; value = "
+                    f"throughput amortized over {SCAN_K} executions/dispatch "
+                    "(materialized columns produced every iteration); rows "
+                    "verified equal to the host numpy engine",
+                },
             }
         )
     )

@@ -94,10 +94,19 @@ class ColumnarTripleStore:
     reference, in columnar form.
     """
 
+    # The three primary orders cover every bound-combination lookup (the
+    # hexastore insight); the other three exist so scans can present ANY free
+    # column pre-sorted to the device engine's sort-free merge joins (the
+    # TPU analogue of the reference picking its PSO permutation for
+    # subject-keyed merge joins, join_algorithm.rs:19-131).  All are built
+    # lazily on first use.
     _ORDER_PERMS = {
         "spo": ("s", "p", "o"),
         "pos": ("p", "o", "s"),
         "osp": ("o", "s", "p"),
+        "pso": ("p", "s", "o"),
+        "ops": ("o", "p", "s"),
+        "sop": ("s", "o", "p"),
     }
 
     def __init__(self) -> None:
@@ -108,6 +117,7 @@ class ColumnarTripleStore:
         self._pending_del: set = set()
         self._orders: dict = {}
         self._device_cols = None
+        self._device_orders: dict = {}
         self._version = 0  # bumped on every compaction that changed data
 
     # ------------------------------------------------------------- mutation
@@ -149,6 +159,7 @@ class ColumnarTripleStore:
     def _invalidate(self) -> None:
         self._orders = {}
         self._device_cols = None
+        self._device_orders = {}
         self._version += 1
 
     def compact(self) -> None:
@@ -236,6 +247,40 @@ class ColumnarTripleStore:
                 jnp.asarray(self._o),
             )
         return self._device_cols
+
+    def device_order(self, name: str):
+        """Device (HBM) mirror of one sort order as canonical ``(s, p, o)``
+        columns in that order's row permutation, padded to a power of two
+        with ``0xFFFFFFFF`` sentinel rows (which sort after every real ID —
+        dictionary IDs use bits 0..30 plus the quoted bit 31, so u32-max is
+        never real).  Returns ``((s, p, o), true_len)``.
+
+        Padding to a power of two keeps jit executable shapes stable across
+        store versions of similar size (the device engine's compile cache).
+        """
+        self.compact()
+        cached = self._device_orders.get(name)
+        if cached is None:
+            import jax.numpy as jnp
+
+            so = self.order(name)
+            n = len(so)
+            padded = 128
+            while padded < n:
+                padded <<= 1
+            pad = padded - n
+
+            def dev(col):
+                if pad:
+                    col = np.concatenate(
+                        [col, np.full(pad, 0xFFFFFFFF, dtype=np.uint32)]
+                    )
+                return jnp.asarray(col)
+
+            canon = {so.perm[0]: so.c0, so.perm[1]: so.c1, so.perm[2]: so.c2}
+            cached = ((dev(canon["s"]), dev(canon["p"]), dev(canon["o"])), n)
+            self._device_orders[name] = cached
+        return cached
 
     def order(self, name: str) -> SortedOrder:
         self.compact()

@@ -46,6 +46,10 @@ from kolibrie_tpu.query.parser import parse_combined_query
 
 Rows = List[List[str]]
 
+# "auto" execution mode switches to the device engine at this store size;
+# db.execution_mode = "device" / "host" forces either path.
+_DEVICE_AUTO_MIN = 100_000
+
 
 # --------------------------------------------------------------------------
 # WHERE evaluation (shared by volcano executor, rules, RSP, ML input queries)
@@ -69,7 +73,16 @@ def eval_where(db, where: WhereClause, use_optimizer: bool = True) -> BindingTab
         stats = db.get_or_build_stats()
         planner = Streamertail(stats)
         plan = planner.find_best_plan(logical)
-        table = engine.execute_with_ids(plan)
+        table = None
+        mode = getattr(db, "execution_mode", "auto")
+        if mode == "device" or (
+            mode == "auto" and len(db.store) >= _DEVICE_AUTO_MIN
+        ):
+            from kolibrie_tpu.optimizer.device_engine import try_device_execute
+
+            table = try_device_execute(db, plan)
+        if table is None:
+            table = engine.execute_with_ids(plan)
     else:
         table = _naive_eval(engine, resolved, where, plan_filters)
     # subqueries join in

@@ -44,6 +44,9 @@ class SparqlDatabase:
         self.neural_relations: Dict[str, object] = {}
         self.trained_models: Dict[str, object] = {}
         self.probability_seeds: Dict[Tuple[int, int, int], float] = {}
+        # query execution: "auto" = device engine above a size threshold with
+        # host fallback; "device" forces the TPU path; "host" forces numpy
+        self.execution_mode: str = "auto"
         self._stats = None
         self._stats_version = -1
         self._numeric_cache: Optional[np.ndarray] = None
@@ -325,6 +328,7 @@ class SparqlDatabase:
         db.neural_relations = dict(self.neural_relations)
         db.trained_models = dict(self.trained_models)
         db.probability_seeds = dict(self.probability_seeds)
+        db.execution_mode = self.execution_mode
         return db
 
 
