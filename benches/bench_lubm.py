@@ -1,35 +1,44 @@
 """LUBM Q2/Q9 wall-clock + rule-closure + pod-sharded join (BASELINE
 configs 3 and 5).
 
-- Q2/Q9 run through the full engine (parse → Volcano → ID-space execute →
-  decode) over a generated LUBM-style KG (benches/lubm.py).
+- Q2/Q9 run through the full engine twice: host path (parse → Volcano →
+  numpy ID-space execute → decode) and device path (same parse/plan, the
+  plan compiled to one XLA program via ``PreparedQuery``).
 - The closure bench materializes transitive subOrganizationOf and
-  member-propagation rules with the semi-naive reasoner.
+  member-propagation rules with the host semi-naive reasoner AND the
+  single-dispatch device fixpoint (whole closure = one ``lax.while_loop``
+  program).
 - The sharded join runs the distributed BGP join (all-to-all partitioned)
   over a device mesh: the real chip when only one device is visible, or an
   8-device virtual CPU mesh under
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu.
 
+Each section runs in its OWN subprocess: through the axon tunnel a single
+device→host readback degrades every later dispatch in the process by
+orders of magnitude, so a section's result verification must not share a
+process with the next section's timing loop.
+
 Prints one JSON line per metric.
 """
 
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import numpy as np  # noqa: E402
 
 from lubm import LUBM_Q2, LUBM_Q9, UB, generate, predicate_ids  # noqa: E402
 
 N_UNIVERSITIES = 40
+SECTIONS = ("load", "queries_host", "queries_device", "closure", "sharded")
 
 
-def main():
-    from kolibrie_tpu.core.dictionary import Dictionary
-    from kolibrie_tpu.query.executor import execute_query_volcano
+def build_db():
     from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
     db = SparqlDatabase()
@@ -38,18 +47,29 @@ def main():
     db.store.add_batch(s, p, o)
     db.store.compact()
     t_gen = time.perf_counter() - t0
-    n = len(db.store)
+    return db, (s, p, o), t_gen
+
+
+def section_load():
+    db, _cols, t_gen = build_db()
     print(
         json.dumps(
             {
                 "metric": "lubm_generate_load",
                 "universities": N_UNIVERSITIES,
-                "triples": n,
+                "triples": len(db.store),
                 "seconds": round(t_gen, 3),
             }
         )
     )
 
+
+def section_queries_host():
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    db, _cols, _ = build_db()
+    db.execution_mode = "host"
+    n = len(db.store)
     for name, query in (("lubm_q2", LUBM_Q2), ("lubm_q9", LUBM_Q9)):
         best, rows = float("inf"), []
         for _ in range(3):
@@ -59,7 +79,7 @@ def main():
         print(
             json.dumps(
                 {
-                    "metric": f"{name}_wall_clock",
+                    "metric": f"{name}_host_wall_clock",
                     "rows": len(rows),
                     "ms": round(1000 * best, 2),
                     "triples_per_sec": round(n / best, 1),
@@ -67,9 +87,55 @@ def main():
             )
         )
 
-    # ---- config 3: rule closure (transitive org structure + membership)
+
+def section_queries_device():
+    import jax
+
+    from kolibrie_tpu.optimizer.device_engine import PreparedQuery
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    db, _cols, _ = build_db()
+    n = len(db.store)
+    preps = {}
+    for name, query in (("lubm_q2", LUBM_Q2), ("lubm_q9", LUBM_Q9)):
+        prep = PreparedQuery(db, query)
+        prep.calibrate()  # host-side exact capacities, no device I/O
+        preps[name] = (prep, query)
+    # ALL timed dispatches before ANY readback
+    results = {}
+    for name, (prep, _q) in preps.items():
+        out = prep.run()
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = prep.run()
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        results[name] = (best, out)
+    # verification readbacks
+    db.execution_mode = "host"
+    for name, (prep, query) in preps.items():
+        best, out = results[name]
+        rows = prep.fetch(out)
+        host_rows = sorted(execute_query_volcano(query, db))
+        assert rows == host_rows, f"{name}: device/host mismatch"
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name}_device_wall_clock",
+                    "rows": len(rows),
+                    "ms": round(1000 * best, 3),
+                    "triples_per_sec": round(n / best, 1),
+                }
+            )
+        )
+
+
+def _closure_reasoner(db, cols):
     from kolibrie_tpu.reasoner.reasoner import Reasoner
 
+    s, p, o = cols
     r = Reasoner(db.dictionary)
     r.facts.add_batch(s, p, o)
     sub = UB + "subOrganizationOf"
@@ -84,6 +150,20 @@ def main():
             [("?x", mem, "?d"), ("?d", sub, "?u")], [("?x", mem, "?u")]
         )
     )
+    return r
+
+
+def section_closure():
+    import jax
+
+    from kolibrie_tpu.reasoner.device_fixpoint import (
+        DeviceFixpoint,
+        _Caps,
+        _round_cap,
+    )
+
+    db, cols, _ = build_db()
+    r = _closure_reasoner(db, cols)
     before = len(r.facts)
     t0 = time.perf_counter()
     r.infer_new_facts_semi_naive()
@@ -101,18 +181,63 @@ def main():
         )
     )
 
-    # ---- config 5: sharded BGP join over the device mesh
+    # whole closure = ONE device dispatch; timed before any readback
+    r_dev = _closure_reasoner(db, cols)
+    fx = DeviceFixpoint(r_dev)
+    caps = _Caps(
+        fact=_round_cap(2 * (before + derived)),
+        delta=_round_cap(before),
+        join=_round_cap(4 * before, 1024),
+    )
+    t0 = time.perf_counter()
+    out = fx.run_raw(caps)  # compile + warm
+    jax.block_until_ready(out)
+    t_first = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = fx.run_raw(caps)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    # readback + verification AFTER timing
+    code = int(out[5])
+    assert code == 0, f"fixpoint overflow code {code} — raise bench caps"
+    n_out = int(out[3])
+    assert n_out - before == derived, (n_out - before, derived)
+    dev_set = set(
+        zip(
+            np.asarray(out[0][:n_out]).tolist(),
+            np.asarray(out[1][:n_out]).tolist(),
+            np.asarray(out[2][:n_out]).tolist(),
+        )
+    )
+    assert dev_set == r.facts.triples_set()
+    print(
+        json.dumps(
+            {
+                "metric": "lubm_rule_closure_device",
+                "derived": derived,
+                "rounds": int(out[4]),
+                "compile_s": round(t_first, 1),
+                "ms": round(1000 * best, 3),
+                "derived_per_sec": round(derived / max(best, 1e-9), 1),
+            }
+        )
+    )
+
+
+def section_sharded():
     import jax
 
     from kolibrie_tpu.parallel.dist_join import dist_bgp_join_count_device
     from kolibrie_tpu.parallel.mesh import make_mesh
     from kolibrie_tpu.parallel.sharded_store import ShardedTripleStore
 
+    db, (s, p, o), _ = build_db()
+    n = len(db.store)
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
     preds = predicate_ids(db.dictionary)
-    # cap sized by from_columns from the ACTUAL per-shard loads (rdf:type
-    # objects skew the object-hashed copy well past a uniform estimate)
     store = ShardedTripleStore.from_columns(mesh, s, p, o)
     p1, p2 = preds["advisor"], preds["teacherOf"]
     # Timing discipline: no host readback until all dispatches are timed.
@@ -138,12 +263,22 @@ def main():
                 "platform": jax.devices()[0].platform,
                 "matches": int(count),
                 "ms": round(1000 * t_join, 2),
-                "triples_per_sec_per_chip": round(
-                    n / t_join / max(n_dev, 1), 1
-                ),
+                "triples_per_sec_per_chip": round(n / t_join / max(n_dev, 1), 1),
             }
         )
     )
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--section"):
+        name = sys.argv[1].split("=", 1)[1] if "=" in sys.argv[1] else sys.argv[2]
+        globals()[f"section_{name}"]()
+        return
+    here = str(Path(__file__).resolve())
+    for name in SECTIONS:
+        subprocess.run(
+            [sys.executable, here, f"--section={name}"], check=True
+        )
 
 
 if __name__ == "__main__":

@@ -263,12 +263,11 @@ class ColumnarTripleStore:
         if cached is None:
             import jax.numpy as jnp
 
+            from kolibrie_tpu.ops import round_cap
+
             so = self.order(name)
             n = len(so)
-            padded = 128
-            while padded < n:
-                padded <<= 1
-            pad = padded - n
+            pad = round_cap(n) - n
 
             def dev(col):
                 if pad:

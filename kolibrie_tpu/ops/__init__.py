@@ -12,14 +12,36 @@ environments without a device.
 from kolibrie_tpu.ops.join import equi_join_tables, multi_key_pack
 from kolibrie_tpu.ops.unique import unique_rows
 
-__all__ = ["equi_join_tables", "multi_key_pack", "unique_rows"]
+_LAZY_KERNELS = ("merge_join", "filter_mask", "tag_combine")
+
+__all__ = [
+    "equi_join_tables",
+    "multi_key_pack",
+    "round_cap",
+    "unique_rows",
+    *_LAZY_KERNELS,
+]
+
+
+def round_cap(n: int, lo: int = 128) -> int:
+    """Round a buffer size up to a power of two (>= ``lo``) — the shared
+    capacity-rounding rule for every static-shape buffer, so jit executable
+    shapes stay stable across nearby sizes."""
+    c = lo
+    while c < n:
+        c <<= 1
+    return c
 
 
 def __getattr__(name):
     # Pallas kernels import jax.experimental.pallas; load lazily so the
     # numpy-only host paths stay importable in minimal environments.
-    if name in ("merge_join", "filter_mask", "tag_combine"):
+    if name in _LAZY_KERNELS:
         from kolibrie_tpu.ops import pallas_kernels
 
         return getattr(pallas_kernels, name)
     raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_KERNELS))

@@ -81,19 +81,23 @@ def join_indices(
         return z, z, jnp.zeros(cap, dtype=bool), jnp.int64(0)
     order = jnp.argsort(rkey)
     rsorted = rkey[order]
-    lo = jnp.searchsorted(rsorted, lkey, side="left")
-    hi = jnp.searchsorted(rsorted, lkey, side="right")
-    counts = (hi - lo).astype(jnp.int64)
+    # int32 positions/cumsum (i64 cumsum lowers to a VMEM-heavy
+    # reduce-window on TPU); the TRUE match count is an i64 reduction so a
+    # >2^31 blow-up is still detected by the caller's overflow check — the
+    # wrapped i32 cum only affects rows invalid in that case anyway
+    lo = jnp.searchsorted(rsorted, lkey, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rsorted, lkey, side="right").astype(jnp.int32)
+    counts = hi - lo
     # left padding rows can never match right rows (distinct sentinels)
     cum = jnp.cumsum(counts)
-    total = cum[-1] if ln else jnp.int64(0)
-    idx = jnp.arange(cap, dtype=jnp.int64)
-    row = jnp.searchsorted(cum, idx, side="right")
+    total = jnp.sum(counts.astype(jnp.int64)) if ln else jnp.int64(0)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    row = jnp.searchsorted(cum, idx, side="right").astype(jnp.int32)
     row_c = jnp.clip(row, 0, max(ln - 1, 0))
     start = cum[row_c] - counts[row_c]
     pos = lo[row_c] + (idx - start)
     valid = idx < total
-    li = jnp.where(valid, row_c, 0).astype(jnp.int32)
+    li = jnp.where(valid, row_c, 0)
     ri = jnp.where(valid, order[jnp.clip(pos, 0, max(rn - 1, 0))], 0).astype(
         jnp.int32
     )

@@ -253,25 +253,36 @@ def _xla_merge_join(lkey, lval, rkey, rval, cap):
 _OPS = {"eq": 0, "ne": 1, "lt": 2, "le": 3, "gt": 4, "ge": 5}
 
 
+_I32_MIN = -(1 << 31)
+
+
 def _filter_kernel(consts_ref, s_ref, p_ref, o_ref, mask_ref):
-    s_c, p_c, o_c = consts_ref[0], consts_ref[1], consts_ref[2]
-    o_op, o_cmp = consts_ref[3], consts_ref[4]
-    # Boolean algebra only (Mosaic has no i1-vector select): a wildcard
-    # constant (< 0) makes its clause vacuously true via scalar broadcast.
-    m = (s_ref[...] == s_c) | (s_c < 0)
-    m &= (p_ref[...] == p_c) | (p_c < 0)
-    m &= (o_ref[...] == o_c) | (o_c < 0)
+    # consts layout: [s_val, s_active, p_val, p_active, o_val, o_active,
+    #                 o_op, o_cmp]; values are u32 bit patterns carried in
+    # i32.  Equality is bit-exact either way; ordered comparisons flip the
+    # sign bit (x ^ i32min) so i32 compare == unsigned u32 compare — IDs
+    # with bit 31 set (quoted triples) order correctly.
+    s_c, s_on = consts_ref[0], consts_ref[1]
+    p_c, p_on = consts_ref[2], consts_ref[3]
+    o_c, o_on = consts_ref[4], consts_ref[5]
+    o_op, o_cmp = consts_ref[6], consts_ref[7]
+    # Boolean algebra only (Mosaic has no i1-vector select): an inactive
+    # clause is vacuously true via scalar broadcast.
+    m = (s_ref[...] == s_c) | (s_on == 0)
+    m &= (p_ref[...] == p_c) | (p_on == 0)
+    m &= (o_ref[...] == o_c) | (o_on == 0)
     o = o_ref[...]
+    ob = o ^ _I32_MIN
+    cb = o_cmp ^ _I32_MIN
     m &= (o == o_cmp) | (o_op != 0)
     m &= (o != o_cmp) | (o_op != 1)
-    m &= (o < o_cmp) | (o_op != 2)
-    m &= (o <= o_cmp) | (o_op != 3)
-    m &= (o > o_cmp) | (o_op != 4)
-    m &= (o >= o_cmp) | (o_op != 5)
+    m &= (ob < cb) | (o_op != 2)
+    m &= (ob <= cb) | (o_op != 3)
+    m &= (ob > cb) | (o_op != 4)
+    m &= (ob >= cb) | (o_op != 5)
     mask_ref[...] = m
 
 
-@jax.jit
 def filter_mask(
     s: jnp.ndarray,
     p: jnp.ndarray,
@@ -288,17 +299,49 @@ def filter_mask(
     comparison on the object column (numeric filters compare encoded IDs the
     caller has mapped to an order-preserving key, as the reference's SIMD
     path compares raw epoch/ID words).  One pass over HBM, mask out.
+
+    Constants and comparands cover the FULL u32 range (quoted-triple IDs
+    have bit 31 set): values ride as u32 bit patterns in i32 with a
+    sign-bit flip for the ordered comparisons inside the kernel.  The
+    constants travel in the scalar-prefetch operand (traced, not static),
+    so every constant combination shares ONE compiled executable.
     """
+
+    def bits(v) -> int:
+        return int(np.uint32(v).view(np.int32))
+
+    consts = np.array(
+        [
+            bits(s_const) if s_const >= 0 else 0,
+            1 if s_const >= 0 else 0,
+            bits(p_const) if p_const >= 0 else 0,
+            1 if p_const >= 0 else 0,
+            bits(o_const) if o_const >= 0 else 0,
+            1 if o_const >= 0 else 0,
+            int(o_op),
+            bits(o_cmp),
+        ],
+        np.int32,
+    )
+    return _filter_mask_jit(consts, s, p, o)
+
+
+@jax.jit
+def _filter_mask_jit(consts, s, p, o) -> jnp.ndarray:
     n = s.shape[0]
     n_chunks = max(1, -(-n // (_CHUNK_ROWS * TILE)))
     rows = n_chunks * _CHUNK_ROWS
     pad = rows * TILE - n
 
     def shape2d(x):
-        x = jnp.concatenate([x.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+        x = jnp.concatenate(
+            [
+                lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32),
+                jnp.zeros(pad, jnp.int32),
+            ]
+        )
         return x.reshape(rows, TILE)
 
-    consts = jnp.array([s_const, p_const, o_const, o_op, o_cmp], jnp.int32)
     block = pl.BlockSpec((_CHUNK_ROWS, TILE), lambda i, *_: (i, 0))
     mask2d = pl.pallas_call(
         _filter_kernel,

@@ -55,18 +55,11 @@ from kolibrie_tpu.query.ast import (
 
 __all__ = ["Unsupported", "lower_plan", "try_device_execute", "PreparedQuery"]
 
-_MIN_CAP = 128
+from kolibrie_tpu.ops import round_cap as _round_cap
 
 
 class Unsupported(Exception):
     """Plan construct the device path cannot express (host fallback)."""
-
-
-def _round_cap(n: int) -> int:
-    c = _MIN_CAP
-    while c < n:
-        c <<= 1
-    return c
 
 
 # ---------------------------------------------------------------------------
@@ -895,27 +888,36 @@ class LoweredPlan:
             self._join_caps
         )
 
-    def execute(self) -> BindingTable:
-        """Run to completion with capacity validation; returns a host table."""
-        for _attempt in range(12):
-            out_cols, valid, counts = self.run()
+    def converge(self, out, max_attempts: int = 12):
+        """Validate join counts against the capacities ``out`` ran with;
+        re-run with doubled capacities until everything fits (the one
+        overflow protocol shared by every consumer).  Returns
+        ``(out_cols, valid)`` — readback of the counts happens here."""
+        for _attempt in range(max_attempts):
+            out_cols, valid, counts = out
             counts_h = [int(c) for c in counts]
             overflow = [
                 i for i, c in enumerate(counts_h) if c > self._join_caps[i]
             ]
             if not overflow:
                 self._store_caps()
-                break
+                return out_cols, valid
             for i in overflow:
                 self._join_caps[i] = _round_cap(2 * counts_h[i])
             self._store_caps()
-        else:
-            raise RuntimeError("device plan capacities failed to converge")
+            out = self.run()
+        raise RuntimeError("device plan capacities failed to converge")
+
+    def to_table(self, out_cols, valid) -> BindingTable:
         valid_h = np.asarray(valid)
-        table: BindingTable = {}
-        for var, col in zip(self.out_vars, out_cols):
-            table[var] = np.asarray(col)[valid_h].astype(np.uint32)
-        return table
+        return {
+            var: np.asarray(col)[valid_h].astype(np.uint32)
+            for var, col in zip(self.out_vars, out_cols)
+        }
+
+    def execute(self) -> BindingTable:
+        """Run to completion with capacity validation; returns a host table."""
+        return self.to_table(*self.converge(self.run()))
 
 
 def lower_plan(db, plan) -> LoweredPlan:
@@ -994,26 +996,7 @@ class PreparedQuery:
         doubled and the query re-runs — no silent truncation."""
         from kolibrie_tpu.query.executor import format_results
 
-        out_cols, valid, counts = out
-        for _attempt in range(12):
-            counts_h = [int(c) for c in counts]
-            overflow = [
-                i
-                for i, c in enumerate(counts_h)
-                if c > self.lowered._join_caps[i]
-            ]
-            if not overflow:
-                break
-            for i in overflow:
-                self.lowered._join_caps[i] = _round_cap(2 * counts_h[i])
-            self.lowered._store_caps()
-            out_cols, valid, counts = self.lowered.run()
-        else:
-            raise RuntimeError("device plan capacities failed to converge")
-        valid_h = np.asarray(valid)
-        table: BindingTable = {}
-        for var, col in zip(self.lowered.out_vars, out_cols):
-            table[var] = np.asarray(col)[valid_h].astype(np.uint32)
+        table = self.lowered.to_table(*self.lowered.converge(out))
         rows = format_results(self.db, table, self.query)
         rows.sort()
         return rows

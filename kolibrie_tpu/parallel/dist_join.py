@@ -266,7 +266,12 @@ def _bgp_count_fn(mesh):
         packed = subj_packed[0]  # PRE-SORTED (pred<<32|subj) — no sort here
         lv = ov & (op == p1)
         p2_hi = p2.astype(jnp.uint64) << jnp.uint64(32)
-        # invalid left rows get a probe key beyond every real packed key
+        # Invalid left rows get a probe key beyond every real packed key.
+        # This relies on dictionary IDs never reaching 0xFFFFFFFF (IDs use
+        # bits 0..30 + quoted bit 31, asserted in core.dictionary): a real
+        # (pred, subj) = (0xFFFFFFFF, 0xFFFFFFFF) row would be
+        # indistinguishable from the all-ones padding in subj_packed_sorted
+        # and a probe for it would overcount against padding entries.
         lkey = jnp.where(
             lv, p2_hi | oo.astype(jnp.uint64), jnp.uint64(0xFFFFFFFFFFFFFFFF)
         )

@@ -138,13 +138,30 @@ class Reasoner:
 
         return infer_semi_naive(self)
 
+    # facts below this size run the host path even in "auto" mode — a device
+    # dispatch + compile outweighs a small numpy fixpoint
+    _DEVICE_AUTO_MIN_FACTS = 50_000
+
     def infer_new_facts_semi_naive_parallel(self) -> int:
         """The vectorized/batched strategy — the rebuild's analogue of the
-        rayon-parallel path (semi_naive_parallel.rs); on device this is the
-        pjit-sharded fixpoint body."""
+        rayon-parallel path (semi_naive_parallel.rs).  Above a size
+        threshold the whole fixpoint runs as one device program
+        (:mod:`kolibrie_tpu.reasoner.device_fixpoint`); rules the device
+        path can't express fall back to the host strategy."""
+        if len(self.facts) >= self._DEVICE_AUTO_MIN_FACTS:
+            derived = self.infer_new_facts_device()
+            if derived is not None:
+                return derived
         from kolibrie_tpu.reasoner.strategies import infer_semi_naive
 
         return infer_semi_naive(self)
+
+    def infer_new_facts_device(self) -> Optional[int]:
+        """On-device semi-naive fixpoint (one XLA dispatch for the whole
+        closure); ``None`` if the rule set can't be lowered."""
+        from kolibrie_tpu.reasoner.device_fixpoint import infer_semi_naive_device
+
+        return infer_semi_naive_device(self)
 
     def infer_new_facts_with_repairs(self) -> int:
         from kolibrie_tpu.reasoner.repairs import infer_semi_naive_with_repairs

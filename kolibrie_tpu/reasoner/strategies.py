@@ -122,7 +122,9 @@ def _join_quoted_position(
 
 
 def _apply_rule_filters(reasoner, rule: Rule, table: BindingTable) -> BindingTable:
-    """Vectorized-ish filter pass (rules.rs:133-165 ``evaluate_filters``)."""
+    """Vectorized filter pass (rules.rs:133-165 ``evaluate_filters``): each
+    filter is evaluated once per DISTINCT id in its column (RDF columns are
+    highly repetitive) and broadcast back with the unique-inverse map."""
     n = table_len(table)
     if n == 0 or not rule.filters:
         return table
@@ -133,9 +135,13 @@ def _apply_rule_filters(reasoner, rule: Rule, table: BindingTable) -> BindingTab
         if col is None:
             mask[:] = False
             break
-        for i in range(n):
-            if mask[i] and not f.evaluate(int(col[i]), decode):
-                mask[i] = False
+        uniq, inv = np.unique(col, return_inverse=True)
+        verdicts = np.fromiter(
+            (f.evaluate(int(u), decode) for u in uniq),
+            dtype=bool,
+            count=len(uniq),
+        )
+        mask &= verdicts[inv]
     return {k: v[mask] for k, v in table.items()}
 
 
@@ -260,15 +266,29 @@ def instantiate_conclusions(rule: Rule, table: BindingTable, quoted=None) -> Col
 
 
 def subtract_existing(store: ColumnarTripleStore, cols: Cols) -> Cols:
-    """Keep only rows not already in the store (sort-based membership)."""
+    """Keep only rows not already in the store — vectorized membership:
+    dense-rank the (s, p) pairs over both sides, pack with o into one u64
+    key per row, then one sorted-membership probe (the host twin of
+    ``ops.device_join._row_membership``)."""
     s, p, o = cols
     if len(s) == 0:
         return cols
-    keep = np.fromiter(
-        (not store.contains(int(a), int(b), int(c)) for a, b, c in zip(s, p, o)),
-        dtype=bool,
-        count=len(s),
-    )
+    ss, sp, so = store.columns()
+    if len(ss) == 0:
+        return cols
+
+    def pack2(a, b):
+        return (a.astype(np.uint64) << np.uint64(32)) | b.astype(np.uint64)
+
+    osp = pack2(s, p)
+    tsp = pack2(ss, sp)
+    sorted_u = np.sort(np.concatenate([osp, tsp]))
+    rank_o = np.searchsorted(sorted_u, osp).astype(np.uint32)
+    rank_t = np.searchsorted(sorted_u, tsp).astype(np.uint32)
+    from kolibrie_tpu.ops.join import semi_join_mask
+
+    member = semi_join_mask(pack2(rank_o, o), pack2(rank_t, so))
+    keep = ~member
     return s[keep], p[keep], o[keep]
 
 

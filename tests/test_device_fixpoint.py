@@ -1,0 +1,215 @@
+"""Agreement tests: on-device semi-naive fixpoint vs host strategies.
+
+The host semi-naive strategy is the oracle (same pattern as the reference's
+naive-vs-incremental agreement tests, SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu.core.rule import FilterCondition
+from kolibrie_tpu.reasoner.device_fixpoint import (
+    DeviceFixpoint,
+    Unsupported,
+    infer_semi_naive_device,
+)
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+
+def both_closures(build):
+    """Run host and device fixpoints on identical reasoners; return fact sets."""
+    r_host = build()
+    r_host.infer_new_facts_semi_naive()
+    r_dev = build()
+    derived = infer_semi_naive_device(r_dev)
+    assert derived is not None, "device path refused a lowerable rule set"
+    return r_host.facts.triples_set(), r_dev.facts.triples_set(), derived
+
+
+def test_transitive_closure_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(30):
+            r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    host, dev, derived = both_closures(build)
+    assert host == dev
+    assert derived > 0
+
+
+def test_multi_rule_cascade_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(20):
+            r.add_abox_triple(f"p{i}", "worksAt", f"org{i % 4}")
+            r.add_abox_triple(f"org{i % 4}", "partOf", "corp")
+        r.add_abox_triple("corp", "locatedIn", "city")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "worksAt", "?o"), ("?o", "partOf", "?c")],
+                [("?x", "memberOf", "?c")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "memberOf", "?c"), ("?c", "locatedIn", "?l")],
+                [("?x", "basedIn", "?l")],
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+
+
+def test_three_premise_rule_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(12):
+            r.add_abox_triple(f"a{i}", "p", f"b{i % 5}")
+            r.add_abox_triple(f"b{i % 5}", "q", f"c{i % 3}")
+            r.add_abox_triple(f"c{i % 3}", "r", f"d{i % 2}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y"), ("?y", "q", "?z"), ("?z", "r", "?w")],
+                [("?x", "reach", "?w")],
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+
+
+def test_naf_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(10):
+            r.add_abox_triple(f"s{i}", "hasPart", f"t{i}")
+        r.add_abox_triple("t3", "broken", "yes")
+        r.add_abox_triple("t7", "broken", "yes")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "hasPart", "?y")],
+                [("?x", "works", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+    # the two broken parts must be excluded
+    r = Reasoner()
+    assert len([t for t in host if t not in set()]) == len(host)
+
+
+def test_numeric_filter_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(12):
+            r.add_abox_triple(f"item{i}", "price", f'"{i * 10}"')
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "price", "?v")],
+                [("?x", "expensive", "yes")],
+                filters=[FilterCondition("v", ">", 60.0)],
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+
+
+def test_multi_head_and_constants_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(8):
+            r.add_abox_triple(f"x{i}", "type", "Widget")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "type", "Widget")],
+                [("?x", "category", "product"), ("?x", "taxed", "yes")],
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+
+
+def test_diamond_no_duplicates():
+    def build():
+        r = Reasoner()
+        r.add_abox_triple("a", "e", "b1")
+        r.add_abox_triple("a", "e", "b2")
+        r.add_abox_triple("b1", "e", "c")
+        r.add_abox_triple("b2", "e", "c")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "e", "?y"), ("?y", "e", "?z")], [("?x", "e", "?z")]
+            )
+        )
+        return r
+
+    host, dev, _ = both_closures(build)
+    assert host == dev
+
+
+def test_capacity_doubling_converges():
+    """Tiny initial capacities must converge via overflow-driven doubling."""
+    r = Reasoner()
+    for i in range(40):
+        r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "next", "?y"), ("?y", "next", "?z")], [("?x", "next", "?z")]
+        )
+    )
+    fx = DeviceFixpoint(r)
+    from kolibrie_tpu.reasoner.device_fixpoint import _Caps
+
+    fx._caps = lambda n: _Caps(fact=128, delta=128, join=128)
+    derived = fx.infer()
+    r2 = Reasoner()
+    for i in range(40):
+        r2.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+    r2.add_rule(
+        r2.rule_from_strings(
+            [("?x", "next", "?y"), ("?y", "next", "?z")], [("?x", "next", "?z")]
+        )
+    )
+    r2.infer_new_facts_semi_naive()
+    assert r.facts.triples_set() == r2.facts.triples_set()
+    assert derived > 0
+
+
+def test_unsupported_rules_return_none():
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    # cartesian premise join is not expressible on the device path
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y"), ("?u", "q", "?v")], [("?x", "r", "?u")]
+        )
+    )
+    assert infer_semi_naive_device(r) is None
+
+
+def test_idempotent_on_closed_set():
+    r = Reasoner()
+    r.add_abox_triple("a", "next", "b")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "next", "?y"), ("?y", "next", "?z")], [("?x", "next", "?z")]
+        )
+    )
+    assert infer_semi_naive_device(r) == 0

@@ -169,6 +169,37 @@ class TestFilterMask:
         m = np.asarray(filter_mask(jnp.asarray(o), jnp.asarray(o), jnp.asarray(o)))
         assert m.all()
 
+    def test_full_u32_range(self):
+        """IDs >= 2^31 (quoted-triple bit set) must compare as unsigned:
+        equality against a high constant and ordered comparisons across the
+        sign-bit boundary both stay exact."""
+        o = np.array(
+            [5, 0x7FFFFFFF, 0x80000000, 0x90000001, 0xFFFFFFFE], dtype=np.uint32
+        )
+        s = o.copy()
+        p = o.copy()
+        m = np.asarray(
+            filter_mask(
+                jnp.asarray(s), jnp.asarray(p), jnp.asarray(o),
+                s_const=0x90000001,
+            )
+        )
+        assert (m == (s == 0x90000001)).all()
+        m = np.asarray(
+            filter_mask(
+                jnp.asarray(s), jnp.asarray(p), jnp.asarray(o),
+                o_op=4, o_cmp=0x80000000,
+            )
+        )
+        assert (m == (o.astype(np.uint64) > 0x80000000)).all()
+        m = np.asarray(
+            filter_mask(
+                jnp.asarray(s), jnp.asarray(p), jnp.asarray(o),
+                o_op=2, o_cmp=0x90000001,
+            )
+        )
+        assert (m == (o.astype(np.uint64) < 0x90000001)).all()
+
 
 class TestTagCombine:
     def test_ops(self):
