@@ -6,8 +6,9 @@ this package is the TPU-native scale-out axis it never had:
 - triple columns hash-partitioned across chips (:mod:`sharded_store`),
 - partitioned hash joins with ``all_to_all`` repartitioning over ICI
   (:mod:`dist_join`),
-- distributed semi-naive fixpoint with ``psum`` termination
-  (:mod:`dist_fixpoint`),
+- distributed semi-naive fixpoint with ``psum`` termination: a fast path
+  for unary/binary-chain rules (:mod:`dist_fixpoint`) and a general path
+  for arbitrary premise counts/constants/filters/NAF (:mod:`dist_general`),
 - data-parallel neural-predicate training (:mod:`train_step`).
 
 Everything compiles under ``jit`` + ``shard_map`` with STATIC shapes (padded
@@ -24,6 +25,10 @@ from kolibrie_tpu.parallel.dist_fixpoint import (
     DistributedReasoner,
     distributed_seminaive,
 )
+from kolibrie_tpu.parallel.dist_general import (
+    DistGeneralReasoner,
+    distributed_seminaive_general,
+)
 from kolibrie_tpu.parallel.train_step import (
     dp_train_step,
     make_train_state,
@@ -38,7 +43,9 @@ __all__ = [
     "dist_bgp_join_count",
     "DistRuleSet",
     "DistributedReasoner",
+    "DistGeneralReasoner",
     "distributed_seminaive",
+    "distributed_seminaive_general",
     "dp_train_step",
     "make_train_state",
     "neurosymbolic_step",
