@@ -47,9 +47,6 @@ TILE = 128  # output tile width = one lane row
 _WPAD = 8  # sublane alignment padding for the left-row window
 W = TILE + _WPAD
 _CHUNK_ROWS = 256  # grid chunk height for elementwise kernels (128KB/col)
-# Above this many rows per side the whole-array VMEM residency of the join
-# kernel would blow the ~16MB budget; fall back to the XLA formulation.
-_VMEM_ROW_LIMIT = 200_000
 
 
 def _interpret() -> bool:
@@ -63,26 +60,54 @@ def _interpret() -> bool:
 
 def _merge_join_kernel(
     row_start_ref,  # scalar-prefetch: (n_tiles + 1,) int32; last slot = total
-    lkey_ref,  # (Lpad + W, 1) compacted left keys
-    lval_ref,  # (Lpad + W, 1) compacted left payloads
-    low_ref,  # (Lpad + W, 1) right-run start per compacted left row
-    cum_ref,  # (Lpad + W, 1) inclusive cumsum of run lengths
-    cumprev_ref,  # (Lpad + W, 1) exclusive cumsum (cum shifted right)
-    key_out_ref,  # (1, T) joined key
-    lval_out_ref,  # (1, T) left payload
-    pos_out_ref,  # (1, T) right row index (caller gathers right payload)
-    valid_out_ref,  # (1, T) int32 0/1 mask
+    lkey_ref,  # HBM (Lpad + W, 1) compacted left keys
+    lval_ref,  # HBM (Lpad + W, 1) compacted left payloads
+    low_ref,  # HBM (Lpad + W, 1) right-run start per compacted left row
+    cum_ref,  # HBM (Lpad + W, 1) inclusive cumsum of run lengths
+    cumprev_ref,  # HBM (Lpad + W, 1) exclusive cumsum (cum shifted right)
+    key_out_ref,  # (1, T) block: joined key
+    lval_out_ref,  # (1, T) block: left payload
+    pos_out_ref,  # (1, T) block: right row index (caller gathers payload)
+    valid_out_ref,  # (1, T) block: int32 0/1 mask
+    lkey_w_ref,  # VMEM scratch (W, 1)
+    lval_w_ref,
+    low_w_ref,
+    cum_w_ref,
+    cumprev_w_ref,
+    sems,  # DMA semaphores (5,)
 ):
     t = pl.program_id(0)
     rstart = row_start_ref[t]
     total = row_start_ref[pl.num_programs(0)]
 
-    # Static-size left-row window for this tile (bound proof in module doc).
-    cum_w = cum_ref[pl.ds(rstart, W), :]  # (W, 1)
-    low_w = low_ref[pl.ds(rstart, W), :]
-    lkey_w = lkey_ref[pl.ds(rstart, W), :]
-    lval_w = lval_ref[pl.ds(rstart, W), :]
-    cumprev0 = cumprev_ref[rstart, 0]
+    # The per-row arrays stay in HBM (they scale with the LEFT side, which
+    # may be millions of rows); only the static W-row window this tile needs
+    # is DMA'd into VMEM — this is what removes the old whole-array VMEM
+    # residency limit (~200K rows).
+    copies = [
+        pltpu.make_async_copy(
+            src.at[pl.ds(rstart, W), :], dst, sems.at[i]
+        )
+        for i, (src, dst) in enumerate(
+            (
+                (lkey_ref, lkey_w_ref),
+                (lval_ref, lval_w_ref),
+                (low_ref, low_w_ref),
+                (cum_ref, cum_w_ref),
+                (cumprev_ref, cumprev_w_ref),
+            )
+        )
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    cum_w = cum_w_ref[...]  # (W, 1)
+    low_w = low_w_ref[...]
+    lkey_w = lkey_w_ref[...]
+    lval_w = lval_w_ref[...]
+    cumprev0 = cumprev_w_ref[0, 0]
 
     k = t * TILE + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)  # (1,T)
 
@@ -107,10 +132,10 @@ def _merge_join_kernel(
 
     valid = (k < total).astype(jnp.int32)
     pos = low_k + (k - cum_ex)
-    key_out_ref[pl.ds(t, 1), :] = valid * key_k
-    lval_out_ref[pl.ds(t, 1), :] = valid * lval_k
-    pos_out_ref[pl.ds(t, 1), :] = valid * pos
-    valid_out_ref[pl.ds(t, 1), :] = valid
+    key_out_ref[...] = valid * key_k
+    lval_out_ref[...] = valid * lval_k
+    pos_out_ref[...] = valid * pos
+    valid_out_ref[...] = valid
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -149,9 +174,6 @@ def merge_join(
         z = jnp.zeros(cap, jnp.uint32)
         return z, z, z, jnp.zeros(cap, bool), jnp.int32(0)
 
-    if max(lkey.shape[0], rkey.shape[0]) > _VMEM_ROW_LIMIT:
-        return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
-
     # --- XLA pre-pass -----------------------------------------------------
     low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
     high = jnp.searchsorted(rkey_u, lkey_u, side="right").astype(jnp.int32)
@@ -188,13 +210,15 @@ def merge_join(
     cumprev_p = padded(cumprev, 0)
     cumprev_p = cumprev_p.at[lkey_c.shape[0] :, 0].set(big)
 
+    out_block = pl.BlockSpec((1, TILE), lambda t, *_: (t, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(4)
-        ],
+        # per-row arrays stay off-chip; the kernel DMAs its W-row window
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 5,
+        out_specs=[out_block] * 4,
+        scratch_shapes=[pltpu.VMEM((W, 1), jnp.int32)] * 5
+        + [pltpu.SemaphoreType.DMA((5,))],
     )
     out_shape = [
         jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32) for _ in range(4)
