@@ -18,6 +18,7 @@ device (HBM) for kernel-side joins.
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -25,6 +26,8 @@ import numpy as np
 from kolibrie_tpu.core.triple import Triple
 
 _EMPTY = np.empty(0, dtype=np.uint32)
+
+_VERSION_COUNTER = itertools.count(1)
 
 
 def _lex_sort_rows(s: np.ndarray, p: np.ndarray, o: np.ndarray):
@@ -47,13 +50,18 @@ class SortedOrder:
 
     __slots__ = ("perm", "c0", "c1", "c2", "key01")
 
-    def __init__(self, perm: Tuple[str, str, str], cols: dict):
+    def __init__(self, perm: Tuple[str, str, str], cols: dict, presorted: bool = False):
         self.perm = perm
         a, b, c = (cols[perm[0]], cols[perm[1]], cols[perm[2]])
-        order = _lex_sort_rows(a, b, c)
-        self.c0 = a[order]
-        self.c1 = b[order]
-        self.c2 = c[order]
+        if presorted:
+            # caller guarantees (a, b, c) is already lexsorted — the store's
+            # canonical columns ARE the SPO order
+            self.c0, self.c1, self.c2 = a, b, c
+        else:
+            order = _lex_sort_rows(a, b, c)
+            self.c0 = a[order]
+            self.c1 = b[order]
+            self.c2 = c[order]
         self.key01 = _pack2(self.c0, self.c1)
 
     def __len__(self) -> int:
@@ -118,7 +126,13 @@ class ColumnarTripleStore:
         self._orders: dict = {}
         self._device_cols = None
         self._device_orders: dict = {}
-        self._version = 0  # bumped on every compaction that changed data
+        self._triples_set_cache = None  # (version, set) memo
+        # Globally-unique version per compacted state: two stores (or one
+        # store at two times) share a version IFF they hold identical column
+        # arrays.  snapshot/restore reuses the saved state's version, so a
+        # post-restore compaction must never collide with a version handed
+        # out before the restore — hence a process-wide counter, not +1.
+        self._version = next(_VERSION_COUNTER)
 
     # ------------------------------------------------------------- mutation
 
@@ -160,39 +174,92 @@ class ColumnarTripleStore:
         self._orders = {}
         self._device_cols = None
         self._device_orders = {}
-        self._version += 1
+        self._version = next(_VERSION_COUNTER)
 
     def compact(self) -> None:
         if not self._pending_add and not self._pending_del:
             return
-        parts_s = [self._s]
-        parts_p = [self._p]
-        parts_o = [self._o]
+        parts_s = []
+        parts_p = []
+        parts_o = []
         singles = []
+        n_add = 0
         for item in self._pending_add:
             if isinstance(item, tuple):
                 singles.append(item)
+                n_add += 1
             else:
                 parts_s.append(item[:, 0])
                 parts_p.append(item[:, 1])
                 parts_o.append(item[:, 2])
+                n_add += len(item)
         if singles:
             arr = np.asarray(singles, dtype=np.uint32)
             parts_s.append(arr[:, 0])
             parts_p.append(arr[:, 1])
             parts_o.append(arr[:, 2])
-        s = np.concatenate(parts_s)
-        p = np.concatenate(parts_p)
-        o = np.concatenate(parts_o)
         self._pending_add = []
-        if len(s):
-            order = _lex_sort_rows(s, p, o)
-            s, p, o = s[order], p[order], o[order]
-            # unique: drop consecutive duplicate rows
-            if len(s) > 1:
-                dup = (s[1:] == s[:-1]) & (p[1:] == p[:-1]) & (o[1:] == o[:-1])
+        n = len(self._s)
+        if not n_add:
+            s, p, o = self._s, self._p, self._o
+        elif n_add * 16 < n:
+            # Small batch into a big sorted base: merge-insert by binary
+            # search — O(batch·log n) probes + one O(n) copy — instead of
+            # re-lexsorting the whole store (the fixpoint engines append a
+            # few derived rows per round; a full O(n log n) sort per round
+            # made every seeded closure cost O(store), not O(cone)).
+            a_s = np.concatenate(parts_s)
+            a_p = np.concatenate(parts_p)
+            a_o = np.concatenate(parts_o)
+            order = _lex_sort_rows(a_s, a_p, a_o)
+            a_s, a_p, a_o = a_s[order], a_p[order], a_o[order]
+            if len(a_s) > 1:
+                dup = (
+                    (a_s[1:] == a_s[:-1])
+                    & (a_p[1:] == a_p[:-1])
+                    & (a_o[1:] == a_o[:-1])
+                )
                 keep = np.concatenate(([True], ~dup))
-                s, p, o = s[keep], p[keep], o[keep]
+                a_s, a_p, a_o = a_s[keep], a_p[keep], a_o[keep]
+            key01 = _pack2(self._s, self._p)
+            bkey = _pack2(a_s, a_p)
+            lo = np.searchsorted(key01, bkey, side="left")
+            hi = np.searchsorted(key01, bkey, side="right")
+            pos = lo.astype(np.int64)
+            fresh = np.ones(len(a_s), dtype=bool)
+            base_o = self._o
+            # only rows landing in an existing (s, p) group need the o probe
+            for i in np.flatnonzero(hi > lo):
+                sub = base_o[lo[i] : hi[i]]
+                l2 = int(np.searchsorted(sub, a_o[i], side="left"))
+                pos[i] = lo[i] + l2
+                if l2 < len(sub) and sub[l2] == a_o[i]:
+                    fresh[i] = False  # already present
+            if fresh.all():
+                s = np.insert(self._s, pos, a_s)
+                p = np.insert(self._p, pos, a_p)
+                o = np.insert(self._o, pos, a_o)
+            elif fresh.any():
+                s = np.insert(self._s, pos[fresh], a_s[fresh])
+                p = np.insert(self._p, pos[fresh], a_p[fresh])
+                o = np.insert(self._o, pos[fresh], a_o[fresh])
+            else:
+                s, p, o = self._s, self._p, self._o
+        else:
+            parts_s.insert(0, self._s)
+            parts_p.insert(0, self._p)
+            parts_o.insert(0, self._o)
+            s = np.concatenate(parts_s)
+            p = np.concatenate(parts_p)
+            o = np.concatenate(parts_o)
+            if len(s):
+                order = _lex_sort_rows(s, p, o)
+                s, p, o = s[order], p[order], o[order]
+                # unique: drop consecutive duplicate rows
+                if len(s) > 1:
+                    dup = (s[1:] == s[:-1]) & (p[1:] == p[:-1]) & (o[1:] == o[:-1])
+                    keep = np.concatenate(([True], ~dup))
+                    s, p, o = s[keep], p[keep], o[keep]
         if self._pending_del and len(s):
             # per-row binary search on the sorted columns; delete sets are small
             key01 = _pack2(s, p)
@@ -209,6 +276,8 @@ class ColumnarTripleStore:
                 keep = ~drop
                 s, p, o = s[keep], p[keep], o[keep]
         self._pending_del = set()
+        if s is self._s and p is self._p and o is self._o:
+            return  # no-op mutation batch: keep caches and version
         if (
             len(s) == len(self._s)
             and np.array_equal(s, self._s)
@@ -286,7 +355,9 @@ class ColumnarTripleStore:
         so = self._orders.get(name)
         if so is None:
             so = SortedOrder(
-                self._ORDER_PERMS[name], {"s": self._s, "p": self._p, "o": self._o}
+                self._ORDER_PERMS[name],
+                {"s": self._s, "p": self._p, "o": self._o},
+                presorted=(name == "spo"),
             )
             self._orders[name] = so
         return so
@@ -303,8 +374,21 @@ class ColumnarTripleStore:
             yield Triple(int(s[i]), int(p[i]), int(o[i]))
 
     def triples_set(self) -> set:
+        """Membership set of (s, p, o) tuples, memoized per version.
+
+        The returned set is SHARED with later callers at the same version —
+        treat it as read-only (derive new sets with ``-`` / ``|``).  The
+        memo makes repeated fixpoints over an unchanging base (the
+        neurosymbolic trainer's per-sample closures) O(1) instead of
+        O(store) per call.
+        """
         s, p, o = self.columns()
-        return set(zip(s.tolist(), p.tolist(), o.tolist()))
+        cached = self._triples_set_cache
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        keys = set(zip(s.tolist(), p.tolist(), o.tolist()))
+        self._triples_set_cache = (self._version, keys)
+        return keys
 
     # ---------------------------------------------------------------- match
 
@@ -349,10 +433,52 @@ class ColumnarTripleStore:
         return len(ms)
 
     def clone(self) -> "ColumnarTripleStore":
+        """O(1) copy-on-write clone.  Column arrays and built sort orders are
+        immutable once compacted (every mutation path allocates fresh arrays
+        and swaps them in), so the clone SHARES them; the first mutation on
+        either side builds new arrays/orders without touching the other."""
         self.compact()
         c = ColumnarTripleStore()
-        c._s, c._p, c._o = self._s.copy(), self._p.copy(), self._o.copy()
+        c._s, c._p, c._o = self._s, self._p, self._o
+        c._orders = dict(self._orders)
+        c._device_cols = self._device_cols
+        c._device_orders = dict(self._device_orders)
+        c._triples_set_cache = self._triples_set_cache
+        c._version = self._version  # same state ⇒ same version (see __init__)
         return c
+
+    def snapshot(self):
+        """O(1) state capture.  Compaction never mutates column arrays in
+        place (it builds new ones and reassigns — ``compact``), so holding
+        references is enough; ``restore`` swaps them back.  Used by the
+        neurosymbolic trainer to roll back per-sample seed + derived facts
+        without recloning the store (reference builds one ground reasoner,
+        ``execute_ml_train.rs:337``)."""
+        self.compact()
+        return (
+            self._s,
+            self._p,
+            self._o,
+            self._orders,
+            self._device_cols,
+            self._device_orders,
+            self._version,
+        )
+
+    def restore(self, snap) -> None:
+        """Return to a prior ``snapshot`` state.  O(1): reassigns the saved
+        references and drops any pending mutations recorded since."""
+        (
+            self._s,
+            self._p,
+            self._o,
+            self._orders,
+            self._device_cols,
+            self._device_orders,
+            self._version,
+        ) = snap
+        self._pending_add = []
+        self._pending_del = set()
 
     # ----------------------------------------------------------- serialization
 

@@ -170,6 +170,39 @@ def execute_train_decl(db, decl: TrainNeuralRelationDecl) -> Dict[str, float]:
     # P(target) = p_label and ∂P/∂p_i = δ_{i,label} — so skip per-sample
     # reasoner/SDD construction entirely (pure JAX classification).
     no_rules = not rules
+
+    # One ground reasoner for the whole run (execute_ml_train.rs:337 parity):
+    # built + rule-loaded ONCE; per sample the closure's seed/derived facts
+    # are rolled back via an O(1) store snapshot instead of recloning the db.
+    kg = None
+    base_snap = None
+    seeds_only_delta = False
+    if not no_rules:
+        kg = build_reasoner_from_db(db)
+        for rule in rules:
+            kg.add_rule(rule)
+        # NAF-free programs are monotone: close the base facts ONCE, then
+        # each per-sample closure needs only the seed triples as its first
+        # delta (its derivation cone), not the whole database.  With NAF the
+        # closure is non-monotone in the seed facts, so fall back to the
+        # full-delta closure per sample.
+        if not any(r.negative_premise for r in rules):
+            kg.infer_new_facts_semi_naive()
+            seeds_only_delta = True
+        base_snap = kg.facts.snapshot()
+        # Frozen view of the closed base, shared as every per-sample
+        # closure's round-1 old-side: its lazily-built sort orders are
+        # computed once and reused for all samples/epochs.
+        base_store = kg.facts.clone() if seeds_only_delta else None
+    # Per-sample proof-structure cache: the SDD built for a sample depends
+    # only on the db facts + seed TRIPLES — not on the seed probabilities,
+    # which enter as variable weights.  So the closure runs once per sample
+    # (first epoch); later epochs reuse (prov, tag) and just reassign seed
+    # weights before re-evaluating WMC and its gradient.
+    proof_cache: Dict[int, Optional[Tuple[object, int]]] = {}
+    if not no_rules:
+        true_term = db.dictionary.encode(XSD_BOOL_TRUE)
+        label_terms = [db.dictionary.encode(f'"{lab}"') for lab in labels]
     for _epoch in range(decl.epochs):
         order = rng.permutation(len(rows))
         epoch_loss = 0.0
@@ -208,33 +241,52 @@ def execute_train_decl(db, decl: TrainNeuralRelationDecl) -> Dict[str, float]:
                 continue
             for bi, ri in enumerate(batch_idx):
                 row = rows[ri]
+                ri = int(ri)
                 anchor_id = row.get(nr.anchor_var, 0)
                 label_id = row.get(decl.label_var, 0)
-                # seeds for this sample's neural call
-                kg = build_reasoner_from_db(db)
-                for rule in rules:
-                    kg.add_rule(rule)
-                if exclusive:
-                    choices = []
-                    for li, lab in enumerate(labels):
-                        lab_term = db.dictionary.encode(f'"{lab}"')
-                        choices.append(
-                            (Triple(anchor_id, pred_id, lab_term), float(probs[bi, li]), li)
-                        )
-                    specs = [ExclusiveGroupSeed(0, choices)]
-                    target_obj = label_id
+                if ri in proof_cache:
+                    cached = proof_cache[ri]
+                    if cached is None:
+                        continue  # target not derivable for this sample
+                    prov, tag = cached
+                    if exclusive:
+                        for li in range(len(labels)):
+                            var = prov.seed_vars.get(li)
+                            if var is not None:
+                                prov.manager.set_weight(var, float(probs[bi, li]))
+                    else:
+                        var = prov.seed_vars.get(0)
+                        if var is not None:
+                            p = float(probs[bi]) if probs.ndim == 1 else float(probs[bi, 0])
+                            prov.manager.set_weight(var, p)
                 else:
-                    true_term = db.dictionary.encode(XSD_BOOL_TRUE)
-                    p = float(probs[bi]) if probs.ndim == 1 else float(probs[bi, 0])
-                    specs = [
-                        IndependentSeed(Triple(anchor_id, pred_id, true_term), p, 0)
-                    ]
-                    target_obj = true_term
-                tag_store, prov = infer_new_facts_with_sdd_seed_specs(kg, specs)
-                target = Triple(anchor_id, pred_id, target_obj)
-                tag = tag_store.get_opt(target)
-                if tag is None:
-                    continue  # target not derivable for this sample
+                    # first epoch: run the closure, then roll the shared
+                    # reasoner back to the base facts
+                    if exclusive:
+                        choices = [
+                            (Triple(anchor_id, pred_id, label_terms[li]), float(probs[bi, li]), li)
+                            for li in range(len(labels))
+                        ]
+                        specs = [ExclusiveGroupSeed(0, choices)]
+                        target_obj = label_id
+                    else:
+                        p = float(probs[bi]) if probs.ndim == 1 else float(probs[bi, 0])
+                        specs = [
+                            IndependentSeed(Triple(anchor_id, pred_id, true_term), p, 0)
+                        ]
+                        target_obj = true_term
+                    tag_store, prov = infer_new_facts_with_sdd_seed_specs(
+                        kg,
+                        specs,
+                        seeds_only_delta=seeds_only_delta,
+                        base_store=base_store,
+                    )
+                    kg.facts.restore(base_snap)
+                    target = Triple(anchor_id, pred_id, target_obj)
+                    tag = tag_store.get_opt(target)
+                    proof_cache[ri] = None if tag is None else (prov, tag)
+                    if tag is None:
+                        continue  # target not derivable for this sample
                 p_q = prov.recover_probability(tag)
                 y = 1.0 if exclusive else _binary_label(db, row, decl.label_var)
                 loss, dl_dpq = _loss_grad(decl.loss, p_q, y)

@@ -115,11 +115,19 @@ def infer_with_provenance(
     provenance: Provenance,
     tag_store: Optional[TagStore] = None,
     initial_delta: Optional[Set[TripleKey]] = None,
+    round1_old_store=None,
 ) -> TagStore:
     """Provenance semi-naive fixpoint; returns the final TagStore.
 
     ``initial_delta`` (incremental SDS+ entry): restrict the first round's
     delta to exactly these facts instead of all facts.
+
+    ``round1_old_store``: caller-provided store equal to
+    ``reasoner.facts`` minus ``initial_delta`` (i.e. the delta facts must
+    NOT be in it).  Borrowed read-only for the first round — its cached
+    sort orders survive across calls, which is what makes the trainer's
+    10k-per-epoch seeded closures O(cone) each.  Later rounds copy-on-write
+    before the incremental old-store maintenance mutates it.
     """
     if tag_store is None:
         tag_store = seed_tag_store(reasoner, provenance)
@@ -134,8 +142,15 @@ def infer_with_provenance(
     naf_seen: Set[Tuple] = set()  # processed NAF derivation signatures
     while True:
         delta_keys = _positive_fixpoint(
-            reasoner, provenance, tag_store, pos_rules, facts, delta_keys
+            reasoner,
+            provenance,
+            tag_store,
+            pos_rules,
+            facts,
+            delta_keys,
+            round1_old_store=round1_old_store,
         )
+        round1_old_store = None  # only valid for the very first round
         naf_new = _negative_pass(
             reasoner, provenance, tag_store, neg_rules, facts, naf_seen
         )
@@ -147,13 +162,33 @@ def infer_with_provenance(
 
 
 def _positive_fixpoint(
-    reasoner, provenance, tag_store, pos_rules, facts, delta_keys
+    reasoner,
+    provenance,
+    tag_store,
+    pos_rules,
+    facts,
+    delta_keys,
+    round1_old_store=None,
 ) -> Set[TripleKey]:
     # old = facts \ delta, so each derivation is found exactly once
     # (non-idempotent ⊕ must not see duplicates).  Both the old-store and
     # the membership set are maintained INCREMENTALLY across rounds — a
     # per-round rebuild makes deep (recursive-rule) fixpoints quadratic.
-    all_keys = facts.triples_set()  # membership set, maintained per round
+    # Membership test for "conclusion already known".  Two regimes:
+    # - small delta over a big base (the trainer's per-sample seeded
+    #   closures): NO Python materialization of the fact set — membership is
+    #   a binary-search ``facts.count`` probe, and the round-1 old-store is a
+    #   vectorized clone + pending deletes.  Keeps per-closure cost
+    #   proportional to the seed's derivation cone, not the database.
+    # - otherwise (full closure): one memoized set (SHARED with the store —
+    #   read-only here) plus a local overlay of this fixpoint's additions.
+    small_delta = round1_old_store is not None or (
+        delta_keys and len(delta_keys) * 16 < len(facts)
+    )
+    base_keys: Optional[Set[TripleKey]] = (
+        None if small_delta else facts.triples_set()
+    )
+    new_keys: Set[TripleKey] = set()
     old_store = None
     prev_delta: Set[TripleKey] = set()
     prev_new: Set[TripleKey] = set()
@@ -169,8 +204,20 @@ def _positive_fixpoint(
         #   REMOVE (delta \ prev_new) \ prev_delta   (an OLD fact whose tag
         #          improved re-enters the delta → hide from old)
         if old_store is None:
-            old_store = reasoner._store_from(all_keys - delta_keys)
+            if round1_old_store is not None:
+                # borrowed: already equals facts \ delta, orders pre-built
+                old_store = round1_old_store
+            elif small_delta:
+                # COW clone + pending deletes beats rebuilding from a
+                # Python set of every fact
+                old_store = facts.clone()
+                for k in delta_keys:
+                    old_store.remove(*k)
+            else:
+                old_store = reasoner._store_from(base_keys - delta_keys)
         else:
+            if old_store is round1_old_store:
+                old_store = old_store.clone()  # COW before maintenance
             grown = prev_delta - delta_keys
             if grown:
                 g = np.asarray(sorted(grown), dtype=np.uint32)
@@ -223,7 +270,15 @@ def _positive_fixpoint(
                     prev = acc.get(ckey)
                     acc[ckey] = tag if prev is None else disj(prev, tag)
             for ckey, tag in acc.items():
-                existed = ckey in all_keys or ckey in round_new
+                if base_keys is None:
+                    # committed facts (base + prior rounds) live in the store
+                    existed = ckey in round_new or facts.count(*ckey) > 0
+                else:
+                    existed = (
+                        ckey in base_keys
+                        or ckey in new_keys
+                        or ckey in round_new
+                    )
                 changed = tag_store.update_disjunction(Triple(*ckey), tag)
                 if not existed:
                     round_new.add(ckey)
@@ -237,7 +292,7 @@ def _positive_fixpoint(
         if round_new:
             rn = np.asarray(sorted(round_new), dtype=np.uint32)
             facts.add_batch(rn[:, 0], rn[:, 1], rn[:, 2])
-            all_keys |= round_new
+            new_keys |= round_new
         prev_new = round_new
         delta_keys = next_delta
     return set()

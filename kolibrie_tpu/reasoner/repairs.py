@@ -40,8 +40,9 @@ def infer_semi_naive_with_repairs(reasoner) -> int:
     while len(delta[0]) > 0:
         accepted: List = []
         # one shared test set per round; accepted candidates stay in,
-        # violating ones are removed again
-        test = reasoner.facts.triples_set()
+        # violating ones are removed again.  COPY: triples_set() returns the
+        # store's per-version memo, which must stay unmutated.
+        test = set(reasoner.facts.triples_set())
         for rule in reasoner.rules:
             table = eval_rule_body(reasoner, rule, reasoner.facts, delta=delta)
             if table_len(table) == 0:

@@ -18,9 +18,23 @@ from kolibrie_tpu.reasoner.tag_store import TagStore
 
 
 def infer_new_facts_with_sdd_seed_specs(
-    reasoner, seed_specs: List[object]
+    reasoner, seed_specs: List[object], seeds_only_delta: bool = False,
+    base_store=None,
 ) -> Tuple[TagStore, SddProvenance]:
-    """Returns (tag store after closure, the SddProvenance used)."""
+    """Returns (tag store after closure, the SddProvenance used).
+
+    ``seeds_only_delta``: the caller guarantees ``reasoner.facts`` is already
+    closed under the (NAF-free) rules, so the first semi-naive round needs
+    only the seed triples as its delta — every derivation not reachable from
+    a seed already exists with a certain (⊤) tag.  The neurosymbolic trainer
+    uses this to make the per-sample closure proportional to the seed's
+    derivation cone instead of the whole database.
+
+    ``base_store`` (with ``seeds_only_delta``): a store equal to
+    ``reasoner.facts`` WITHOUT the seed triples, borrowed read-only as the
+    first round's old-side — lets repeated calls share its cached sort
+    orders instead of re-deriving them per call.
+    """
     prov = SddProvenance()
     store = TagStore(prov)
     mgr = prov.manager
@@ -53,5 +67,23 @@ def infer_new_facts_with_sdd_seed_specs(
                 reasoner.facts.add_triple(triple)
         else:
             raise TypeError(f"unknown seed spec {spec!r}")
-    tag_store = infer_with_provenance(reasoner, prov, store)
+    initial_delta = None
+    if seeds_only_delta:
+        initial_delta = set()
+        for spec in seed_specs:
+            if isinstance(spec, IndependentSeed):
+                t = spec.triple
+                initial_delta.add((t.subject, t.predicate, t.object))
+            else:
+                for triple, _p, _sid in spec.choices:
+                    initial_delta.add(
+                        (triple.subject, triple.predicate, triple.object)
+                    )
+    tag_store = infer_with_provenance(
+        reasoner,
+        prov,
+        store,
+        initial_delta=initial_delta,
+        round1_old_store=base_store if seeds_only_delta else None,
+    )
     return tag_store, prov

@@ -144,6 +144,62 @@ class TestColumnarStore:
         c.add(4, 5, 6)
         assert len(st) == 1 and len(c) == 2
 
+    def test_clone_cow_both_directions(self):
+        """COW clone: mutations on either side never leak to the other, and
+        pre-built sort orders survive on the untouched side."""
+        st = ColumnarTripleStore()
+        for i in range(50):
+            st.add(i, i % 5, i % 7)
+        st.order("pos")  # pre-build an order, shared by the clone
+        c = st.clone()
+        assert c.match(p=2)[0].tolist() == st.match(p=2)[0].tolist()
+        st.add(100, 100, 100)
+        c.remove(0, 0, 0)
+        assert st.contains(100, 100, 100) and st.contains(0, 0, 0)
+        assert not c.contains(100, 100, 100) and not c.contains(0, 0, 0)
+
+    def test_merge_insert_compaction_equivalence(self):
+        """Small-batch merge-insert compaction must equal the full re-sort
+        path: duplicates within the batch, duplicates vs existing rows, and
+        interleaved deletes."""
+        rng = np.random.default_rng(3)
+        base_n = 4000
+        bs = rng.integers(0, 64, base_n).astype(np.uint32)
+        bp = rng.integers(0, 8, base_n).astype(np.uint32)
+        bo = rng.integers(0, 64, base_n).astype(np.uint32)
+        st = ColumnarTripleStore()
+        st.add_batch(bs, bp, bo)
+        st.compact()
+        ref = set(st.triples_set())
+        # a small batch: some fresh rows, some already-present, some dups
+        adds = [(1000, 1, 1), (1000, 1, 1), (int(bs[0]), int(bp[0]), int(bo[0])),
+                (0, 0, 0), (2**31 + 5, 3, 9)]
+        for a in adds:
+            st.add(*a)
+            ref.add(a)
+        st.remove(int(bs[1]), int(bp[1]), int(bo[1]))
+        ref.discard((int(bs[1]), int(bp[1]), int(bo[1])))
+        assert set(st.triples_set()) == ref
+        s, p, o = st.columns()
+        # canonical columns stay lexsorted + unique
+        packed = [(int(a), int(b), int(c)) for a, b, c in zip(s, p, o)]
+        assert packed == sorted(set(packed))
+
+    def test_snapshot_restore(self):
+        st = ColumnarTripleStore()
+        for i in range(20):
+            st.add(i, 1, i)
+        snap = st.snapshot()
+        v0 = st.version
+        st.add(999, 999, 999)
+        assert st.contains(999, 999, 999)
+        st.restore(snap)
+        assert not st.contains(999, 999, 999) and len(st) == 20
+        assert st.version == v0
+        # a fresh mutation after restore gets a version never seen before
+        st.add(5, 5, 5)
+        assert st.version != v0
+
     def test_roundtrip_npz(self, tmp_path):
         st = ColumnarTripleStore()
         st.add(1, 2, 3)
