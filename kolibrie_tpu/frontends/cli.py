@@ -53,6 +53,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument("--n3logic", help="N3 logic rules (text or path)")
     ap.add_argument("--legacy", action="store_true", help="use the legacy join path")
+    ap.add_argument(
+        "--export",
+        choices=["ntriples", "turtle", "rdfxml"],
+        help="after loading (and applying rules), print the database in this "
+        "format instead of running a query",
+    )
     ap.add_argument("--time", action="store_true", help="print execution time")
     ap.add_argument("--serve", action="store_true", help="start the HTTP server")
     ap.add_argument("--host", default="127.0.0.1")
@@ -65,8 +71,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         serve(args.host, args.port)
         return 0
 
-    if not args.query:
-        ap.error("--query is required (unless --serve)")
+    if not args.query and not args.export:
+        ap.error("--query or --export is required (unless --serve)")
 
     from kolibrie_tpu.query.executor import execute_query, execute_query_volcano
     from kolibrie_tpu.query.sparql_database import SparqlDatabase
@@ -86,6 +92,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         inferred = apply_sparql_rules(db, [_read_arg(rule_text)])
         print(f"# rule inferred {inferred} fact(s)", file=sys.stderr)
+
+    if args.export:
+        writer = {
+            "ntriples": db.to_ntriples,
+            "turtle": db.to_turtle,
+            "rdfxml": db.to_rdfxml,
+        }[args.export]
+        sys.stdout.write(writer())
+        return 0
 
     sparql = _read_arg(args.query)
     start = time.perf_counter()

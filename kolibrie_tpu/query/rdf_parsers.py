@@ -423,14 +423,196 @@ def parse_rdf_xml(data: str) -> List[ParsedTriple]:
 
 
 def format_term_nt(term: str) -> str:
-    """Render a stored term string in N-Triples syntax."""
+    """Render a stored term string in N-Triples syntax.
+
+    Quoted triples re-bracket recursively: the decoded form carries bare
+    inner IRIs (``<< http://a http://p http://o >>``), the syntactic form
+    needs ``<< <http://a> <http://p> <http://o> >>``.
+    """
     if term.startswith('"') or term.startswith("_:"):
-        # literal: re-bracket a datatype IRI if present
-        if '"^^' in term:
+        # literal: re-bracket a datatype IRI if present.  Anchored at the
+        # end — a plain literal ends with its closing quote and may contain
+        # '^^' inside its raw lexical form.
+        if not term.endswith('"') and '"^^' in term:
             lex, dt = term.rsplit("^^", 1)
-            if not dt.startswith("<"):
+            if not dt.startswith("<") and '"' not in dt and " " not in dt:
                 return f"{lex}^^<{dt}>"
         return term
     if term.startswith("<<"):
+        from kolibrie_tpu.query.sparql_database import split_quoted_triple_content
+
+        parts = split_quoted_triple_content(term[2:-2].strip())
+        if len(parts) == 3:
+            return "<< " + " ".join(format_term_nt(p) for p in parts) + " >>"
         return term
     return f"<{term}>"
+
+
+_LANG_TAG_RE = re.compile(r"^[A-Za-z]{1,8}(-[A-Za-z0-9]{1,8})*$")
+
+
+def _parse_stored_literal(term: str) -> Tuple[str, Optional[str], Optional[str]]:
+    """Split a stored literal ``"lex"``, ``"lex"^^dt`` or ``"lex"@lang`` into
+    (lexical form, datatype IRI or None, language tag or None).
+
+    The stored lexical form is raw/unescaped and may itself contain ``"``,
+    ``@`` or ``^^`` — so suffixes are recognized only when anchored at the
+    END of the term: a plain literal always ends with its closing quote, and
+    a candidate datatype/lang suffix must itself be well-formed.
+    """
+    if term.endswith('"') and len(term) >= 2:
+        return term[1:-1], None, None
+    if '"^^' in term:
+        lex, dt = term.rsplit('"^^', 1)
+        if '"' not in dt and " " not in dt:
+            return lex[1:], dt.strip("<>"), None
+    if '"@' in term:
+        lex, lang = term.rsplit('"@', 1)
+        if _LANG_TAG_RE.match(lang):
+            return lex[1:], None, lang
+    return term[1:] if term.startswith('"') else term, None, None
+
+
+_NCNAME_START_RE = re.compile(r"[A-Za-z_]")
+# XML NCName (dots allowed anywhere after the first char)
+_PN_LOCAL_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.\-]*$")
+# Turtle PN_LOCAL may not END with '.' (a trailing dot terminates the
+# statement for conformant parsers)
+_TTL_LOCAL_RE = re.compile(r"^[A-Za-z_]([A-Za-z0-9_.\-]*[A-Za-z0-9_\-])?$")
+
+
+def _split_iri_qname(iri: str) -> Optional[Tuple[str, str]]:
+    """Split an IRI into (namespace, NCName local part) for XML QName use.
+    Prefers the fragment/last-slash boundary, then backs up until the local
+    part starts with an NCName start char.  None if no valid split exists."""
+    for sep in ("#", "/", ":"):
+        idx = iri.rfind(sep)
+        if idx < 0 or idx == len(iri) - 1:
+            continue
+        local = iri[idx + 1 :]
+        if _PN_LOCAL_RE.match(local):
+            return iri[: idx + 1], local
+        # back up past leading non-NCName-start chars (e.g. digits)
+        m = _NCNAME_START_RE.search(local)
+        if m and _PN_LOCAL_RE.match(local[m.start() :]):
+            cut = idx + 1 + m.start()
+            return iri[:cut], iri[cut:]
+    return None
+
+
+def serialize_rdfxml(
+    decoded_triples, prefixes: Optional[Dict[str, str]] = None
+) -> str:
+    """RDF/XML writer over decoded (s, p, o) term strings.
+
+    Parity: ``kolibrie/src/sparql_database.rs:277-317`` ``generate_rdf_xml``
+    — subject-grouped ``rdf:Description`` blocks with the database's prefix
+    table as namespace declarations — but emits spec-valid XML the
+    reference's string-template writer does not: predicate QName splitting
+    with auto-declared namespaces, ``rdf:resource`` for IRI objects,
+    ``rdf:nodeID`` for blank nodes, ``rdf:datatype``/``xml:lang`` literal
+    attributes, and XML escaping.  Triples touching a quoted triple are
+    skipped (RDF/XML has no RDF-star syntax; N-Triples/Turtle carry those).
+    """
+    from xml.sax.saxutils import escape, quoteattr
+
+    ns_to_prefix: Dict[str, str] = {RDF_NS: "rdf"}
+    iri_to_prefix = {v: k for k, v in (prefixes or {}).items() if k}
+    auto = [0]
+
+    def prefix_for(ns: str) -> str:
+        pfx = ns_to_prefix.get(ns)
+        if pfx is None:
+            pfx = iri_to_prefix.get(ns)
+            if pfx is None or pfx in ns_to_prefix.values():
+                auto[0] += 1
+                pfx = f"ns{auto[0]}"
+            ns_to_prefix[ns] = pfx
+        return pfx
+
+    subjects: Dict[str, List[Tuple[str, str]]] = {}
+    for s, p, o in decoded_triples:
+        if "<<" in (s[:2], p[:2], o[:2]) or s.startswith('"'):
+            continue  # not expressible in RDF/XML
+        subjects.setdefault(s, []).append((p, o))
+
+    body: List[str] = []
+    for s in sorted(subjects):
+        if s.startswith("_:"):
+            body.append(f"  <rdf:Description rdf:nodeID={quoteattr(s[2:])}>")
+        else:
+            body.append(f"  <rdf:Description rdf:about={quoteattr(s)}>")
+        for p, o in subjects[s]:
+            split = _split_iri_qname(p)
+            if split is None:
+                # RDF/XML requires every predicate to be an XML QName; a
+                # silent drop would lose data, so refuse (rdflib does too)
+                raise ValueError(
+                    f"predicate IRI not serializable as an XML QName: {p!r}"
+                )
+            ns, local = split
+            qn = f"{prefix_for(ns)}:{local}"
+            if o.startswith('"'):
+                lex, dt, lang = _parse_stored_literal(o)
+                attrs = ""
+                if dt:
+                    attrs = f" rdf:datatype={quoteattr(dt)}"
+                elif lang:
+                    attrs = f" xml:lang={quoteattr(lang)}"
+                body.append(f"    <{qn}{attrs}>{escape(lex)}</{qn}>")
+            elif o.startswith("_:"):
+                body.append(f"    <{qn} rdf:nodeID={quoteattr(o[2:])}/>")
+            else:
+                body.append(f"    <{qn} rdf:resource={quoteattr(o)}/>")
+        body.append("  </rdf:Description>")
+
+    decls = [
+        f"xmlns:{pfx}={quoteattr(ns)}"
+        for ns, pfx in sorted(ns_to_prefix.items(), key=lambda kv: kv[1])
+    ]
+    head = "<rdf:RDF " + " ".join(decls) + ">"
+    return "\n".join(['<?xml version="1.0" encoding="utf-8"?>', head, *body, "</rdf:RDF>"]) + "\n"
+
+
+def serialize_turtle(
+    decoded_triples, prefixes: Optional[Dict[str, str]] = None
+) -> str:
+    """Subject/predicate-grouped Turtle-star writer with prefix compaction
+    and ``a`` for rdf:type.  Parity: ``sparql_database.rs:343-400``
+    ``generate_turtle`` (BTreeMap grouping with ``;`` / ``,``)."""
+    prefixes = prefixes or {}
+    # longest-namespace-first so the most specific prefix wins
+    by_len = sorted(
+        ((v, k) for k, v in prefixes.items() if k), key=lambda kv: -len(kv[0])
+    )
+
+    def compact(term: str) -> str:
+        if term.startswith('"') or term.startswith("_:") or term.startswith("<<"):
+            return format_term_nt(term)
+        for ns, pfx in by_len:
+            if term.startswith(ns):
+                local = term[len(ns):]
+                if _TTL_LOCAL_RE.match(local):
+                    return f"{pfx}:{local}"
+        return f"<{term}>"
+
+    subjects: Dict[str, Dict[str, List[str]]] = {}
+    order: List[str] = []
+    for s, p, o in decoded_triples:
+        if s not in subjects:
+            subjects[s] = {}
+            order.append(s)
+        subjects[s].setdefault(p, []).append(o)
+
+    lines = [f"@prefix {k}: <{v}> ." for k, v in sorted(prefixes.items()) if k]
+    if lines:
+        lines.append("")
+    for s in order:
+        s_str = compact(s)
+        parts = []
+        for p, objs in subjects[s].items():
+            p_str = "a" if p == RDF_TYPE else compact(p)
+            o_str = " , ".join(compact(o) for o in objs)
+            parts.append(f"{p_str} {o_str}")
+        lines.append(f"{s_str} " + " ;\n    ".join(parts) + " .")
+    return "\n".join(lines) + "\n"

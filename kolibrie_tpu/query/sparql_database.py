@@ -222,11 +222,20 @@ class SparqlDatabase:
         return "\n".join(out) + ("\n" if out else "")
 
     def to_turtle(self) -> str:
-        lines = [f"@prefix {k}: <{v}> ." for k, v in sorted(self.prefixes.items())]
-        lines.append("")
-        for s, p, o in self.iter_decoded():
-            lines.append(f"{format_term_nt(s)} {format_term_nt(p)} {format_term_nt(o)} .")
-        return "\n".join(lines) + "\n"
+        """Subject/predicate-grouped Turtle-star with prefix compaction
+        (``generate_turtle``, sparql_database.rs:343-400)."""
+        from kolibrie_tpu.query.rdf_parsers import serialize_turtle
+
+        return serialize_turtle(self.iter_decoded(), self.prefixes)
+
+    def to_rdfxml(self) -> str:
+        """RDF/XML export (``generate_rdf_xml``, sparql_database.rs:277-317).
+        Quoted-triple (RDF-star) facts are omitted — RDF/XML cannot express
+        them; use :meth:`to_ntriples`/:meth:`to_turtle`.  Raises
+        ``ValueError`` if a predicate IRI cannot form an XML QName."""
+        from kolibrie_tpu.query.rdf_parsers import serialize_rdfxml
+
+        return serialize_rdfxml(self.iter_decoded(), self.prefixes)
 
     # -------------------------------------------------------------- prefixes
 

@@ -34,7 +34,26 @@ def infer_new_facts_with_sdd_seed_specs(
     ``reasoner.facts`` WITHOUT the seed triples, borrowed read-only as the
     first round's old-side — lets repeated calls share its cached sort
     orders instead of re-deriving them per call.
+
+    Safety: the exactly-once derivation invariant needs old ∩ delta = ∅.
+    If a seed triple ALREADY exists in the facts (e.g. a prior ML.PREDICT
+    materialized it), both flags are dropped for this call and the closure
+    runs with the full delta — same semantics as an unseeded-base run.
     """
+    if seeds_only_delta:
+        for spec in seed_specs:
+            triples = (
+                [spec.triple]
+                if isinstance(spec, IndependentSeed)
+                else [t for t, _p, _sid in spec.choices]
+            )
+            if any(
+                reasoner.facts.contains(t.subject, t.predicate, t.object)
+                for t in triples
+            ):
+                seeds_only_delta = False
+                base_store = None
+                break
     prov = SddProvenance()
     store = TagStore(prov)
     mgr = prov.manager

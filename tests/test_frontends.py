@@ -111,6 +111,21 @@ def test_cli_query(tmp_path, capsys):
     assert "http://example.org/alice" in out
 
 
+def test_cli_export(tmp_path, capsys):
+    data = tmp_path / "data.ttl"
+    data.write_text(TTL)
+    rc = cli_main(["--file", str(data), "--export", "rdfxml"])
+    assert rc == 0
+    xml = capsys.readouterr().out
+    assert xml.startswith('<?xml version="1.0"')
+    # exported RDF/XML parses back to the same triples
+    db = SparqlDatabase()
+    db.parse_turtle(TTL)
+    db2 = SparqlDatabase()
+    db2.parse_rdf(xml)
+    assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
+
 def test_cli_n3logic(tmp_path, capsys):
     data = tmp_path / "data.ttl"
     data.write_text(TTL)

@@ -293,6 +293,49 @@ TRAIN NEURAL RELATION ex:predictedHot {
         assert calls["n"] == n, f"expected {n} closures, ran {calls['n']}"
 
 
+class TestSeedPreexists:
+    def test_train_with_preexisting_seed_fact(self):
+        """A seed triple already asserted in the db (e.g. by a prior
+        ML.PREDICT materialization) violates the seeds-only-delta old/delta
+        split; the closure must detect it and fall back to the full-delta
+        path for that sample — training still runs and learns."""
+        db = SparqlDatabase()
+        rng = np.random.default_rng(3)
+        rows = []
+        for i in range(24):
+            hot = i % 2
+            t = (80 + rng.normal(0, 3)) if hot else (50 + rng.normal(0, 3))
+            rows.append(
+                f'ex:m{i} ex:temp "{t:.2f}" ; '
+                f'ex:isHot "{"true" if hot else "false"}" .'
+            )
+        # pre-assert the seed triple for one sample
+        rows.append(
+            'ex:m1 ex:predictedHot "true"^^<http://www.w3.org/2001/XMLSchema#boolean> .'
+        )
+        db.parse_turtle("@prefix ex: <http://e/> .\n" + "\n".join(rows))
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+RULE :r :- CONSTRUCT { ?m ex:alert "y" . } WHERE { ?m ex:predictedHot "true"^^<http://www.w3.org/2001/XMLSchema#boolean> . }""",
+            db,
+        )
+        execute_query_volcano(
+            """PREFIX ex: <http://e/>
+MODEL "hp" { ARCH MLP { HIDDEN [8] } OUTPUT BINARY }
+NEURAL RELATION ex:predictedHot USING MODEL "hp" {
+    INPUT { ?m ex:temp ?t . } FEATURES { ?t } }
+TRAIN NEURAL RELATION ex:predictedHot {
+    DATA { ?m ex:isHot ?hot . } LABEL ?hot
+    TARGET { ?m ex:predictedHot ?l }
+    LOSS bce EPOCHS 6 BATCH_SIZE 8 LEARNING_RATE 0.1 }""",
+            db,
+        )
+        model = db.trained_models["hp"]
+        p_hot = model.predict(np.array([[85.0]]))[0]
+        p_cold = model.predict(np.array([[45.0]]))[0]
+        assert p_hot > p_cold
+
+
 class TestMLSchemaAndHandler:
     def test_mlschema_roundtrip(self):
         ttl = model_to_mlschema_ttl(

@@ -156,7 +156,100 @@ class TestSparqlDatabase:
         assert is_quoted_triple_id(s)
         assert db.decode_term(s) == "<< http://e/a http://e/b http://e/c >>"
         nt = db.to_ntriples()
-        assert "<< http://e/a http://e/b http://e/c >>" in nt
+        assert "<< <http://e/a> <http://e/b> <http://e/c> >>" in nt
+        # N-Triples-star round-trip
+        db2 = SparqlDatabase()
+        db2.parse_ntriples(nt)
+        assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
+    def test_rdfxml_export_roundtrip(self):
+        """VERDICT r1 item 8: parse -> to_rdfxml -> parse equality, covering
+        IRIs, typed + lang-tagged + plain literals, bnodes, rdf:type, and a
+        multi-namespace predicate set (sparql_database.rs:277-317)."""
+        db = SparqlDatabase()
+        db.parse_turtle(
+            """@prefix ex: <http://e/> .
+@prefix foaf: <http://xmlns.com/foaf/0.1/> .
+ex:alice a foaf:Person ;
+    foaf:name "Alice" ;
+    foaf:age "30"^^<http://www.w3.org/2001/XMLSchema#integer> ;
+    ex:motto "salut <&> \\"quotes\\""@fr ;
+    foaf:knows ex:bob , _:b1 .
+_:b1 foaf:name "Mystery" .
+ex:bob ex:score "1.5"^^<http://www.w3.org/2001/XMLSchema#double> ."""
+        )
+        xml = db.to_rdfxml()
+        assert xml.startswith('<?xml version="1.0"')
+        db2 = SparqlDatabase()
+        db2.parse_rdf(xml)
+        # blank node labels may differ; compare with bnodes normalized away
+        def rows(d):
+            out = set()
+            for s, p, o in d.iter_decoded():
+                s = "_:" if s.startswith("_:") else s
+                o = "_:" if o.startswith("_:") else o
+                out.add((s, p, o))
+            return out
+
+        assert rows(db2) == rows(db)
+
+    def test_rdfxml_literal_with_embedded_quote_suffix(self):
+        """A raw lexical form containing '\"@' or '\"^^' must not be
+        misparsed as a lang/datatype suffix (suffix detection is anchored
+        at the end of the stored term)."""
+        db = SparqlDatabase()
+        db.add_triple_parts(
+            "<http://e/a>", "<http://e/p>", '"hi "@x" there"'
+        )
+        db.add_triple_parts(
+            "<http://e/a>", "<http://e/q>", '"v"^^w" end"'
+        )
+        xml = db.to_rdfxml()
+        db2 = SparqlDatabase()
+        db2.parse_rdf(xml)
+        assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
+    def test_rdfxml_unqnameable_predicate_raises(self):
+        db = SparqlDatabase()
+        db.add_triple_parts("<http://e/a>", "<http://e/123>", "<http://e/b>")
+        with pytest.raises(ValueError, match="QName"):
+            db.to_rdfxml()
+
+    def test_turtle_no_trailing_dot_compaction(self):
+        db = SparqlDatabase()
+        db.parse_turtle(
+            "@prefix ex: <http://e/> . ex:a <http://e/foo.> ex:b ."
+        )
+        ttl = db.to_turtle()
+        # 'ex:foo.' would terminate the statement early for conformant
+        # parsers; the writer must fall back to the bracketed IRI
+        assert "<http://e/foo.>" in ttl and "ex:foo." not in ttl
+        db2 = SparqlDatabase()
+        db2.parse_turtle(ttl)
+        assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
+    def test_rdfxml_export_skips_rdf_star(self):
+        db = SparqlDatabase()
+        db.parse_turtle(
+            '@prefix ex: <http://e/> . ex:a ex:p ex:b . '
+            '<< ex:a ex:p ex:b >> ex:conf "0.9" .'
+        )
+        xml = db.to_rdfxml()
+        assert "conf" not in xml and "rdf:Description" in xml
+
+    def test_turtle_export_grouped_roundtrip(self):
+        db = SparqlDatabase()
+        db.parse_turtle(
+            """@prefix ex: <http://e/> .
+ex:a a ex:T ; ex:p ex:b , ex:c ; ex:q "x"@en .
+<< ex:a ex:p ex:b >> ex:conf "0.9"^^<http://www.w3.org/2001/XMLSchema#double> ."""
+        )
+        ttl = db.to_turtle()
+        # grouping + compaction actually happened
+        assert "ex:a a ex:T" in ttl and " , " in ttl and " ;" in ttl
+        db2 = SparqlDatabase()
+        db2.parse_turtle(ttl)
+        assert set(db2.iter_decoded()) == set(db.iter_decoded())
 
     def test_encode_term_star(self):
         db = SparqlDatabase()
