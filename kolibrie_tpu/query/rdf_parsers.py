@@ -422,22 +422,37 @@ def parse_rdf_xml(data: str) -> List[ParsedTriple]:
 # --------------------------------------------------------------------------
 
 
+def _escape_lex(lex: str) -> str:
+    """N-Triples/Turtle string escaping for a raw lexical form."""
+    return (
+        lex.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+        .replace("\r", "\\r")
+        .replace("\t", "\\t")
+    )
+
+
 def format_term_nt(term: str) -> str:
     """Render a stored term string in N-Triples syntax.
 
-    Quoted triples re-bracket recursively: the decoded form carries bare
-    inner IRIs (``<< http://a http://p http://o >>``), the syntactic form
-    needs ``<< <http://a> <http://p> <http://o> >>``.
+    Stored literal lexical forms are raw/unescaped (see module docstring),
+    so they are re-escaped here — otherwise a literal containing a quote or
+    newline produces output no Turtle parser accepts.  Quoted triples
+    re-bracket recursively: the decoded form carries bare inner IRIs
+    (``<< http://a http://p http://o >>``), the syntactic form needs
+    ``<< <http://a> <http://p> <http://o> >>``.
     """
-    if term.startswith('"') or term.startswith("_:"):
-        # literal: re-bracket a datatype IRI if present.  Anchored at the
-        # end — a plain literal ends with its closing quote and may contain
-        # '^^' inside its raw lexical form.
-        if not term.endswith('"') and '"^^' in term:
-            lex, dt = term.rsplit("^^", 1)
-            if not dt.startswith("<") and '"' not in dt and " " not in dt:
-                return f"{lex}^^<{dt}>"
+    if term.startswith("_:"):
         return term
+    if term.startswith('"'):
+        lex, dt, lang = _parse_stored_literal(term)
+        esc = _escape_lex(lex)
+        if dt:
+            return f'"{esc}"^^<{dt}>'
+        if lang:
+            return f'"{esc}"@{lang}'
+        return f'"{esc}"'
     if term.startswith("<<"):
         from kolibrie_tpu.query.sparql_database import split_quoted_triple_content
 
@@ -523,10 +538,17 @@ def serialize_rdfxml(
     def prefix_for(ns: str) -> str:
         pfx = ns_to_prefix.get(ns)
         if pfx is None:
+            taken = set(ns_to_prefix.values())
             pfx = iri_to_prefix.get(ns)
-            if pfx is None or pfx in ns_to_prefix.values():
-                auto[0] += 1
-                pfx = f"ns{auto[0]}"
+            if pfx is not None and (pfx in taken or not _PN_LOCAL_RE.match(pfx)):
+                pfx = None  # registered name unusable as an XML prefix here
+            if pfx is None:
+                # auto names must not collide with registered prefixes either
+                while True:
+                    auto[0] += 1
+                    pfx = f"ns{auto[0]}"
+                    if pfx not in taken and pfx not in iri_to_prefix.values():
+                        break
             ns_to_prefix[ns] = pfx
         return pfx
 

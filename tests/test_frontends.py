@@ -111,6 +111,32 @@ def test_cli_query(tmp_path, capsys):
     assert "http://example.org/alice" in out
 
 
+def test_playground_drives_every_route():
+    """The playground IDE must reference every HTTP route the server
+    exposes, plus the IDE features (modes, tabs, composer, terminal)."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "web",
+        "playground.html",
+    )
+    html = open(path, encoding="utf-8").read()
+    for route in ("/query", "/rsp-query", "/rsp/register", "/rsp/push",
+                  "/rsp/events/"):
+        assert route in html, f"playground does not drive {route}"
+    for feature in ("modeSparql", "modeRsp", "queryTabs", "subRules",
+                    "subN3", "eventRows", "terminal", "EventSource",
+                    "renderTable", "examples", "legacy"):
+        assert feature in html, f"playground missing {feature}"
+    # balanced script structure (no truncated edit)
+    import re
+
+    script = re.search(r"<script>(.*)</script>", html, re.S).group(1)
+    for o, c in (("{", "}"), ("(", ")"), ("[", "]")):
+        assert script.count(o) == script.count(c)
+
+
 def test_cli_export(tmp_path, capsys):
     data = tmp_path / "data.ttl"
     data.write_text(TTL)

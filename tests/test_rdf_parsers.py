@@ -228,6 +228,34 @@ ex:bob ex:score "1.5"^^<http://www.w3.org/2001/XMLSchema#double> ."""
         db2.parse_turtle(ttl)
         assert set(db2.iter_decoded()) == set(db.iter_decoded())
 
+    def test_rdfxml_no_duplicate_xmlns(self):
+        """A registered prefix named like an auto-generated one must not
+        produce a duplicate xmlns declaration."""
+        db = SparqlDatabase()
+        db.register_prefix("ns1", "http://a/")
+        db.add_triple_parts("<http://x/s>", "<http://a/p>", "<http://x/o>")
+        db.add_triple_parts("<http://x/s>", "<http://b/p>", "<http://x/o>")
+        xml = db.to_rdfxml()
+        db2 = SparqlDatabase()
+        db2.parse_rdf(xml)  # duplicate attributes would raise ParseError
+        assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
+    def test_turtle_literal_escaping_roundtrip(self):
+        """Raw quotes/newlines in stored literals must be re-escaped on
+        export so our own parser (and any conformant one) reads them back."""
+        db = SparqlDatabase()
+        db.parse_turtle(
+            '@prefix ex: <http://e/> . ex:a ex:q "he said \\"hi\\"" ; '
+            'ex:r "line1\\nline2" .'
+        )
+        for text in (db.to_turtle(), db.to_ntriples()):
+            db2 = SparqlDatabase()
+            if text.startswith("@prefix"):
+                db2.parse_turtle(text)
+            else:
+                db2.parse_ntriples(text)
+            assert set(db2.iter_decoded()) == set(db.iter_decoded())
+
     def test_rdfxml_export_skips_rdf_star(self):
         db = SparqlDatabase()
         db.parse_turtle(
