@@ -30,12 +30,16 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
+import os  # noqa: E402
+
 import numpy as np  # noqa: E402
 
-from lubm import LUBM_Q2, LUBM_Q9, UB, generate, predicate_ids  # noqa: E402
+from lubm import LUBM_Q2, LUBM_Q9, UB, generate_fast, predicate_ids  # noqa: E402
 
-N_UNIVERSITIES = 40
-SECTIONS = ("load", "queries_host", "queries_device", "closure", "sharded")
+# LUBM scale knob: LUBM_UNIVERSITIES=1000 runs the BASELINE.md LUBM-1000
+# configuration (~3.79M triples, generated vectorized in ~1s)
+N_UNIVERSITIES = int(os.environ.get("LUBM_UNIVERSITIES", "40"))
+SECTIONS = ("load", "queries_host", "queries_device", "closure", "sharded", "load10m")
 
 
 def build_db():
@@ -43,7 +47,7 @@ def build_db():
 
     db = SparqlDatabase()
     t0 = time.perf_counter()
-    s, p, o = generate(N_UNIVERSITIES, db.dictionary)
+    s, p, o = generate_fast(N_UNIVERSITIES, db.dictionary)
     db.store.add_batch(s, p, o)
     db.store.compact()
     t_gen = time.perf_counter() - t0
@@ -182,6 +186,8 @@ def section_closure():
     )
 
     # whole closure = ONE device dispatch; timed before any readback
+    from kolibrie_tpu.reasoner.device_fixpoint import SAFE_JOIN_CAP
+
     r_dev = _closure_reasoner(db, cols)
     fx = DeviceFixpoint(r_dev)
     caps = _Caps(
@@ -189,6 +195,20 @@ def section_closure():
         delta=_round_cap(before),
         join=_round_cap(4 * before, 1024),
     )
+    if jax.default_backend() == "tpu" and caps.join > SAFE_JOIN_CAP:
+        print(
+            json.dumps(
+                {
+                    "metric": "lubm_rule_closure_device",
+                    "skipped": "join cap exceeds the toolchain-safe bound "
+                    "(SAFE_JOIN_CAP) on this TPU stack; host path above is "
+                    "the recorded number",
+                    "join_cap": caps.join,
+                    "safe_join_cap": SAFE_JOIN_CAP,
+                }
+            )
+        )
+        return
     t0 = time.perf_counter()
     out = fx.run_raw(caps)  # compile + warm
     jax.block_until_ready(out)
@@ -264,6 +284,54 @@ def section_sharded():
                 "matches": int(count),
                 "ms": round(1000 * t_join, 2),
                 "triples_per_sec_per_chip": round(n / t_join / max(n_dev, 1), 1),
+            }
+        )
+    )
+
+
+def section_load10m():
+    """10M-triple N-Triples bulk load through the public parser (native
+    C++ tokenizer fast path) — the reference's ``n_triple_10M.rs`` example,
+    fed in 1M-line chunks the way a file stream would arrive."""
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    n_total = int(os.environ.get("LUBM_BULK_TRIPLES", "10000000"))
+    n_subjects = n_total // 4
+    db = SparqlDatabase()
+    chunk = 250_000  # subjects per chunk -> 1M triples
+    loaded = 0
+    t_parse = 0.0
+    for start in range(0, n_subjects, chunk):
+        end = min(start + chunk, n_subjects)
+        lines = []
+        for i in range(start, end):
+            e = f"<https://data.example/employee/{i}>"
+            lines.append(f'{e} <http://xmlns.com/foaf/0.1/name> "Employee {i}" .')
+            lines.append(
+                f"{e} <https://data.example/ontology#dept> "
+                f"<https://data.example/dept/{i % 500}> ."
+            )
+            lines.append(
+                f"{e} <http://xmlns.com/foaf/0.1/workplaceHomepage> "
+                f"<https://company{i % 997}.example/> ."
+            )
+            lines.append(
+                f'{e} <https://data.example/ontology#annual_salary> '
+                f'"{30000 + (i % 50) * 1000}" .'
+            )
+        text = "\n".join(lines)
+        t0 = time.perf_counter()
+        loaded += db.parse_ntriples(text)
+        t_parse += time.perf_counter() - t0
+    n_stored = len(db.store)
+    print(
+        json.dumps(
+            {
+                "metric": "bulk_load_10m_ntriples",
+                "triples_parsed": loaded,
+                "triples_stored": n_stored,
+                "seconds": round(t_parse, 2),
+                "triples_per_sec": round(loaded / t_parse, 1),
             }
         )
     )

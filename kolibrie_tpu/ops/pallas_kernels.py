@@ -26,8 +26,22 @@ Merge-path window bound
 After compacting the left side to rows with at least one match, every left
 row in a tile contributes ≥ 1 output, so the rows feeding outputs
 ``[t*T, (t+1)*T)`` span at most ``T`` consecutive compacted rows starting at
-``row_start[t] = searchsorted(cum, t*T, 'right')``.  The kernel therefore
-loads a static ``W = T + 8`` row window per tile and never overflows.
+``row_start[t] = searchsorted(cum, t*T, 'right')``.
+
+Mosaic block constraints (and how the kernel scales past VMEM)
+--------------------------------------------------------------
+Mosaic requires output blocks with sublane dim a multiple of 8 — so each
+kernel invocation produces a ``(G=8, T)`` block, an unrolled loop over 8
+sub-tiles.  It also rejects DMA windows at arbitrary sublane offsets, so
+the per-row arrays cannot be manually DMA'd from ``row_start[t]``.
+Instead each array is passed TWICE as a block-quantized ``(BW, 1)`` input
+(lane dim 1 equals the full array — legal) whose index map reads the
+prefetched row starts: blocks ``rstart//BW`` and ``rstart//BW + 1``
+together always cover the group's row window; the kernel concatenates the
+two resident blocks and dynamic-slices each sub-tile's ``W = T + 8`` row
+window from VMEM.  Per-group residency is ``10 * BW * 4`` bytes —
+independent of the left side's total length, so there is no whole-array
+VMEM cliff.
 """
 
 from __future__ import annotations
@@ -44,8 +58,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE = 128  # output tile width = one lane row
+G = 8  # sub-tiles per kernel invocation (Mosaic sublane granularity)
 _WPAD = 8  # sublane alignment padding for the left-row window
-W = TILE + _WPAD
+W = TILE + _WPAD  # per-sub-tile row window
+BW = 2048  # block-quantized row-window granule (two consecutive blocks
+#            always cover a group's G*TILE + W row span: G*TILE + W +
+#            (BW - 1) <= 2 * BW)
+# Verified-safe kernel range on the current Mosaic toolchain (see
+# merge_join docstring); larger left sides use the XLA formulation.
+_PALLAS_MAX_LEFT_ROWS = 393216
 _CHUNK_ROWS = 256  # grid chunk height for elementwise kernels (128KB/col)
 
 
@@ -58,84 +79,72 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
+_NCOLS = 5  # packed per-row columns: lkey, lval, low, cum, cumprev
+
+
 def _merge_join_kernel(
     row_start_ref,  # scalar-prefetch: (n_tiles + 1,) int32; last slot = total
-    lkey_ref,  # HBM (Lpad + W, 1) compacted left keys
-    lval_ref,  # HBM (Lpad + W, 1) compacted left payloads
-    low_ref,  # HBM (Lpad + W, 1) right-run start per compacted left row
-    cum_ref,  # HBM (Lpad + W, 1) inclusive cumsum of run lengths
-    cumprev_ref,  # HBM (Lpad + W, 1) exclusive cumsum (cum shifted right)
-    key_out_ref,  # (1, T) block: joined key
-    lval_out_ref,  # (1, T) block: left payload
-    pos_out_ref,  # (1, T) block: right row index (caller gathers payload)
-    valid_out_ref,  # (1, T) block: int32 0/1 mask
-    lkey_w_ref,  # VMEM scratch (W, 1)
-    lval_w_ref,
-    low_w_ref,
-    cum_w_ref,
-    cumprev_w_ref,
-    sems,  # DMA semaphores (5,)
+    rows_a_ref,  # (1, BW, 5) block at rstart//BW: packed per-row columns
+    rows_b_ref,  # (1, BW, 5) block at rstart//BW + 1
+    key_out_ref,  # (G, T) block: joined key
+    lval_out_ref,  # (G, T) block: left payload
+    pos_out_ref,  # (G, T) block: right row index (caller gathers payload)
+    valid_out_ref,  # (G, T) block: int32 0/1 mask
+    rows_s,  # VMEM scratch (2*BW, 5): the two resident blocks, contiguous
 ):
-    t = pl.program_id(0)
-    rstart = row_start_ref[t]
-    total = row_start_ref[pl.num_programs(0)]
+    g = pl.program_id(0)
+    base = (row_start_ref[g * G] // BW) * BW  # first resident row
+    total = row_start_ref[pl.num_programs(0) * G]
 
-    # The per-row arrays stay in HBM (they scale with the LEFT side, which
-    # may be millions of rows); only the static W-row window this tile needs
-    # is DMA'd into VMEM — this is what removes the old whole-array VMEM
-    # residency limit (~200K rows).
-    copies = [
-        pltpu.make_async_copy(
-            src.at[pl.ds(rstart, W), :], dst, sems.at[i]
+    # Two consecutive BW-row blocks of the packed per-row table are
+    # VMEM-resident (block-quantized index maps driven by the prefetched
+    # row starts); together they cover this group's row span.  Stitch them
+    # into one contiguous scratch so sub-tile windows can dynamic-slice
+    # across the block boundary (ref reads support dynamic sublane
+    # offsets; value dynamic_slice does not lower).
+    rows_s[0:BW, :] = rows_a_ref[0]
+    rows_s[BW : 2 * BW, :] = rows_b_ref[0]
+
+    for r in range(G):
+        t = g * G + r
+        off = row_start_ref[t] - base  # sub-tile window start in residency
+
+        win = rows_s[pl.ds(off, W), :]  # (W, 5)
+        lkey_w = win[:, 0:1]  # (W, 1)
+        lval_w = win[:, 1:2]
+        low_w = win[:, 2:3]
+        cum_w = win[:, 3:4]
+        cumprev0 = rows_s[off, 4]
+
+        k = t * TILE + jax.lax.broadcasted_iota(
+            jnp.int32, (1, TILE), 1
+        )  # (1, T)
+
+        # M[j, k] = does output k lie past row j's last output?  Kept as
+        # int32 masks throughout — Mosaic has no i1-vector select.
+        m = (cum_w <= k).astype(jnp.int32)  # (W, T) broadcast
+        row_local = jnp.sum(m, axis=0, keepdims=True)  # (1, T)
+
+        # Row attributes via one-hot masked reduction (gather-free).
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (W, TILE), 0) == row_local
+        ).astype(jnp.int32)  # (W, T)
+        key_k = jnp.sum(onehot * lkey_w, axis=0, keepdims=True)
+        lval_k = jnp.sum(onehot * lval_w, axis=0, keepdims=True)
+        low_k = jnp.sum(onehot * low_w, axis=0, keepdims=True)
+
+        # Outputs already emitted before row(k): the largest qualifying
+        # cum, or the window's exclusive prefix when row_local == 0.
+        cum_ex = jnp.maximum(
+            jnp.max(m * cum_w, axis=0, keepdims=True), cumprev0
         )
-        for i, (src, dst) in enumerate(
-            (
-                (lkey_ref, lkey_w_ref),
-                (lval_ref, lval_w_ref),
-                (low_ref, low_w_ref),
-                (cum_ref, cum_w_ref),
-                (cumprev_ref, cumprev_w_ref),
-            )
-        )
-    ]
-    for c in copies:
-        c.start()
-    for c in copies:
-        c.wait()
 
-    cum_w = cum_w_ref[...]  # (W, 1)
-    low_w = low_w_ref[...]
-    lkey_w = lkey_w_ref[...]
-    lval_w = lval_w_ref[...]
-    cumprev0 = cumprev_w_ref[0, 0]
-
-    k = t * TILE + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)  # (1,T)
-
-    # M[j, k] = does output k lie past row j's last output?  Kept as int32
-    # masks throughout — Mosaic has no i1-vector select.
-    m = (cum_w <= k).astype(jnp.int32)  # (W, T) broadcast
-    row_local = jnp.sum(m, axis=0, keepdims=True)  # (1,T)
-
-    # Row attributes via one-hot masked reduction (gather-free).
-    onehot = (
-        jax.lax.broadcasted_iota(jnp.int32, (W, TILE), 0) == row_local
-    ).astype(jnp.int32)  # (W, T)
-    key_k = jnp.sum(onehot * lkey_w, axis=0, keepdims=True)
-    lval_k = jnp.sum(onehot * lval_w, axis=0, keepdims=True)
-    low_k = jnp.sum(onehot * low_w, axis=0, keepdims=True)
-
-    # Outputs already emitted before row(k): the largest qualifying cum,
-    # or the window's exclusive prefix when row_local == 0.
-    cum_ex = jnp.maximum(
-        jnp.max(m * cum_w, axis=0, keepdims=True), cumprev0
-    )
-
-    valid = (k < total).astype(jnp.int32)
-    pos = low_k + (k - cum_ex)
-    key_out_ref[...] = valid * key_k
-    lval_out_ref[...] = valid * lval_k
-    pos_out_ref[...] = valid * pos
-    valid_out_ref[...] = valid
+        valid = (k < total).astype(jnp.int32)
+        pos = low_k + (k - cum_ex)
+        key_out_ref[r, :] = (valid * key_k)[0, :]
+        lval_out_ref[r, :] = (valid * lval_k)[0, :]
+        pos_out_ref[r, :] = (valid * pos)[0, :]
+        valid_out_ref[r, :] = valid[0, :]
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -161,10 +170,19 @@ def merge_join(
     Keys/payloads are treated as u32; inside the kernel they ride as
     bitcast int32 (pure passthrough, exact for the full u32 range — the
     sorted-order-sensitive searchsorted runs on the u32 originals).
+
+    Inputs past ``_PALLAS_MAX_LEFT_ROWS`` route to the pure-XLA
+    formulation: the current Mosaic toolchain raises a device fault once
+    row-start offsets cross 2^19 under multi-thousand-tile grids (verified
+    empirically on v5e; block-index, pipeline-lookahead and SMEM-size
+    causes ruled out), so the kernel path is gated to the proven range.
+    The XLA path is the same algorithm (searchsorted + cumsum expansion)
+    and is what the device query engine uses throughout.
     """
     lkey_u = lkey.astype(jnp.uint32)
     rkey_u = rkey.astype(jnp.uint32)
-    n_tiles = max(1, -(-cap // TILE))
+    n_groups = max(1, -(-cap // (G * TILE)))
+    n_tiles = n_groups * G
     cap = n_tiles * TILE
 
     def _bc(x):
@@ -173,6 +191,8 @@ def merge_join(
     if lkey.shape[0] == 0 or rkey.shape[0] == 0:
         z = jnp.zeros(cap, jnp.uint32)
         return z, z, z, jnp.zeros(cap, bool), jnp.int32(0)
+    if lkey.shape[0] > _PALLAS_MAX_LEFT_ROWS:
+        return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
 
     # --- XLA pre-pass -----------------------------------------------------
     low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
@@ -195,30 +215,47 @@ def merge_join(
     )
     row_start = jnp.concatenate([row_start, total[None]])
 
-    # Pad row windows; padded rows carry cum == total so they never match.
-    def padded(x, fill):
-        return jnp.concatenate(
-            [x, jnp.full(W, fill, jnp.int32)]
-        ).reshape(-1, 1)
-
-    lkey_p = padded(lkey_c, 0)
-    lval_p = padded(lval_c, 0)
-    low_p = padded(low_c, 0)
+    # Pack the five per-row columns into one (N, 5) table (linear in HBM;
+    # ONE lane-padded VMEM block instead of five), padded to whole BW
+    # blocks PLUS one spare block (the second resident block's index is
+    # always rstart//BW + 1).  Padded rows carry cum == max so they never
+    # match.
+    n_rows = lkey_c.shape[0]
+    pad_to = (-(-(n_rows + W) // BW) + 1) * BW
     big = jnp.int32(np.iinfo(np.int32).max)
-    cum_p = padded(cum, 0)
-    cum_p = cum_p.at[lkey_c.shape[0] :, 0].set(big)
-    cumprev_p = padded(cumprev, 0)
-    cumprev_p = cumprev_p.at[lkey_c.shape[0] :, 0].set(big)
+    rows_p = jnp.stack([lkey_c, lval_c, low_c, cum, cumprev], axis=1)
+    pad_row = jnp.array([[0, 0, 0, big, big]], jnp.int32)
+    rows_p = jnp.concatenate(
+        [rows_p, jnp.broadcast_to(pad_row, (pad_to - n_rows, _NCOLS))]
+    )
+    # Leading block dimension: the resident-block index must ride a plain
+    # array dimension — HBM sublane offsets saturate a ~2^19 descriptor
+    # field, which faults for left sides past ~500K rows.
+    rows_p = rows_p.reshape(pad_to // BW, BW, _NCOLS)
 
-    out_block = pl.BlockSpec((1, TILE), lambda t, *_: (t, 0))
+    out_block = pl.BlockSpec((G, TILE), lambda g, *_: (g, 0))
+
+    nb = pad_to // BW
+
+    def blk_a(g, rs):
+        # clamp: the pipeline evaluates index maps one step past the grid,
+        # where rs[g*G] is the TOTAL (a match count, not a row index)
+        return (jnp.minimum(rs[g * G] // BW, nb - 2), 0, 0)
+
+    def blk_b(g, rs):
+        return (jnp.minimum(rs[g * G] // BW + 1, nb - 1), 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n_tiles,),
-        # per-row arrays stay off-chip; the kernel DMAs its W-row window
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 5,
+        grid=(n_groups,),
+        # the packed table rides as TWO consecutive block-quantized
+        # (1, BW, 5) residents (see module docstring)
+        in_specs=[
+            pl.BlockSpec((1, BW, _NCOLS), blk_a),
+            pl.BlockSpec((1, BW, _NCOLS), blk_b),
+        ],
         out_specs=[out_block] * 4,
-        scratch_shapes=[pltpu.VMEM((W, 1), jnp.int32)] * 5
-        + [pltpu.SemaphoreType.DMA((5,))],
+        scratch_shapes=[pltpu.VMEM((2 * BW, _NCOLS), jnp.int32)],
     )
     out_shape = [
         jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32) for _ in range(4)
@@ -228,7 +265,7 @@ def merge_join(
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=_interpret(),
-    )(row_start, lkey_p, lval_p, low_p, cum_p, cumprev_p)
+    )(row_start, rows_p, rows_p)
 
     key_o = lax.bitcast_convert_type(key_o.reshape(cap), jnp.uint32)
     lval_o = lax.bitcast_convert_type(lval_o.reshape(cap), jnp.uint32)

@@ -589,6 +589,13 @@ class DeviceFixpoint:
                 caps.delta * (2 if code & 2 else 1),
                 caps.join * (2 if code & 1 else 1),
             )
+            if (
+                jax.default_backend() == "tpu"
+                and caps.join > SAFE_JOIN_CAP
+            ):
+                # the doubled program would hit the toolchain fault the
+                # entry gate exists to avoid — bail to the host path
+                raise JoinCapExceeded(caps.join)
         else:
             raise RuntimeError("device fixpoint capacities failed to converge")
         self.converged_caps = caps
@@ -601,10 +608,33 @@ class DeviceFixpoint:
         return n_out - n0
 
 
+# Largest join capacity verified stable on the current axon/Mosaic
+# toolchain: composed fixpoint programs with join buffers past 2^21 rows
+# raise a TPU device fault at dispatch (the same ops standalone — sorts to
+# 16M rows, join_indices at 4M cap, gathers — all pass, so this is a
+# composition-specific toolchain issue, not a memory or algorithm bound).
+# Past it the reasoner transparently uses the host semi-naive path.
+SAFE_JOIN_CAP = 2_097_152
+
+
+class JoinCapExceeded(RuntimeError):
+    """Raised when capacity doubling would cross SAFE_JOIN_CAP on TPU."""
+
+
 def infer_semi_naive_device(reasoner) -> Optional[int]:
     """Device fixpoint if the rule set lowers; ``None`` → host fallback."""
     try:
         fx = DeviceFixpoint(reasoner)
     except Unsupported:
         return None
-    return fx.infer()
+    import jax
+
+    if (
+        jax.default_backend() == "tpu"
+        and fx._caps(len(reasoner.facts)).join > SAFE_JOIN_CAP
+    ):
+        return None  # toolchain-safe bound exceeded -> host fallback
+    try:
+        return fx.infer()
+    except JoinCapExceeded:
+        return None  # overflow doubling crossed the bound mid-run
