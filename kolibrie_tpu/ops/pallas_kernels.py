@@ -107,14 +107,20 @@ def _merge_join_kernel(
 
     for r in range(G):
         t = g * G + r
-        off = row_start_ref[t] - base  # sub-tile window start in residency
+        # Window start within the residency.  Clamped: tiles past the last
+        # match carry row_start == n_rows, which can lie far outside this
+        # group's two resident blocks — their outputs are zeroed by the
+        # valid mask below, so any in-bounds window serves; without the
+        # clamp the reads are undefined behavior.  Legitimate windows are
+        # bounded by (BW-1) + G*TILE < 2*BW - W and are never clamped.
+        off = jnp.minimum(row_start_ref[t] - base, 2 * BW - W)
 
         win = rows_s[pl.ds(off, W), :]  # (W, 5)
         lkey_w = win[:, 0:1]  # (W, 1)
         lval_w = win[:, 1:2]
         low_w = win[:, 2:3]
         cum_w = win[:, 3:4]
-        cumprev0 = rows_s[off, 4]
+        cumprev0 = rows_s[off, 4]  # off already clamped in-bounds above
 
         k = t * TILE + jax.lax.broadcasted_iota(
             jnp.int32, (1, TILE), 1

@@ -31,7 +31,7 @@ class CostEstimator:
             cr = self.cardinality(op.right)
             if not op.join_vars:
                 return cl * cr
-            sel = self.stats.join_selectivity(cl, cr)
+            sel = self._join_selectivity(op.left, op.right)
             return max(cl * cr * sel, 1.0)
         if isinstance(op, P.PhysNestedLoopJoin):
             return self.cardinality(op.left) * self.cardinality(op.right)
@@ -52,6 +52,30 @@ class CostEstimator:
         if isinstance(op, P.PhysSubquery):
             return 1000.0
         return 1.0
+
+    @staticmethod
+    def _scan_predicate(op):
+        """Constant predicate of a scan operand, else None
+        (optimizer.rs:698-706 ``estimate_join_selectivity`` operand probe)."""
+        pattern = getattr(op, "pattern", None)
+        if pattern is not None and pattern.predicate.kind == "id":
+            return pattern.predicate.value
+        return None
+
+    def _join_selectivity(self, left, right) -> float:
+        """Per-predicate sampled selectivity when a join side scans a bound
+        predicate (cached, ``database_stats.rs:129``); independence fallback
+        otherwise."""
+        pred = self._scan_predicate(left)
+        if pred is None:
+            pred = self._scan_predicate(right)
+        if pred is not None:
+            sel = self.stats.get_join_selectivity(pred)
+            if sel > 0.0:
+                return sel
+        return self.stats.join_selectivity(
+            self.cardinality(left), self.cardinality(right)
+        )
 
     # ---------------------------------------------------------------- costs
 

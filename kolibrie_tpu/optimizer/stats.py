@@ -8,7 +8,7 @@ cardinality maps, and a join-selectivity cache.  Counting is vectorized
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import numpy as np
 
@@ -18,13 +18,14 @@ SAMPLE_CAP = 100_000
 class DatabaseStats:
     def __init__(self) -> None:
         self.total_triples = 0
+        self.quoted_triple_count = 0
         self.distinct_subjects = 0
         self.distinct_predicates = 0
         self.distinct_objects = 0
         self.predicate_counts: Dict[int, float] = {}
         self.subject_counts: Dict[int, float] = {}
         self.object_counts: Dict[int, float] = {}
-        self.join_selectivity_cache: Dict[Tuple[int, int], float] = {}
+        self.join_selectivity_cache: Dict[int, float] = {}
 
     @staticmethod
     def gather_stats_fast(db) -> "DatabaseStats":
@@ -32,6 +33,7 @@ class DatabaseStats:
         s, p, o = db.store.columns()
         n = len(s)
         st.total_triples = n
+        st.quoted_triple_count = len(getattr(db, "quoted", ()) or ())
         if n == 0:
             return st
         if n > SAMPLE_CAP:
@@ -69,6 +71,55 @@ class DatabaseStats:
         return max(est, 0.0)
 
     def join_selectivity(self, card_left: float, card_right: float) -> float:
-        """Crude independence assumption over the larger distinct-value side."""
+        """Crude independence assumption over the larger distinct-value side
+        (fallback when neither join side has a bound predicate)."""
         denom = max(self.distinct_subjects + self.distinct_objects, 1)
         return 1.0 / denom
+
+    def get_join_selectivity(self, predicate: int) -> float:
+        """Cached per-predicate selectivity = |pred| / |db|
+        (``database_stats.rs:129-153`` ``get_join_selectivity``)."""
+        cached = self.join_selectivity_cache.get(predicate)
+        if cached is not None:
+            return cached
+        if self.total_triples > 0:
+            sel = self.predicate_counts.get(predicate, 0.0) / self.total_triples
+        else:
+            sel = 0.1
+        self.join_selectivity_cache[predicate] = sel
+        return sel
+
+    # --------------------------------------------- incremental maintenance
+
+    def update_stats(self, s: int, p: int, o: int) -> None:
+        """Count one added triple (``database_stats.rs:156-165`` parity
+        API).  The engine itself rebuilds stats per store version
+        (``SparqlDatabase.get_or_build_stats``); this keeps a LONG-LIVED
+        stats object coherent across small mutation batches — including
+        the distinct counts the independence-fallback selectivity uses."""
+        self.total_triples += 1
+        for counts, key, attr in (
+            (self.subject_counts, s, "distinct_subjects"),
+            (self.predicate_counts, p, "distinct_predicates"),
+            (self.object_counts, o, "distinct_objects"),
+        ):
+            prev = counts.get(key, 0.0)
+            if prev <= 0:
+                setattr(self, attr, getattr(self, attr) + 1)
+            counts[key] = prev + 1.0
+        self.join_selectivity_cache.clear()
+
+    def remove_stats(self, s: int, p: int, o: int) -> None:
+        """Uncount one removed triple (``database_stats.rs:168-193``)."""
+        self.total_triples = max(self.total_triples - 1, 0)
+        for counts, key, attr in (
+            (self.subject_counts, s, "distinct_subjects"),
+            (self.predicate_counts, p, "distinct_predicates"),
+            (self.object_counts, o, "distinct_objects"),
+        ):
+            v = counts.get(key)
+            if v is not None and v > 0:
+                counts[key] = v - 1.0
+                if v - 1.0 <= 0:
+                    setattr(self, attr, max(getattr(self, attr) - 1, 0))
+        self.join_selectivity_cache.clear()

@@ -310,3 +310,60 @@ class TestAgreement:
             naive = execute_query(q, db)
             volcano = execute_query_volcano(q, db)
             assert sorted(map(tuple, naive)) == sorted(map(tuple, volcano)), q
+
+
+class TestDatabaseStats:
+    """Sampled stats + per-predicate join-selectivity cache
+    (database_stats.rs:43-193 parity)."""
+
+    def test_sampling_scales_counts(self):
+        import numpy as np
+
+        from kolibrie_tpu.optimizer.stats import SAMPLE_CAP, DatabaseStats
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        db = SparqlDatabase()
+        n = SAMPLE_CAP * 2  # force the sampling path
+        s = np.arange(n, dtype=np.uint32) % 1000
+        p = np.full(n, 7, dtype=np.uint32)
+        o = np.arange(n, dtype=np.uint32)
+        db.store.add_batch(s, p, o)
+        st = DatabaseStats.gather_stats_fast(db)
+        assert st.total_triples == n
+        # scaled-up predicate count lands near the true total
+        assert abs(st.predicate_counts[7] - n) / n < 0.01
+
+    def test_join_selectivity_cached_per_predicate(self):
+        from kolibrie_tpu.optimizer.stats import DatabaseStats
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        db = SparqlDatabase()
+        for i in range(80):
+            db.store.add(i, 1, i + 1000)
+        for i in range(20):
+            db.store.add(i, 2, i + 2000)
+        st = DatabaseStats.gather_stats_fast(db)
+        assert st.get_join_selectivity(1) == 0.8
+        assert st.get_join_selectivity(2) == 0.2
+        assert st.join_selectivity_cache == {1: 0.8, 2: 0.2}
+        # unseen predicate -> 0 matches sampled
+        assert st.get_join_selectivity(999) == 0.0
+
+    def test_incremental_update_remove(self):
+        from kolibrie_tpu.optimizer.stats import DatabaseStats
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        db = SparqlDatabase()
+        db.store.add(1, 2, 3)
+        st = DatabaseStats.gather_stats_fast(db)
+        st.get_join_selectivity(2)
+        assert st.distinct_subjects == 1 and st.distinct_objects == 1
+        st.update_stats(5, 2, 6)
+        assert st.join_selectivity_cache == {}  # cache cleared
+        assert st.total_triples == 2 and st.predicate_counts[2] == 2.0
+        # distinct counts maintained too (the independence fallback uses them)
+        assert st.distinct_subjects == 2 and st.distinct_objects == 2
+        assert st.distinct_predicates == 1
+        st.remove_stats(5, 2, 6)
+        assert st.total_triples == 1 and st.predicate_counts[2] == 1.0
+        assert st.distinct_subjects == 1 and st.distinct_objects == 1

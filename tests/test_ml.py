@@ -336,6 +336,77 @@ TRAIN NEURAL RELATION ex:predictedHot {
         assert p_hot > p_cold
 
 
+class TestMLSchemaConverter:
+    def test_convert_sklearn_like_model(self):
+        from kolibrie_tpu.ml.mlschema import MLSchemaConverter
+
+        class LinearStub:
+            coef_ = np.array([[0.5, -1.5]])
+            intercept_ = np.array([0.25])
+
+            def get_params(self):
+                return {"C": 1.0, "penalty": "l2"}
+
+        conv = MLSchemaConverter()
+        X_train = np.zeros((30, 2))
+        X_test = np.zeros((10, 2))
+        conv.convert_model(
+            LinearStub(),
+            X_train=X_train,
+            X_test=X_test,
+            y_test=np.zeros(10),
+            feature_names=["age", "salary"],
+            class_names=["hot"],
+            cpu_time_used=1.5,
+            evaluation_metrics={"accuracy": 0.93},
+        )
+        # metrics queryable via the engine's own SPARQL
+        rows = conv.query(
+            """PREFIX mls: <http://www.w3.org/ns/mls#>
+            SELECT ?v WHERE {
+              ?e a mls:ModelEvaluation .
+              ?e mls:specifiedBy mls:accuracy .
+              ?e mls:hasValue ?v }"""
+        )
+        assert rows == [["0.93"]]
+        # hyperparameters + coefficients + dataset characteristics present
+        ttl = conv.serialize("turtle")
+        assert "mls:HyperParameter" in ttl and '"l2"' in ttl
+        assert "Coefficient for class hot, feature salary" in ttl
+        assert "numberOfInstances" in ttl
+        # framework (module) detection produced a Software node
+        assert "software/" in ttl and "mls:Software" in ttl
+        # serialized graph round-trips through the engine's parser
+        db = SparqlDatabase()
+        db.parse_turtle(ttl)
+        assert set(db.iter_decoded()) == set(conv.db.iter_decoded())
+
+    def test_convert_native_jax_mlp(self):
+        from kolibrie_tpu.ml.mlschema import MLSchemaConverter
+
+        m = MlpNeuralPredicate(2, [4], "binary")
+        conv = MLSchemaConverter()
+
+        def evaluate(model, X, y):
+            p = model.predict(X)
+            return {"meanProb": float(np.mean(p))}
+
+        conv.convert_model(
+            m,
+            X_test=np.zeros((5, 2)),
+            y_test=np.zeros(5),
+            evaluation_function=evaluate,
+        )
+        ttl = conv.serialize()
+        assert "Parameter layer0.W" in ttl  # learned-parameter export
+        assert "meanProb" in ttl
+        rows = conv.query(
+            """PREFIX mls: <http://www.w3.org/ns/mls#>
+            SELECT ?a WHERE { ?r a mls:Run . ?r mls:realizes ?a }"""
+        )
+        assert rows and "MlpNeuralPredicate" in rows[0][0]
+
+
 class TestMLSchemaAndHandler:
     def test_mlschema_roundtrip(self):
         ttl = model_to_mlschema_ttl(
