@@ -89,15 +89,11 @@ def run_sweep(sizes=(100, 1000, 5000), ratios=(1, 10, 50, 100)):
                 naive_out = naive_sds_plus(rules, sds, dictionary, CURRENT_TIME)
                 t_naive = min(t_naive, time.perf_counter() - t0)
 
-            # Incremental: prior state = the same SDS maintained before the
-            # update slice arrived (facts with old event times only).
-            old_sds = Sds()
-            old_sds.output_iris.add(RESULT)
-            for iri, wd in sds.windows.items():
-                old = [t for t in wd.triples if t.event_time < CURRENT_TIME]
-                old_sds.windows[iri] = WindowData(alpha=wd.alpha, triples=old)
+            # Incremental: prior state = the ratio-0 SDS maintained at time
+            # 0 (all pre-update facts alive), exactly the reference bench's
+            # prior construction (cross_window_benchmark.rs:121-127)
             prior = incremental_sds_plus(
-                rules, old_sds, {}, dictionary, CURRENT_TIME - 1
+                rules, make_sds(n, 0), {}, dictionary, 0
             )
             t_inc = float("inf")
             for _ in range(3):

@@ -86,6 +86,12 @@ def main():
     db = build_db()
     t_load = time.perf_counter() - t0
     n = len(db)
+    # Host engine only: this bench measures repeated e2e query calls, each
+    # of which reads results back — through the axon tunnel a readback
+    # degrades every later device dispatch ~3000x, so auto/device mode would
+    # measure the tunnel, not the engine.  bench.py + bench_lubm.py measure
+    # the device path with the no-readback discipline.
+    db.execution_mode = "host"
     print(
         json.dumps(
             {

@@ -436,18 +436,46 @@ class RSPEngine:
     def _build_sds(self) -> Sds:
         sds = Sds()
         dec = self.dictionary.decode
+        enc = self.dictionary.encode
         with self._cw_lock:
             latest = {k: list(v) for k, v in self._latest_contents.items()}
+        # Per-cycle wrapper memo: window contents evolve incrementally, so
+        # reusing each event's WindowedTriple (with its pre-computed encode
+        # memo) makes the SDS translation cost track NEW arrivals, not
+        # window size.  Rebuilt from live entries each cycle -> bounded.
+        old_cache = getattr(self, "_wt_cache", {})
+        new_cache = {}
+        annot = getattr(self, "_annot_pred_cache", {})
+        self._annot_pred_cache = annot
         for cfg in self.window_configs:
             triples: List[WindowedTriple] = []
             for t, event_time in latest.get(cfg.window_iri, []):
-                s = dec(t.subject)
-                p = dec(t.predicate)
-                o = dec(t.object)
-                if s is None or p is None or o is None:
-                    continue
-                triples.append(WindowedTriple(s, p, o, event_time))
+                key = (cfg.window_iri, t, event_time)
+                wt = old_cache.get(key)
+                if wt is None:
+                    s = dec(t.subject)
+                    p = dec(t.predicate)
+                    o = dec(t.object)
+                    if s is None or p is None or o is None:
+                        continue
+                    wt = WindowedTriple(s, p, o, event_time)
+                    pkey = (cfg.window_iri, t.predicate)
+                    pid = annot.get(pkey)
+                    if pid is None:
+                        pid = enc(cfg.window_iri + p)
+                        annot[pkey] = pid
+                    # pre-seed the translation memo: ids are already known
+                    wt._enc = (
+                        self.dictionary,
+                        cfg.window_iri,
+                        t.subject,
+                        pid,
+                        t.object,
+                    )
+                new_cache[key] = wt
+                triples.append(wt)
             sds.windows[cfg.window_iri] = WindowData(cfg.width, triples)
+        self._wt_cache = new_cache
         if self.cross_window_context is not None:
             for iri in self.cross_window_context.output_iris:
                 sds.output_iris.add(iri)

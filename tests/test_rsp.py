@@ -272,6 +272,82 @@ class TestCrossWindowSds:
         alive = translate_sds_to_datalog(sds, d, 14)
         assert len(alive) == 1 and alive[0][1] == 15
 
+    def test_shared_triple_across_windows_translates_per_window(self):
+        """One WindowedTriple object placed in two windows must get BOTH
+        windows' annotated predicates (the encode memo is window-keyed)."""
+        import numpy as np
+
+        from kolibrie_tpu.reasoner.cross_window import (
+            translate_sds_to_arrays,
+        )
+
+        d = Dictionary()
+        shared = WindowedTriple("s1", "p", "o1", 5)
+        sds = Sds()
+        sds.windows["http://e/w1/"] = WindowData(10, [shared])
+        sds.windows["http://e/w2/"] = WindowData(10, [shared])
+        _s, p, _o, _e = translate_sds_to_arrays(sds, d, 0)
+        preds = sorted(d.decode(int(x)) for x in np.unique(p))
+        assert preds == ["http://e/w1/p", "http://e/w2/p"]
+
+    def test_forever_alpha_saturates(self):
+        from kolibrie_tpu.reasoner.cross_window import (
+            U64_MAX,
+            translate_sds_to_arrays,
+        )
+
+        d = Dictionary()
+        sds = Sds()
+        sds.windows["http://e/w1/"] = WindowData(
+            2**63, [WindowedTriple("s", "p", "o", 5)]
+        )
+        s, _p, _o, exp = translate_sds_to_arrays(sds, d, 10**9)
+        assert len(s) == 1 and int(exp[0]) == U64_MAX
+
+    def test_event_time_mutation_honored(self):
+        """In-place event-time updates must be reflected on the next
+        translation (no stale window-level cache)."""
+        from kolibrie_tpu.reasoner.cross_window import translate_sds_to_arrays
+
+        d = Dictionary()
+        wt = WindowedTriple("s", "p", "o", 5)
+        sds = Sds()
+        sds.windows["http://e/w1/"] = WindowData(10, [wt])
+        _s, _p, _o, exp = translate_sds_to_arrays(sds, d, 0)
+        assert int(exp[0]) == 15
+        wt.event_time = 100
+        _s, _p, _o, exp = translate_sds_to_arrays(sds, d, 0)
+        assert int(exp[0]) == 110
+
+    def test_incremental_state_arrays_mirror_dicts(self):
+        """SdsPlusState.arrays must hold exactly the dict state's facts
+        (incl. after a rule with an unroutable conclusion predicate)."""
+        import numpy as np
+
+        from kolibrie_tpu.reasoner.cross_window import SdsPlusState
+
+        d = Dictionary()
+        rules, _ = parse_n3_rules_for_sds(
+            self.RULES
+            + "\n{ ?room t:hot ?v . } => { ?room <urn:unrouted:x> ?v . } .\n",
+            d,
+            ["http://e/wT/", "http://e/wH/"],
+        )
+        sds = self._sds(
+            [("r1", "hot", "1", 5), ("r2", "hot", "2", 6)],
+            [("r1", "humid", "3", 5)],
+        )
+        state = incremental_sds_plus(rules, sds, {}, d, 0)
+        assert isinstance(state, SdsPlusState)
+        dict_keys = {
+            k for m in state.values() for k in m.keys()
+        }
+        s, p, o, _e = state.arrays
+        arr_keys = set(
+            zip(s.tolist(), p.tolist(), o.tolist())
+        )
+        assert arr_keys == dict_keys
+
     def test_naive_incremental_agree(self):
         """The reference's most valuable pattern: naive recomputation and
         incremental maintenance must agree (cross_window_tests.rs:201)."""
