@@ -196,15 +196,36 @@ def section_closure():
         join=_round_cap(4 * before, 1024),
     )
     if jax.default_backend() == "tpu" and caps.join > SAFE_JOIN_CAP:
+        # past the one-dispatch program's toolchain-safe join bound: run the
+        # host-driven chunked per-round driver (every program stays below
+        # the bound).  Wall-clock includes its one scalar sync per round —
+        # that IS the algorithm's host cost, so it is timed honestly.
+        best = float("inf")
+        derived_dev = 0
+        for i in range(3):
+            r_i = _closure_reasoner(db, cols)
+            fx_i = DeviceFixpoint(r_i)
+            t0 = time.perf_counter()
+            derived_dev = fx_i.infer_chunked(writeback=False)
+            dt = time.perf_counter() - t0
+            if i > 0:  # first call pays compiles
+                best = min(best, dt)
+            t_first = dt if i == 0 else t_first  # noqa: F821
+        assert derived_dev == derived, (derived_dev, derived)
+        # bulk device→host transfer + set verification AFTER timing
+        fx_i.materialize_to_host()
+        assert r_i.facts.triples_set() == r.facts.triples_set()
         print(
             json.dumps(
                 {
                     "metric": "lubm_rule_closure_device",
-                    "skipped": "join cap exceeds the toolchain-safe bound "
-                    "(SAFE_JOIN_CAP) on this TPU stack; host path above is "
-                    "the recorded number",
-                    "join_cap": caps.join,
-                    "safe_join_cap": SAFE_JOIN_CAP,
+                    "mode": "chunked_rounds",
+                    "derived": derived_dev,
+                    "compile_s": round(t_first, 1),
+                    "ms": round(1000 * best, 3),
+                    "derived_per_sec": round(derived_dev / max(best, 1e-9), 1),
+                    "note": "per-round chunk programs under SAFE_JOIN_CAP; "
+                    "facts set verified equal to host closure",
                 }
             )
         )

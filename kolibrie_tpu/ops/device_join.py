@@ -26,6 +26,7 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # Sentinel keys for masked (invalid) rows.  Left and right invalid rows get
@@ -33,7 +34,7 @@ from jax import lax
 # u64 jnp scalars can only be constructed under the x64 scope below.)
 _LPAD = 0xFFFFFFFFFFFFFFFE
 _RPAD = 0xFFFFFFFFFFFFFFFF
-_U32PAD = jnp.uint32(0xFFFFFFFF)
+_U32PAD = np.uint32(0xFFFFFFFF)
 
 
 def _x64(fn):
@@ -51,7 +52,7 @@ def _x64(fn):
 
 def pack2(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Pack two u32 columns into one u64 key (device mirror of host pack)."""
-    return (a.astype(jnp.uint64) << jnp.uint64(32)) | b.astype(jnp.uint64)
+    return (a.astype(jnp.uint64) << np.uint64(32)) | b.astype(jnp.uint64)
 
 
 @_x64
@@ -72,9 +73,9 @@ def join_indices(
     lkey = lkey.astype(jnp.uint64)
     rkey = rkey.astype(jnp.uint64)
     if lvalid is not None:
-        lkey = jnp.where(lvalid, lkey, jnp.uint64(_LPAD))
+        lkey = jnp.where(lvalid, lkey, np.uint64(_LPAD))
     if rvalid is not None:
-        rkey = jnp.where(rvalid, rkey, jnp.uint64(_RPAD))
+        rkey = jnp.where(rvalid, rkey, np.uint64(_RPAD))
     ln, rn = lkey.shape[0], rkey.shape[0]
     if ln == 0 or rn == 0:
         z = jnp.zeros(cap, dtype=jnp.int32)
@@ -125,9 +126,9 @@ def join_indices_presorted(
     lkey = lkey.astype(jnp.uint64)
     rkey = rkey_sorted.astype(jnp.uint64)
     if lvalid is not None:
-        lkey = jnp.where(lvalid, lkey, jnp.uint64(_LPAD))
+        lkey = jnp.where(lvalid, lkey, np.uint64(_LPAD))
     if rvalid_prefix is not None:
-        rkey = jnp.where(rvalid_prefix, rkey, jnp.uint64(_RPAD))
+        rkey = jnp.where(rvalid_prefix, rkey, np.uint64(_RPAD))
     ln, rn = lkey.shape[0], rkey.shape[0]
     if ln == 0 or rn == 0:
         z = jnp.zeros(cap, dtype=jnp.int32)
@@ -164,7 +165,7 @@ def semi_join_mask(
     if rkey.shape[0] == 0:
         return jnp.zeros(lkey.shape[0], dtype=bool)
     if rvalid is not None:
-        rkey = jnp.where(rvalid, rkey, jnp.uint64(_RPAD))
+        rkey = jnp.where(rvalid, rkey, np.uint64(_RPAD))
     rsorted = jnp.sort(rkey)
     idx = jnp.clip(jnp.searchsorted(rsorted, lkey), 0, rkey.shape[0] - 1)
     return rsorted[idx] == lkey
@@ -230,7 +231,7 @@ def set_difference_rows(
     window deltas (reference: rsp/r2s.rs:37-58).  Membership is an exact
     progressive pairwise pack (see :func:`_row_membership`).
     """
-    ours = [jnp.where(valid, c.astype(jnp.uint32), jnp.uint32(0xFFFFFFFE)) for c in cols]
+    ours = [jnp.where(valid, c.astype(jnp.uint32), np.uint32(0xFFFFFFFE)) for c in cols]
     theirs = [
         jnp.where(other_valid, c.astype(jnp.uint32), _U32PAD) for c in other_cols
     ]

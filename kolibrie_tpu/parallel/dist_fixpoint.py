@@ -205,16 +205,16 @@ def _round_body(
     (fs, fp, fo, fv, gs, gp, go, gv, ds, dp_, do_, dv) = (a[0] for a in state)
 
     derived: List[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]] = []
-    drops = jnp.int32(0)
-    local_ovf = jnp.int32(0)  # per-shard join/dedup capacity overruns
+    drops = np.int32(0)
+    local_ovf = np.int32(0)  # per-shard join/dedup capacity overruns
 
     for (pb, ph) in unary:
-        m = dv & (dp_ == jnp.uint32(pb))
+        m = dv & (dp_ == np.uint32(pb))
         derived.append((ds, jnp.full_like(dp_, ph), do_, m))
 
     for (p1, p2, ph) in binary:
         # Δ as premise1: key Y = Δ.o → shard hash(o); facts p2 subject-owned
-        m1 = dv & (dp_ == jnp.uint32(p1))
+        m1 = dv & (dp_ == np.uint32(p1))
         (es, ep, eo), ev, drop0 = exchange(
             (ds, dp_, do_),
             m1,
@@ -224,7 +224,7 @@ def _round_body(
             bucket_cap,
         )
         drops = drops + drop0.astype(jnp.int32)
-        rv = fv & (fp == jnp.uint32(p2))
+        rv = fv & (fp == np.uint32(p2))
         li, ri, jv, jtot = local_join_u32(eo, fs, join_cap, ev, rv)
         local_ovf = local_ovf + jnp.maximum(jtot - join_cap, 0)
         derived.append(
@@ -237,8 +237,8 @@ def _round_body(
         )
         # Δ as premise2: key Y = Δ.s (already owner-local); probe the
         # object-hashed mirror for p1 facts with fact.o == Δ.s
-        m2 = dv & (dp_ == jnp.uint32(p2))
-        lv2 = gv & (gp == jnp.uint32(p1))
+        m2 = dv & (dp_ == np.uint32(p2))
+        lv2 = gv & (gp == np.uint32(p1))
         li2, ri2, jv2, jtot2 = local_join_u32(go, ds, join_cap, lv2, m2)
         local_ovf = local_ovf + jnp.maximum(jtot2 - join_cap, 0)
         derived.append(

@@ -27,21 +27,21 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-_LPAD32 = jnp.uint32(0xFFFFFFFE)
-_RPAD32 = jnp.uint32(0xFFFFFFFF)
+_LPAD32 = np.uint32(0xFFFFFFFE)  # np scalar: a trace-time LITERAL, never a lifted const buffer
+_RPAD32 = np.uint32(0xFFFFFFFF)
 
 
 def mix32(x: jnp.ndarray) -> jnp.ndarray:
     """Device twin of ``sharded_store._mix32`` — MUST stay bit-identical."""
     x = x.astype(jnp.uint32)
-    c = jnp.uint32(0x45D9F3B)
+    c = np.uint32(0x45D9F3B)
     x = (x ^ (x >> 16)) * c
     x = (x ^ (x >> 16)) * c
     return x ^ (x >> 16)
 
 
 def shard_of_dev(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
-    return (mix32(key) % jnp.uint32(n_shards)).astype(jnp.int32)
+    return (mix32(key) % np.uint32(n_shards)).astype(jnp.int32)
 
 
 def local_join_u32(
@@ -248,8 +248,8 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
     fn = _bgp_count_fn(store.mesh)
     with jax.enable_x64(True):
         return fn(
-            jnp.uint32(p1),
-            jnp.uint32(p2),
+            np.uint32(p1),
+            np.uint32(p2),
             store.by_obj[1],
             store.by_obj[2],
             store.by_obj_valid,
@@ -265,7 +265,7 @@ def _bgp_count_fn(mesh):
         op, oo, ov = op[0], oo[0], ov[0]
         packed = subj_packed[0]  # PRE-SORTED (pred<<32|subj) — no sort here
         lv = ov & (op == p1)
-        p2_hi = p2.astype(jnp.uint64) << jnp.uint64(32)
+        p2_hi = p2.astype(jnp.uint64) << np.uint64(32)
         # Invalid left rows get a probe key beyond every real packed key.
         # This relies on dictionary IDs never reaching 0xFFFFFFFF (IDs use
         # bits 0..30 + quoted bit 31, asserted in core.dictionary): a real
@@ -273,7 +273,7 @@ def _bgp_count_fn(mesh):
         # indistinguishable from the all-ones padding in subj_packed_sorted
         # and a probe for it would overcount against padding entries.
         lkey = jnp.where(
-            lv, p2_hi | oo.astype(jnp.uint64), jnp.uint64(0xFFFFFFFFFFFFFFFF)
+            lv, p2_hi | oo.astype(jnp.uint64), np.uint64(0xFFFFFFFFFFFFFFFF)
         )
         lo = jnp.searchsorted(packed, lkey, side="left")
         hi = jnp.searchsorted(packed, lkey, side="right")

@@ -259,7 +259,7 @@ def _scan_premise(prem: LoweredPremise, cols, valid):
     m = valid
     for c, col in zip(prem.consts, cols):
         if c is not None:
-            m = m & (col == jnp.uint32(c))
+            m = m & (col == np.uint32(c))
     for a, b in prem.eq_pairs:
         m = m & (cols[a] == cols[b])
     table = {v: cols[pos] for v, pos in prem.vars}
@@ -272,10 +272,115 @@ def _pack(cols: List, valid, sentinel):
     if len(cols) == 1:
         key = cols[0].astype(jnp.uint64)
     else:
-        key = (cols[0].astype(jnp.uint64) << jnp.uint64(32)) | cols[1].astype(
+        key = (cols[0].astype(jnp.uint64) << np.uint64(32)) | cols[1].astype(
             jnp.uint64
         )
-    return jnp.where(valid, key, jnp.uint64(sentinel))
+    return jnp.where(valid, key, np.uint64(sentinel))
+
+
+def _eval_filters(rule, table, valid, masks):
+    import jax.numpy as jnp
+
+    for f in rule.filters:
+        col = table[f.var]
+        if f.kind == "eq":
+            valid = valid & (col == np.uint32(f.const_id))
+        elif f.kind == "ne":
+            valid = valid & (col != np.uint32(f.const_id))
+        else:
+            m = masks[f.mask_idx]
+            valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+    return valid
+
+
+def _eval_negs(rule, table, valid, facts):
+    import jax.numpy as jnp
+
+    from kolibrie_tpu.ops.device_join import (
+        _LPAD,
+        _RPAD,
+        _row_membership,
+        semi_join_mask,
+    )
+
+    fsx, fpx, fox, fvx = facts
+    fcols = (fsx, fpx, fox)
+    for neg in rule.negs:
+        nm = fvx
+        for c, col in zip(neg.consts, fcols):
+            if c is not None:
+                nm = nm & (col == np.uint32(c))
+        for a, b in neg.eq_pairs:
+            nm = nm & (fcols[a] == fcols[b])
+        key_cols = [table[v] for v, _ in neg.vars]
+        fact_cols = [fcols[pos] for _, pos in neg.vars]
+        if not key_cols:
+            # fully-constant negated premise: existence kills every row
+            valid = valid & ~jnp.any(nm)
+            continue
+        if len(key_cols) <= 2:
+            member = semi_join_mask(
+                _pack(key_cols, valid, _LPAD), _pack(fact_cols, nm, _RPAD)
+            )
+        else:
+            ours = [jnp.where(valid, c, np.uint32(0xFFFFFFFE)) for c in key_cols]
+            theirs = [
+                jnp.where(nm, c, np.uint32(0xFFFFFFFF)) for c in fact_cols
+            ]
+            member = _row_membership(ours, theirs)
+        valid = valid & ~member
+    return valid
+
+
+def _gen_candidates(rules, fcols, fvalid, dcols, dvalid, masks, J):
+    """Candidate conclusions of one semi-naive round: delta-seeded premise
+    joins + filters + NAF over a FROZEN fact snapshot, as static-cap column
+    blocks.  Shared by the one-dispatch fixpoint (inside its ``while_loop``)
+    and the per-round chunk program (:func:`_device_round_chunk`)."""
+    import jax.numpy as jnp
+
+    from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices
+
+    facts = (*fcols, fvalid)
+    overflow = np.int32(0)
+    cand_parts: List[tuple] = []  # (s, p, o, valid) static-cap blocks
+
+    for rule in rules:
+        for order, keys in rule.plans:
+            seed = order[0]
+            table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
+            valid = m
+            for step, j in enumerate(order[1:]):
+                ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
+                kv = keys[step]
+                lkey = _pack([table[v] for v in kv], valid, _LPAD)
+                rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
+                li, ri, jvalid, total = join_indices(lkey, rkey, J)
+                overflow = overflow | jnp.where(total > J, np.int32(1), 0)
+                new_table = {}
+                for v, c in table.items():
+                    new_table[v] = c[li]
+                for v, c in ptable.items():
+                    if v not in new_table:
+                        new_table[v] = c[ri]
+                table, valid = new_table, jvalid
+            valid = _eval_filters(rule, table, valid, masks)
+            valid = _eval_negs(rule, table, valid, facts)
+            n = valid.shape[0]
+            for concl in rule.concls:
+                out = []
+                for kind, v in concl:
+                    if kind == "var":
+                        out.append(table[v])
+                    else:
+                        out.append(jnp.full(n, v, dtype=jnp.uint32))
+                cand_parts.append((out[0], out[1], out[2], valid))
+
+    cs = jnp.concatenate([p[0] for p in cand_parts])
+    cp = jnp.concatenate([p[1] for p in cand_parts])
+    co = jnp.concatenate([p[2] for p in cand_parts])
+    cv = jnp.concatenate([p[3] for p in cand_parts])
+    return cs, cp, co, cv, overflow
 
 
 @partial(jax.jit, static_argnames=("rules", "caps"))
@@ -298,13 +403,7 @@ def _device_fixpoint(
     import jax.numpy as jnp
     from jax import lax
 
-    from kolibrie_tpu.ops.device_join import (
-        _LPAD,
-        _RPAD,
-        join_indices,
-        semi_join_mask,
-        _row_membership,
-    )
+    from kolibrie_tpu.ops.device_join import _row_membership
 
     F, D, J = caps.fact, caps.delta, caps.join
 
@@ -320,109 +419,25 @@ def _device_fixpoint(
     dp = fp[:D] if D <= F else pad_to(fp, D)
     do = fo[:D] if D <= F else pad_to(fo, D)
     dvalid = jnp.arange(D, dtype=jnp.int32) < jnp.minimum(n_facts, D)
-    init_overflow = jnp.where(n_facts > D, jnp.int32(2), jnp.int32(0))  # bit1: delta
-
-    def eval_filters(rule, table, valid):
-        for f in rule.filters:
-            col = table[f.var]
-            if f.kind == "eq":
-                valid = valid & (col == jnp.uint32(f.const_id))
-            elif f.kind == "ne":
-                valid = valid & (col != jnp.uint32(f.const_id))
-            else:
-                m = masks[f.mask_idx]
-                valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
-        return valid
-
-    def eval_negs(rule, table, valid, facts):
-        fsx, fpx, fox, fvx = facts
-        fcols = (fsx, fpx, fox)
-        for neg in rule.negs:
-            nm = fvx
-            for c, col in zip(neg.consts, fcols):
-                if c is not None:
-                    nm = nm & (col == jnp.uint32(c))
-            for a, b in neg.eq_pairs:
-                nm = nm & (fcols[a] == fcols[b])
-            key_cols = [table[v] for v, _ in neg.vars]
-            fact_cols = [fcols[pos] for _, pos in neg.vars]
-            if not key_cols:
-                # fully-constant negated premise: existence kills every row
-                valid = valid & ~jnp.any(nm)
-                continue
-            if len(key_cols) <= 2:
-                member = semi_join_mask(
-                    _pack(key_cols, valid, _LPAD), _pack(fact_cols, nm, _RPAD)
-                )
-            else:
-                ours = [jnp.where(valid, c, jnp.uint32(0xFFFFFFFE)) for c in key_cols]
-                theirs = [
-                    jnp.where(nm, c, jnp.uint32(0xFFFFFFFF)) for c in fact_cols
-                ]
-                member = _row_membership(ours, theirs)
-            valid = valid & ~member
-        return valid
+    init_overflow = jnp.where(n_facts > D, np.int32(2), np.int32(0))  # bit1: delta
 
     def round_body(carry):
         fs, fp, fo, fvalid, n_facts, ds, dp, do, dvalid, n_new, rounds, _ovf = carry
-        facts = (fs, fp, fo, fvalid)
-        fcols = (fs, fp, fo)
-        dcols = (ds, dp, do)
 
-        overflow = jnp.int32(0)
-        cand_parts: List[tuple] = []  # (s, p, o, valid) static-cap blocks
-
-        for rule in rules:
-            for order, keys in rule.plans:
-                seed = order[0]
-                table, m = _scan_premise(
-                    rule.premises[seed], dcols, dvalid
-                )
-                valid = m
-                for step, j in enumerate(order[1:]):
-                    ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
-                    kv = keys[step]
-                    lkey = _pack([table[v] for v in kv], valid, _LPAD)
-                    rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
-                    li, ri, jvalid, total = join_indices(lkey, rkey, J)
-                    overflow = overflow | jnp.where(
-                        total > J, jnp.int32(1), 0
-                    )
-                    new_table = {}
-                    for v, c in table.items():
-                        new_table[v] = c[li]
-                    for v, c in ptable.items():
-                        if v not in new_table:
-                            new_table[v] = c[ri]
-                    table, valid = new_table, jvalid
-                valid = eval_filters(rule, table, valid)
-                valid = eval_negs(rule, table, valid, facts)
-                n = valid.shape[0]
-                for concl in rule.concls:
-                    out = []
-                    for kind, v in concl:
-                        if kind == "var":
-                            out.append(table[v])
-                        else:
-                            out.append(jnp.full(n, v, dtype=jnp.uint32))
-                    cand_parts.append((out[0], out[1], out[2], valid))
-
-        cs = jnp.concatenate([p[0] for p in cand_parts])
-        cp = jnp.concatenate([p[1] for p in cand_parts])
-        co = jnp.concatenate([p[2] for p in cand_parts])
-        cv = jnp.concatenate([p[3] for p in cand_parts])
-        # (static shapes: total candidate length is sum of part caps <= C)
+        cs, cp, co, cv, overflow = _gen_candidates(
+            rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J
+        )
 
         # dedup + subtract known facts (fused membership: rank (s,p), pack o)
         ours = [
-            jnp.where(cv, cs, jnp.uint32(0xFFFFFFFE)),
-            jnp.where(cv, cp, jnp.uint32(0xFFFFFFFE)),
-            jnp.where(cv, co, jnp.uint32(0xFFFFFFFE)),
+            jnp.where(cv, cs, np.uint32(0xFFFFFFFE)),
+            jnp.where(cv, cp, np.uint32(0xFFFFFFFE)),
+            jnp.where(cv, co, np.uint32(0xFFFFFFFE)),
         ]
         theirs = [
-            jnp.where(fvalid, fs, jnp.uint32(0xFFFFFFFF)),
-            jnp.where(fvalid, fp, jnp.uint32(0xFFFFFFFF)),
-            jnp.where(fvalid, fo, jnp.uint32(0xFFFFFFFF)),
+            jnp.where(fvalid, fs, np.uint32(0xFFFFFFFF)),
+            jnp.where(fvalid, fp, np.uint32(0xFFFFFFFF)),
+            jnp.where(fvalid, fo, np.uint32(0xFFFFFFFF)),
         ]
         known = _row_membership(ours, theirs)
         cv = cv & ~known
@@ -430,7 +445,7 @@ def _device_fixpoint(
         from kolibrie_tpu.parallel.dist_fixpoint import _sort_unique3
 
         (us, up, uo), uvalid, n_uniq = _sort_unique3((cs, cp, co), cv, D)
-        overflow = overflow | jnp.where(n_uniq > D, jnp.int32(2), 0)
+        overflow = overflow | jnp.where(n_uniq > D, np.int32(2), 0)
         n_new_next = jnp.minimum(n_uniq, D).astype(jnp.int32)
 
         # append new facts
@@ -439,7 +454,7 @@ def _device_fixpoint(
         nfp = fp.at[dest].set(up, mode="drop")
         nfo = fo.at[dest].set(uo, mode="drop")
         n_facts_next = n_facts + n_new_next
-        overflow = overflow | jnp.where(n_facts_next > F, jnp.int32(4), 0)
+        overflow = overflow | jnp.where(n_facts_next > F, np.int32(4), 0)
         nfvalid = jnp.arange(F, dtype=jnp.int32) < n_facts_next
 
         # commit only on success: an overflowing round must not corrupt state
@@ -479,17 +494,103 @@ def _device_fixpoint(
         dp,
         do,
         dvalid,
-        jnp.minimum(n_facts, jnp.int32(1)).astype(jnp.int32),
-        jnp.int32(0),
+        jnp.minimum(n_facts, np.int32(1)).astype(jnp.int32),
+        np.int32(0),
         init_overflow,
     )
     out = lax.while_loop(cond, round_body, init)
     # bit3: round limit hit with work remaining — an incomplete closure must
     # never be reported as success
     code = out[11] | jnp.where(
-        (out[10] >= ROUND_LIMIT) & (out[9] > 0), jnp.int32(8), jnp.int32(0)
+        (out[10] >= ROUND_LIMIT) & (out[9] > 0), np.int32(8), np.int32(0)
     )
     return out[0], out[1], out[2], out[4], out[10], code
+
+
+@partial(jax.jit, static_argnames=("rules", "caps"))
+def _device_round_chunk(
+    rules: tuple,
+    caps: _Caps,
+    fs,
+    fp,
+    fo,
+    n_facts,
+    ds,
+    dp,
+    do,
+    n_delta,
+    accs,
+    accp,
+    acco,
+    n_acc,
+    masks,
+):
+    """One delta CHUNK of one semi-naive round as its own XLA program.
+
+    The facts are FROZEN for the whole round — NAF and known-fact
+    subtraction see the same snapshot in every chunk, so K chunked
+    dispatches produce exactly the round the one-dispatch program's
+    ``round_body`` would.  New facts accumulate (deduplicated) in the
+    ``acc*`` buffer; the host driver merges it into the fact columns at
+    round end and feeds it back as the next round's delta.
+
+    The point of the split: each program's join capacity stays below the
+    toolchain bound that faults the composed one-dispatch fixpoint
+    (``SAFE_JOIN_CAP``), which is what lets LUBM-1000-scale closures run
+    on-chip.  Returns ``(accs, accp, acco, n_acc, overflow)``; an
+    overflowing chunk does NOT commit (bit0 join cap, bit1 accumulator
+    cap), so the caller can double the failing capacity and re-run it.
+    """
+    import jax.numpy as jnp
+
+    from kolibrie_tpu.ops.device_join import _row_membership
+
+    F, D, J = caps.fact, caps.delta, caps.join
+    fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
+    dvalid = jnp.arange(ds.shape[0], dtype=jnp.int32) < n_delta
+
+    cs, cp, co, cv, overflow = _gen_candidates(
+        rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J
+    )
+
+    # subtract known facts AND rows already accumulated by earlier chunks
+    ours = [jnp.where(cv, c, np.uint32(0xFFFFFFFE)) for c in (cs, cp, co)]
+    known = _row_membership(
+        ours,
+        [jnp.where(fvalid, c, np.uint32(0xFFFFFFFF)) for c in (fs, fp, fo)],
+    )
+    accv = jnp.arange(D, dtype=jnp.int32) < n_acc
+    in_acc = _row_membership(
+        ours,
+        [jnp.where(accv, c, np.uint32(0xFFFFFFFF)) for c in (accs, accp, acco)],
+    )
+    cv = cv & ~known & ~in_acc
+
+    from kolibrie_tpu.parallel.dist_fixpoint import _sort_unique3
+
+    (us, up, uo), uvalid, n_uniq = _sort_unique3((cs, cp, co), cv, D)
+    n_u = jnp.minimum(n_uniq, D).astype(jnp.int32)
+    overflow = overflow | jnp.where(
+        (n_uniq > D) | (n_acc + n_u > D), np.int32(2), 0
+    )
+
+    dest = jnp.where(uvalid, n_acc + jnp.cumsum(uvalid) - 1, D)
+    nas = accs.at[dest].set(us, mode="drop")
+    nap = accp.at[dest].set(up, mode="drop")
+    nao = acco.at[dest].set(uo, mode="drop")
+
+    ok = overflow == 0
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    return (
+        sel(nas, accs),
+        sel(nap, accp),
+        sel(nao, acco),
+        sel(n_acc + n_u, n_acc),
+        overflow,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -608,6 +709,185 @@ class DeviceFixpoint:
         return n_out - n0
 
 
+    def infer_chunked(
+        self,
+        chunk_rows: Optional[int] = None,
+        join_cap: Optional[int] = None,
+        delta_cap: Optional[int] = None,
+        max_attempts: int = 64,
+        writeback: bool = True,
+    ) -> int:
+        """Host-driven per-round fixpoint for inputs past the one-dispatch
+        program's toolchain-safe join capacity.
+
+        Each ROUND runs as one chunk program (:func:`_device_round_chunk`)
+        per ``chunk_rows``-row slice of the delta, with the fact columns
+        frozen for the round; the host merges the round's accumulator into
+        the facts and feeds it back as the next delta.  More dispatches
+        than the ``lax.while_loop`` path, but every program stays below
+        ``SAFE_JOIN_CAP`` — this is the path that puts LUBM-1000-scale
+        closures on the chip.  Agreement with the host reasoner is tested
+        in ``tests/test_device_fixpoint.py``.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        r = self.reasoner
+        s, p, o = r.facts.columns()
+        n0 = len(s)
+        if n0 == 0:
+            return 0
+        masks = tuple(jnp.asarray(m) for m in self.bank.materialize()) or (
+            jnp.zeros(1, dtype=bool),
+        )
+        def chunk_call(caps, *dyn):
+            # NOTE: every scalar constant in the traced body must be a
+            # numpy scalar (literal), not a jnp array — a concrete jnp
+            # scalar created at trace time is lifted to a hoisted-constant
+            # parameter on warm retraces, which the dispatch fast path
+            # fails to feed once two capacity keys coexist (observed on
+            # jax 0.9: "Executable expected parameter 0 of size 4...").
+            return _device_round_chunk(self.rules, caps, *dyn)
+
+        on_tpu = jax.default_backend() == "tpu"
+        # all powers of two (user values rounded up), so chunk offsets stay
+        # aligned across buffers: dynamic_slice never clamps a start index,
+        # which would silently re-read earlier rows and skip tail rows
+        Dc = _round_cap(chunk_rows, 8) if chunk_rows else min(
+            _round_cap(n0, 1024), 1 << 19
+        )
+        J = join_cap or (
+            SAFE_JOIN_CAP if on_tpu else _round_cap(4 * max(Dc, 1024), 1024)
+        )
+        D = _round_cap(
+            max(delta_cap, Dc) if delta_cap else max(2 * Dc, 2048), Dc
+        )
+        F = _round_cap(n0 + D, 2048)
+        attempts = 0
+
+        with jax.enable_x64(True):
+
+            def pad(x, cap):
+                x = jnp.asarray(x, dtype=jnp.uint32)
+                return jnp.concatenate(
+                    [x, jnp.zeros(cap - x.shape[0], dtype=jnp.uint32)]
+                )
+
+            def grow(cols, old, new):
+                return tuple(
+                    jnp.concatenate([c, jnp.zeros(new - old, dtype=jnp.uint32)])
+                    for c in cols
+                )
+
+            fs, fp, fo = pad(s, F), pad(p, F), pad(o, F)
+            n_facts = n0
+            # round-0 delta = all facts, in a chunk-aligned buffer
+            dlen = _round_cap(n0, Dc)
+            dels, delp, delo = pad(s, dlen), pad(p, dlen), pad(o, dlen)
+            n_delta = n0
+
+            for _round in range(10_000):
+                # Readback discipline: chunks chain through DEVICE scalars
+                # (n_acc, OR-ed overflow code) and the host syncs ONCE per
+                # round attempt — on the axon tunnel a readback degrades
+                # every later dispatch, and per-round is the true minimum a
+                # host-driven loop needs (termination + chunk count).
+                while True:
+                    accs = jnp.zeros(D, dtype=jnp.uint32)
+                    accp = jnp.zeros(D, dtype=jnp.uint32)
+                    acco = jnp.zeros(D, dtype=jnp.uint32)
+                    n_acc_dev = jnp.int32(0)
+                    code_dev = jnp.int32(0)
+                    for off in range(0, n_delta, Dc):
+                        m = min(Dc, n_delta - off)
+                        ds = lax.dynamic_slice(dels, (off,), (Dc,))
+                        dpp = lax.dynamic_slice(delp, (off,), (Dc,))
+                        doo = lax.dynamic_slice(delo, (off,), (Dc,))
+                        accs, accp, acco, n_acc_dev, ovf = chunk_call(
+                            _Caps(F, D, J),
+                            fs,
+                            fp,
+                            fo,
+                            jnp.int32(n_facts),
+                            ds,
+                            dpp,
+                            doo,
+                            jnp.int32(m),
+                            accs,
+                            accp,
+                            acco,
+                            n_acc_dev,
+                            masks,
+                        )
+                        code_dev = code_dev | ovf
+                    code = int(code_dev)  # the one sync point
+                    n_acc = int(n_acc_dev)
+                    if code == 0:
+                        break
+                    # overflow: retry the WHOLE round (facts are frozen per
+                    # round, so a round restart is exact) with the failing
+                    # capacities adjusted
+                    attempts += 1
+                    if attempts > max_attempts:
+                        raise RuntimeError(
+                            "chunked device fixpoint: capacities failed "
+                            "to converge"
+                        )
+                    if code & 1:
+                        if on_tpu and 2 * J > SAFE_JOIN_CAP:
+                            # doubling J would enter the faulting regime the
+                            # chunked path exists to avoid — shrink the
+                            # chunk instead (fewer delta seeds per program
+                            # → smaller join output at the same J)
+                            if Dc <= 1024:
+                                raise JoinCapExceeded(2 * J)
+                            Dc //= 2
+                        else:
+                            J *= 2
+                    if code & 2:
+                        D *= 2
+                if n_acc == 0:
+                    break
+                # merge the round's accumulator into the fact columns; the
+                # accumulator's zero tail lands past n_facts+n_acc where
+                # fvalid masks it (and later rounds overwrite it)
+                if n_facts + D > F:
+                    newF = _round_cap(n_facts + D, 2048)
+                    fs, fp, fo = grow((fs, fp, fo), F, newF)
+                    F = newF
+                fs = lax.dynamic_update_slice(fs, accs, (n_facts,))
+                fp = lax.dynamic_update_slice(fp, accp, (n_facts,))
+                fo = lax.dynamic_update_slice(fo, acco, (n_facts,))
+                n_facts += n_acc
+                # next round's delta = this round's accumulator (D is a
+                # power of two >= Dc, so it stays chunk-aligned)
+                dels, delp, delo, n_delta = accs, accp, acco, n_acc
+            else:
+                raise RuntimeError(
+                    "device fixpoint hit the round limit before convergence"
+                )
+
+            self.converged_caps = _Caps(F, D, J)
+            # device-resident result; ``writeback=False`` lets callers (and
+            # benches) defer the bulk device→host transfer — on the axon
+            # tunnel it would otherwise sit inside the timed window
+            self._last_state = (fs, fp, fo, n_facts, n0)
+            if writeback:
+                return self.materialize_to_host()
+            return n_facts - n0
+
+    def materialize_to_host(self) -> int:
+        """Copy facts derived by the last ``infer_chunked(writeback=False)``
+        run into ``reasoner.facts``; returns the derived count."""
+        fs, fp, fo, n_facts, n0 = self._last_state
+        if n_facts > n0:
+            s_h = np.asarray(fs[:n_facts])
+            p_h = np.asarray(fp[:n_facts])
+            o_h = np.asarray(fo[:n_facts])
+            self.reasoner.facts.add_batch(s_h[n0:], p_h[n0:], o_h[n0:])
+        return n_facts - n0
+
+
 # Largest join capacity verified stable on the current axon/Mosaic
 # toolchain: composed fixpoint programs with join buffers past 2^21 rows
 # raise a TPU device fault at dispatch (the same ops standalone — sorts to
@@ -622,19 +902,32 @@ class JoinCapExceeded(RuntimeError):
 
 
 def infer_semi_naive_device(reasoner) -> Optional[int]:
-    """Device fixpoint if the rule set lowers; ``None`` → host fallback."""
+    """Device fixpoint if the rule set lowers; ``None`` → host fallback.
+
+    Small inputs take the one-dispatch ``lax.while_loop`` program; inputs
+    whose capacities would cross the toolchain-safe join bound take the
+    host-driven chunked per-round driver (``infer_chunked``), whose
+    programs all stay below the bound — the device handles both regimes.
+    """
     try:
         fx = DeviceFixpoint(reasoner)
     except Unsupported:
         return None
     import jax
 
-    if (
-        jax.default_backend() == "tpu"
-        and fx._caps(len(reasoner.facts)).join > SAFE_JOIN_CAP
-    ):
-        return None  # toolchain-safe bound exceeded -> host fallback
     try:
-        return fx.infer()
+        if (
+            jax.default_backend() == "tpu"
+            and fx._caps(len(reasoner.facts)).join > SAFE_JOIN_CAP
+        ):
+            # one-dispatch program would cross the toolchain bound — run
+            # the round-per-dispatch chunked driver instead
+            return fx.infer_chunked()
+        try:
+            return fx.infer()
+        except JoinCapExceeded:
+            return fx.infer_chunked()  # doubling crossed the bound mid-run
     except JoinCapExceeded:
-        return None  # overflow doubling crossed the bound mid-run
+        # even minimum-size chunk programs would need a join buffer past
+        # the toolchain bound (pathological fan-out) — host fallback
+        return None

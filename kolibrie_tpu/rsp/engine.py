@@ -462,7 +462,11 @@ class RSPEngine:
                     pkey = (cfg.window_iri, t.predicate)
                     pid = annot.get(pkey)
                     if pid is None:
-                        pid = enc(cfg.window_iri + p)
+                        from kolibrie_tpu.reasoner.cross_window import (
+                            annotate_predicate,
+                        )
+
+                        pid = enc(annotate_predicate(cfg.window_iri, p))
                         annot[pkey] = pid
                     # pre-seed the translation memo: ids are already known
                     wt._enc = (

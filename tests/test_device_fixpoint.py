@@ -209,6 +209,86 @@ def test_unsupported_rules_return_none():
     assert infer_semi_naive_device(r) is None
 
 
+def _chunked_closure(build, **kw):
+    """Host oracle vs the per-round chunked driver (``infer_chunked``)."""
+    r_host = build()
+    r_host.infer_new_facts_semi_naive()
+    r_dev = build()
+    derived = DeviceFixpoint(r_dev).infer_chunked(**kw)
+    return r_host.facts.triples_set(), r_dev.facts.triples_set(), derived
+
+
+def test_chunked_rounds_agreement():
+    """Tiny chunk/caps force multi-chunk rounds, accumulator growth, join-cap
+    doubling, and fact-buffer growth — the full chunked-driver protocol."""
+
+    def build():
+        r = Reasoner()
+        for i in range(60):
+            r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    host, dev, derived = _chunked_closure(
+        build, chunk_rows=16, join_cap=64, delta_cap=32
+    )
+    assert host == dev
+    assert derived > 0
+
+
+def test_chunked_naf_filter_agreement():
+    """NAF + numeric filters must see the SAME frozen fact snapshot in every
+    chunk of a round (exact one-dispatch round semantics)."""
+
+    def build():
+        r = Reasoner()
+        for i in range(24):
+            r.add_abox_triple(f"s{i}", "hasPart", f"t{i}")
+            r.add_abox_triple(f"t{i}", "weight", f'"{i * 5}"')
+        r.add_abox_triple("t3", "broken", "yes")
+        r.add_abox_triple("t11", "broken", "yes")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "hasPart", "?y"), ("?y", "weight", "?w")],
+                [("?x", "carries", "?y")],
+                negative=[("?y", "broken", "yes")],
+                filters=[FilterCondition("w", ">", 20.0)],
+            )
+        )
+        return r
+
+    host, dev, _ = _chunked_closure(build, chunk_rows=8, join_cap=32)
+    assert host == dev
+
+
+def test_chunked_matches_one_dispatch():
+    """Chunked driver and while_loop program produce identical closures."""
+
+    def build():
+        r = Reasoner()
+        for i in range(20):
+            r.add_abox_triple(f"p{i}", "worksAt", f"org{i % 4}")
+            r.add_abox_triple(f"org{i % 4}", "partOf", "corp")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "worksAt", "?o"), ("?o", "partOf", "?c")],
+                [("?x", "memberOf", "?c")],
+            )
+        )
+        return r
+
+    r_one = build()
+    DeviceFixpoint(r_one).infer()
+    r_chunk = build()
+    DeviceFixpoint(r_chunk).infer_chunked(chunk_rows=8)
+    assert r_one.facts.triples_set() == r_chunk.facts.triples_set()
+
+
 def test_idempotent_on_closed_set():
     r = Reasoner()
     r.add_abox_triple("a", "next", "b")
