@@ -46,6 +46,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             i64,
         ),
         "kn_nt_parse": ([c.c_char_p, i64, c.POINTER(ptr)], i64),
+        "kn_nt_parse_mt": ([c.c_char_p, i64, c.c_int, c.POINTER(ptr)], i64),
         "kn_nt_nterms": ([ptr], i64),
         "kn_nt_term_bytes": ([ptr], i64),
         "kn_nt_ids": ([ptr, c.POINTER(c.c_uint32)], None),

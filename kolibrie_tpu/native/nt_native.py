@@ -30,7 +30,7 @@ def bulk_parse_ntriples(data: str) -> Optional[tuple]:
         return None
     raw = data.encode("utf-8")
     session = ctypes.c_void_p()
-    n = int(lib.kn_nt_parse(raw, len(raw), ctypes.byref(session)))
+    n = int(lib.kn_nt_parse_mt(raw, len(raw), 0, ctypes.byref(session)))
     if n < 0:
         return None  # -1 syntax error / -2 unsupported: Python decides
     try:
@@ -46,12 +46,24 @@ def bulk_parse_ntriples(data: str) -> Optional[tuple]:
         lib.kn_nt_terms(session, buf, offsets)
         blob = buf.raw
         try:
-            # surrogatepass: lone-surrogate \uXXXX escapes decode to the same
-            # string the Python parser's chr() produces
-            terms = [
-                blob[offsets[i]: offsets[i + 1]].decode("utf-8", "surrogatepass")
-                for i in range(n_terms)
-            ]
+            if blob.isascii():
+                # one whole-blob decode, then per-term str slicing — byte
+                # offsets equal codepoint offsets for pure-ASCII data, which
+                # is the common case for dictionary-encoded RDF terms
+                text = blob.decode("ascii")
+                offs = offsets[:]
+                terms = [
+                    text[offs[i]: offs[i + 1]] for i in range(n_terms)
+                ]
+            else:
+                # surrogatepass: lone-surrogate \uXXXX escapes decode to the
+                # same string the Python parser's chr() produces
+                terms = [
+                    blob[offsets[i]: offsets[i + 1]].decode(
+                        "utf-8", "surrogatepass"
+                    )
+                    for i in range(n_terms)
+                ]
         except UnicodeDecodeError:
             return None  # out-of-range escape: let the Python parser decide
     finally:

@@ -165,9 +165,7 @@ class SparqlDatabase:
             return None
         ids, terms = result
         remap = np.empty(len(terms) + 1, dtype=np.uint32)
-        enc = self.dictionary.encode
-        for i, t in enumerate(terms):
-            remap[i + 1] = enc(t)
+        remap[1:] = self.dictionary.encode_batch(terms)
         cols = remap[ids]
         self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
         return int(ids.shape[0])

@@ -22,6 +22,8 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -208,14 +210,37 @@ struct SddManager {
 
 // ─────────────────────── N-Triples bulk tokenizer ────────────────────────
 
+// Transparent hashing so interning can probe with a string_view into the
+// raw input buffer — a std::string is only constructed on FIRST sight of a
+// term, which removes the per-occurrence allocation the old tokenizer paid.
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view sv) const {
+    return std::hash<std::string_view>{}(sv);
+  }
+  size_t operator()(const std::string &s) const {
+    return std::hash<std::string_view>{}(std::string_view(s));
+  }
+};
+
 struct NtSession {
   std::vector<uint32_t> ids;  // n_triples * 3, 1-based term indices
   std::vector<std::string> terms;
-  std::unordered_map<std::string, uint32_t> term_map;
+  std::unordered_map<std::string, uint32_t, SvHash, std::equal_to<>> term_map;
   int64_t term_bytes = 0;
 
+  uint32_t intern_view(std::string_view sv) {
+    auto it = term_map.find(sv);
+    if (it != term_map.end()) return it->second;
+    uint32_t id = (uint32_t)terms.size() + 1;
+    term_bytes += (int64_t)sv.size();
+    term_map.emplace(std::string(sv), id);
+    terms.emplace_back(sv);
+    return id;
+  }
+
   uint32_t intern(std::string &&s) {
-    auto it = term_map.find(s);
+    auto it = term_map.find(std::string_view(s));
     if (it != term_map.end()) return it->second;
     uint32_t id = (uint32_t)terms.size() + 1;
     term_bytes += (int64_t)s.size();
@@ -295,10 +320,17 @@ bool append_unescaped(const char *s, int64_t len, std::string &out) {
 
 // Parser over raw bytes.  Returns 0 on success, -1 on syntax error, -2 on a
 // construct the fast path does not support (caller falls back to Python).
+//
+// Terms whose stored form is an exact substring of the input (IRIs without
+// the angle brackets, blank nodes, plain/lang literals without escapes)
+// intern as string_views into ``data`` — no copy, no allocation unless the
+// term is new.  Only escaped and datatype-suffixed literals materialize
+// into the reused scratch buffer.
 int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
   int64_t i = 0;
   int term_in_line = 0;
   uint32_t line_ids[3];
+  std::string scratch;
   while (i < len) {
     char c = data[i];
     if (c == ' ' || c == '\t' || c == '\r' || c == '\n') { i++; continue; }
@@ -314,7 +346,7 @@ int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
       continue;
     }
     if (term_in_line == 3) return -1;  // missing '.'
-    std::string term;
+    std::string_view view;
     if (c == '<') {
       if (i + 1 < len && data[i + 1] == '<') return -2;  // RDF-star: fallback
       int64_t j = i + 1;
@@ -323,7 +355,7 @@ int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
         j++;
       }
       if (j >= len) return -1;
-      term.assign(data + i + 1, (size_t)(j - i - 1));
+      view = std::string_view(data + i + 1, (size_t)(j - i - 1));
       i = j + 1;
     } else if (c == '_') {
       if (i + 1 >= len || data[i + 1] != ':') return -1;
@@ -334,19 +366,18 @@ int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
       }
       // a trailing '.' belongs to the statement, not the label
       while (j > i + 2 && data[j - 1] == '.') j--;
-      term.assign(data + i, (size_t)(j - i));
+      view = std::string_view(data + i, (size_t)(j - i));
       i = j;
     } else if (c == '"') {
       int64_t j = i + 1;
+      bool escaped = false;
       while (j < len) {
-        if (data[j] == '\\') { j += 2; continue; }
+        if (data[j] == '\\') { escaped = true; j += 2; continue; }
         if (data[j] == '"') break;
         j++;
       }
       if (j >= len) return -1;
-      term.push_back('"');
-      if (!append_unescaped(data + i + 1, j - i - 1, term)) return -1;
-      term.push_back('"');
+      int64_t body_start = i, body_end = j + 1;  // inclusive of both quotes
       i = j + 1;
       if (i + 1 < len && data[i] == '^' && data[i + 1] == '^') {
         i += 2;
@@ -354,23 +385,131 @@ int nt_parse_impl(const char *data, int64_t len, NtSession &out) {
         int64_t k = i + 1;
         while (k < len && data[k] != '>') k++;
         if (k >= len) return -1;
-        term.append("^^");
-        term.append(data + i + 1, (size_t)(k - i - 1));
-        i = k + 1;
-      } else if (i < len && data[i] == '@') {
-        int64_t k = i + 1;
-        while (k < len && (isalnum((unsigned char)data[k]) || data[k] == '-')) {
-          k++;
+        // stored form strips the datatype's angle brackets — always
+        // materialized ("..."^^iri differs from the input "..."^^<iri>)
+        scratch.clear();
+        scratch.push_back('"');
+        if (!append_unescaped(data + body_start + 1,
+                              body_end - body_start - 2, scratch)) {
+          return -1;
         }
-        term.append(data + i, (size_t)(k - i));
-        i = k;
+        scratch.push_back('"');
+        scratch.append("^^");
+        scratch.append(data + i + 1, (size_t)(k - i - 1));
+        i = k + 1;
+        view = std::string_view(scratch);
+      } else {
+        int64_t end = body_end;
+        if (i < len && data[i] == '@') {
+          int64_t k = i + 1;
+          while (k < len &&
+                 (isalnum((unsigned char)data[k]) || data[k] == '-')) {
+            k++;
+          }
+          end = k;
+          i = k;
+        }
+        if (!escaped) {
+          // quotes and language tag are verbatim input bytes
+          view = std::string_view(data + body_start, (size_t)(end - body_start));
+        } else {
+          scratch.clear();
+          scratch.push_back('"');
+          if (!append_unescaped(data + body_start + 1,
+                                body_end - body_start - 2, scratch)) {
+            return -1;
+          }
+          scratch.push_back('"');
+          scratch.append(data + body_end, (size_t)(end - body_end));
+          view = std::string_view(scratch);
+        }
       }
     } else {
       return -2;  // prefixed name / directive / number: Turtle, not N-Triples
     }
-    line_ids[term_in_line++] = out.intern(std::move(term));
+    line_ids[term_in_line++] = out.intern_view(view);
   }
   if (term_in_line != 0) return -1;  // unterminated statement
+  return 0;
+}
+
+// Multithreaded parse: split the document at newline boundaries, parse each
+// chunk into a thread-local session, then merge the term tables (remapping
+// each chunk's ids).  N-Triples statements MAY legally span lines; a chunk
+// cut inside a statement makes that chunk's parse fail (-1 unterminated /
+// malformed), in which case the caller falls back to the single-threaded
+// whole-document parse — one-statement-per-line data (the universal layout)
+// always takes the parallel path.  Mirrors the reference's chunked parallel
+// parse + dictionary merge design (sparql_database.rs:407-434,
+// dictionary.rs:82-90) with threads in place of a rayon pool.
+int nt_parse_mt_impl(const char *data, int64_t len, int nthreads,
+                     NtSession &out) {
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int)hc : 1;
+    // auto mode: threading only pays off past ~1MB of input
+    const int64_t kMinChunk = 1 << 20;
+    if ((int64_t)nthreads > len / kMinChunk) {
+      nthreads = (int)(len / kMinChunk);
+      if (nthreads < 1) nthreads = 1;
+    }
+  }
+  // an explicit nthreads >= 2 is honored regardless of input size so the
+  // chunk-split/merge path is exercisable by tests on small documents
+  if (nthreads > 16) nthreads = 16;
+  if (len > 0 && (int64_t)nthreads > len) nthreads = (int)len;
+  if (nthreads <= 1) return nt_parse_impl(data, len, out);
+
+  std::vector<int64_t> starts(nthreads + 1);
+  starts[0] = 0;
+  starts[nthreads] = len;
+  for (int t = 1; t < nthreads; t++) {
+    int64_t pos = len * t / nthreads;
+    if (pos < starts[t - 1]) pos = starts[t - 1];
+    while (pos < len && data[pos] != '\n') pos++;
+    starts[t] = pos < len ? pos + 1 : len;
+  }
+  std::vector<NtSession> locals(nthreads);
+  std::vector<int> rcs(nthreads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  // exceptions must not cross a thread boundary (std::terminate would
+  // abort the embedding Python process): catch inside the worker, and
+  // treat a failed spawn (RLIMIT_NPROC etc.) as a single-thread fallback
+  for (int t = 0; t < nthreads; t++) {
+    try {
+      workers.emplace_back([&, t] {
+        try {
+          rcs[t] = nt_parse_impl(data + starts[t], starts[t + 1] - starts[t],
+                                 locals[t]);
+        } catch (...) {
+          rcs[t] = -3;
+        }
+      });
+    } catch (const std::system_error &) {
+      for (int u = t; u < nthreads; u++) rcs[u] = -3;
+      break;
+    }
+  }
+  for (auto &w : workers) w.join();
+  for (int t = 0; t < nthreads; t++) {
+    if (rcs[t] == -2) return -2;  // unsupported construct: Python decides
+    if (rcs[t] != 0) return nt_parse_impl(data, len, out);  // spanning stmt
+  }
+  // merge: chunk 0 seeds the output; later chunks remap through interning
+  out = std::move(locals[0]);
+  for (int t = 1; t < nthreads; t++) {
+    NtSession &loc = locals[t];
+    std::vector<uint32_t> remap(loc.terms.size() + 1);
+    for (size_t k = 0; k < loc.terms.size(); k++) {
+      remap[k + 1] = out.intern(std::move(loc.terms[k]));
+    }
+    size_t base = out.ids.size();
+    out.ids.resize(base + loc.ids.size());
+    for (size_t k = 0; k < loc.ids.size(); k++) {
+      out.ids[base + k] = remap[loc.ids[k]];
+    }
+  }
   return 0;
 }
 
@@ -513,6 +652,20 @@ int64_t kn_sdd_enumerate_models(void *h, int64_t nid, int64_t limit,
 int64_t kn_nt_parse(const char *data, int64_t len, void **out_session) {
   auto *s = new NtSession();
   int rc = nt_parse_impl(data, len, *s);
+  if (rc != 0) {
+    delete s;
+    *out_session = nullptr;
+    return rc;
+  }
+  *out_session = s;
+  return (int64_t)(s->ids.size() / 3);
+}
+
+// Multithreaded variant; nthreads <= 0 = auto (hardware concurrency).
+int64_t kn_nt_parse_mt(const char *data, int64_t len, int nthreads,
+                       void **out_session) {
+  auto *s = new NtSession();
+  int rc = nt_parse_mt_impl(data, len, nthreads, *s);
   if (rc != 0) {
     delete s;
     *out_session = nullptr;

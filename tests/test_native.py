@@ -165,6 +165,91 @@ def test_nt_bulk_parse_agreement():
     assert native_triples == parse_ntriples(NT_DOC)
 
 
+def _parse_with_threads(doc: str, nthreads: int):
+    """Call the multithreaded ctypes entry with an EXPLICIT thread count so
+    the chunk-split/merge/remap path runs even on tiny documents."""
+    import ctypes
+
+    lib = native_loader.load()
+    raw = doc.encode("utf-8")
+    session = ctypes.c_void_p()
+    n = int(lib.kn_nt_parse_mt(raw, len(raw), nthreads, ctypes.byref(session)))
+    if n < 0:
+        return n, None, None
+    try:
+        ids = np.empty(n * 3, dtype=np.uint32)
+        if n:
+            lib.kn_nt_ids(
+                session, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+            )
+        n_terms = int(lib.kn_nt_nterms(session))
+        nbytes = int(lib.kn_nt_term_bytes(session))
+        buf = ctypes.create_string_buffer(nbytes)
+        offsets = (ctypes.c_int64 * (n_terms + 1))()
+        lib.kn_nt_terms(session, buf, offsets)
+        blob = buf.raw
+        terms = [
+            blob[offsets[i]: offsets[i + 1]].decode("utf-8", "surrogatepass")
+            for i in range(n_terms)
+        ]
+    finally:
+        lib.kn_nt_free(session)
+    return n, ids.reshape(n, 3), terms
+
+
+def _decoded_triples(n, ids, terms):
+    return [
+        (terms[ids[i, 0] - 1], terms[ids[i, 1] - 1], terms[ids[i, 2] - 1])
+        for i in range(n)
+    ]
+
+
+def test_nt_multithreaded_merge_agreement():
+    """4-way chunked parse must produce the same triples (and term dedup) as
+    the single-threaded parse, with cross-chunk repeated terms remapped to
+    one id."""
+    from kolibrie_tpu.query.rdf_parsers import parse_ntriples
+
+    # repeated terms across what will be different chunks force the merge
+    # remap; escapes/typed/lang literals exercise materialized terms too
+    doc = "\n".join(
+        f'<http://e/s{i % 7}> <http://e/p{i % 3}> '
+        + (
+            f'"val \\"{i}\\" \\u00e9"'
+            if i % 4 == 0
+            else f'"{i}"^^<http://www.w3.org/2001/XMLSchema#integer>'
+            if i % 4 == 1
+            else f"<http://e/o{i % 5}>"
+        )
+        + " ."
+        for i in range(200)
+    )
+    n1, ids1, terms1 = _parse_with_threads(doc, 1)
+    n4, ids4, terms4 = _parse_with_threads(doc, 4)
+    assert n1 == n4 == 200
+    assert _decoded_triples(n1, ids1, terms1) == _decoded_triples(
+        n4, ids4, terms4
+    )
+    assert sorted(terms1) == sorted(terms4)  # same dedup across chunks
+    assert len(set(terms4)) == len(terms4)  # merge produced no duplicate ids
+    assert _decoded_triples(n4, ids4, terms4) == parse_ntriples(doc)
+
+
+def test_nt_multithreaded_spanning_statement_falls_back():
+    """A statement spanning a chunk cut must still parse correctly (the mt
+    path detects the failed chunk and re-parses single-threaded)."""
+    from kolibrie_tpu.query.rdf_parsers import parse_ntriples
+
+    # every statement spread over three lines: any mid-statement cut makes
+    # that chunk's parse fail, forcing the documented fallback
+    doc = "\n".join(
+        f"<http://e/s{i}>\n<http://e/p>\n<http://e/o{i}> ." for i in range(50)
+    )
+    n4, ids4, terms4 = _parse_with_threads(doc, 4)
+    assert n4 == 50
+    assert _decoded_triples(n4, ids4, terms4) == parse_ntriples(doc)
+
+
 def test_nt_bulk_parse_falls_back_on_rdf_star():
     from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
 
