@@ -93,7 +93,10 @@ def load():
             return None
         try:
             _lib = _declare(ctypes.CDLL(_SO_PATH))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing newly-required
+            # symbols (mtime check fooled by copied artifacts) — degrade to
+            # the pure-Python paths instead of crashing every parse
             _lib = None
         return _lib
 

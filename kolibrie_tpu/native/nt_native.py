@@ -18,19 +18,21 @@ import numpy as np
 from kolibrie_tpu.native import load
 
 
-def bulk_parse_ntriples(data: str) -> Optional[tuple]:
+def bulk_parse_ntriples(data: str, nthreads: int = 0) -> Optional[tuple]:
     """Parse a plain N-Triples document natively.
 
     Returns ``(ids, terms)`` where ``ids`` is an ``(n, 3) uint32`` array of
     1-based indices into ``terms`` (the unique term strings, in first-seen
-    order), or None to request the Python fallback.
+    order), or None to request the Python fallback.  ``nthreads``: 0 = auto
+    (parallel chunked parse past ~1MB); an explicit value >= 2 forces the
+    chunked path regardless of size (tests use this).
     """
     lib = load()
     if lib is None:
         return None
     raw = data.encode("utf-8")
     session = ctypes.c_void_p()
-    n = int(lib.kn_nt_parse_mt(raw, len(raw), 0, ctypes.byref(session)))
+    n = int(lib.kn_nt_parse_mt(raw, len(raw), nthreads, ctypes.byref(session)))
     if n < 0:
         return None  # -1 syntax error / -2 unsupported: Python decides
     try:

@@ -1,0 +1,432 @@
+"""Device (TPU) provenance semi-naive fixpoint for idempotent scalar
+semirings.
+
+The host provenance loop (:mod:`kolibrie_tpu.reasoner.provenance_seminaive`)
+runs per-derivation tag algebra in Python.  For the three IDEMPOTENT scalar
+semirings — MinMax (fuzzy), Boolean, Expiration (the cross-window SDS+
+workhorse) — the whole algebra collapses onto one device form: tags are an
+f64 column, ⊗ (conjunction over a derivation's premises) is ``min`` and
+⊕ (disjunction over derivations of the same fact) is ``max``:
+
+- minmax:     tags in [0,1] verbatim,     zero 0.0, one 1.0
+- boolean:    False/True → 0.0/1.0,       zero 0.0, one 1.0
+- expiration: expiry timestamps → f64 (exact below 2^53; FOREVER → +inf),
+              zero 0.0 (expired), one +inf (static)
+
+Because ⊕ is idempotent, duplicate discoveries of the same derivation are
+harmless — the per-seed delta expansion (every premise position seeded from
+the delta, remaining positions joined against ALL facts) needs no old/delta
+store split, unlike the non-idempotent host path (AddMult) which must count
+each derivation exactly once.  AddMult and the structural semirings
+(SDD/TopK/DNF) stay host-side.
+
+A round is one XLA program: delta-seeded premise joins with tag ``min``
+carried through the join chain, filter masks, conclusion instantiation,
+4-key sort so each (s,p,o) group's first row carries its ``max`` tag,
+match-against-facts index lookup, fact append + in-place tag improvement,
+and the next delta = new facts ∪ tag-improved facts.  The host drives
+rounds (one scalar sync per round) and doubles capacities on overflow, the
+same protocol as :meth:`DeviceFixpoint.infer_chunked`.
+
+Parity: ``datalog/.../provenance_semi_naive.rs:26-34,134-197`` (delta
+re-inclusion of improved tags, per-derivation ⊗, ⊕ merge, zero-pruning) —
+redesigned as whole-column device programs.  Agreement with the host path
+is tested in ``tests/test_device_provenance.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Set, Tuple
+
+import jax
+import numpy as np
+
+from kolibrie_tpu.ops import round_cap as _round_cap
+from kolibrie_tpu.reasoner.device_fixpoint import (
+    Unsupported,
+    _Caps,
+    _eval_filters,
+    _pack,
+    _scan_premise,
+    lower_rules,
+)
+from kolibrie_tpu.core.triple import Triple
+
+__all__ = ["supports", "infer_provenance_device", "AUTO_MIN_FACTS"]
+
+# below this many facts the host loop wins (device dispatch + compile cost)
+AUTO_MIN_FACTS = 20_000
+
+_IDEMPOTENT = ("minmax", "boolean", "expiration")
+
+_EXP_FOREVER = 0xFFFF_FFFF_FFFF_FFFF
+
+
+def supports(provenance) -> bool:
+    return getattr(provenance, "name", None) in _IDEMPOTENT
+
+
+def _encode_tags(provenance, tags) -> np.ndarray:
+    name = provenance.name
+    if name == "boolean":
+        return np.asarray([1.0 if t else 0.0 for t in tags], dtype=np.float64)
+    if name == "expiration":
+        return np.asarray(
+            [np.inf if t >= _EXP_FOREVER else float(t) for t in tags],
+            dtype=np.float64,
+        )
+    return np.asarray(tags, dtype=np.float64)
+
+
+def _decode_tag(provenance, v: float):
+    name = provenance.name
+    if name == "boolean":
+        return v > 0.5
+    if name == "expiration":
+        return _EXP_FOREVER if np.isinf(v) else int(round(v))
+    return float(v)
+
+
+# ---------------------------------------------------------------------------
+# Jitted round
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("rules", "caps"))
+def _prov_round(
+    rules: tuple,
+    caps: _Caps,
+    fs,
+    fp,
+    fo,
+    ftag,
+    n_facts,
+    ds,
+    dp,
+    do,
+    dtag,
+    n_delta,
+    one_enc,
+    masks,
+):
+    """One tagged semi-naive round.  Returns the updated fact columns/tags,
+    the next delta (new ∪ changed facts, with their stored tags), the count
+    of delta entries, and an overflow bitmask (bit0 join, bit1 delta cap,
+    bit2 fact cap).  An overflowing round does not commit.
+
+    Tag-store parity: ``ftag`` mirrors the host TagStore exactly — NaN
+    means "no explicit entry" (premise reads see ``one_enc``), and a fact's
+    FIRST derivation overwrites (``update_disjunction`` inserts the new tag
+    when no entry exists, tag_store.py:47-49) while later derivations
+    ⊕-merge with ``max``.  Delta tags (``dtag``) are effective values,
+    never NaN."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices, pack2
+
+    F, D, J = caps.fact, caps.delta, caps.join
+    fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
+    dvalid = jnp.arange(ds.shape[0], dtype=jnp.int32) < n_delta
+    fcols = (fs, fp, fo)
+    dcols = (ds, dp, do)
+
+    overflow = np.int32(0)
+    parts: List[tuple] = []  # (s, p, o, tag, valid) static-cap blocks
+    for rule in rules:
+        for order, keys in rule.plans:
+            seed = order[0]
+            table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
+            valid = m
+            tag = dtag
+            for step, j in enumerate(order[1:]):
+                ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
+                kv = keys[step]
+                lkey = _pack([table[v] for v in kv], valid, _LPAD)
+                rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
+                li, ri, jvalid, total = join_indices(lkey, rkey, J)
+                overflow = overflow | jnp.where(total > J, np.int32(1), 0)
+                new_table = {}
+                for v, c in table.items():
+                    new_table[v] = c[li]
+                for v, c in ptable.items():
+                    if v not in new_table:
+                        new_table[v] = c[ri]
+                # ⊗ = min: a derivation is as strong as its weakest premise;
+                # an absent (NaN) entry reads as one() for premises
+                ptag = ftag[ri]
+                ptag = jnp.where(jnp.isnan(ptag), one_enc, ptag)
+                tag = jnp.minimum(tag[li], ptag)
+                table, valid = new_table, jvalid
+            valid = _eval_filters(rule, table, valid, masks)
+            # zero-tag pruning (provenance_semi_naive.rs:171)
+            valid = valid & (tag > 0.0)
+            n = valid.shape[0]
+            for concl in rule.concls:
+                out = []
+                for kind, v in concl:
+                    if kind == "var":
+                        out.append(table[v])
+                    else:
+                        out.append(jnp.full(n, v, dtype=jnp.uint32))
+                parts.append((out[0], out[1], out[2], tag, valid))
+
+    cs = jnp.concatenate([p[0] for p in parts])
+    cp = jnp.concatenate([p[1] for p in parts])
+    co = jnp.concatenate([p[2] for p in parts])
+    ctag = jnp.concatenate([p[3] for p in parts])
+    cv = jnp.concatenate([p[4] for p in parts])
+
+    # group candidates by (s,p,o), each group's FIRST row carrying its max
+    # tag: 4-key sort with -tag as the tie-breaking key (⊕ = max)
+    sent = np.uint32(0xFFFFFFFF)
+    ss = jnp.where(cv, cs, sent)
+    sp = jnp.where(cv, cp, sent)
+    so = jnp.where(cv, co, sent)
+    stag = jnp.where(cv, ctag, 0.0)
+    ss, sp, so, negtag = lax.sort((ss, sp, so, -stag), num_keys=4)
+    utag = -negtag
+    isnew = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            (ss[1:] != ss[:-1]) | (sp[1:] != sp[:-1]) | (so[1:] != so[:-1]),
+        ]
+    )
+    isnew = isnew & (ss != sent)
+    n_uniq = jnp.sum(isnew)
+    overflow = overflow | jnp.where(n_uniq > D, np.int32(2), 0)
+    dest = jnp.where(isnew, jnp.cumsum(isnew) - 1, D)
+    us = jnp.zeros(D, jnp.uint32).at[dest].set(ss, mode="drop")
+    up = jnp.zeros(D, jnp.uint32).at[dest].set(sp, mode="drop")
+    uo = jnp.zeros(D, jnp.uint32).at[dest].set(so, mode="drop")
+    ut = jnp.zeros(D, jnp.float64).at[dest].set(utag, mode="drop")
+    uvalid = jnp.arange(D) < n_uniq
+
+    # exact (s,p,o) → fact-index lookup: dense-rank the (s,p) pair over the
+    # union, pack with o, binary-search the sorted fact keys
+    fsp = pack2(jnp.where(fvalid, fs, sent), jnp.where(fvalid, fp, sent))
+    usp = pack2(jnp.where(uvalid, us, sent), jnp.where(uvalid, up, sent))
+    union = jnp.sort(jnp.concatenate([fsp, usp]))
+    rank_f = jnp.searchsorted(union, fsp).astype(jnp.uint32)
+    rank_u = jnp.searchsorted(union, usp).astype(jnp.uint32)
+    fkey = pack2(rank_f, jnp.where(fvalid, fo, sent))
+    ukey = pack2(rank_u, jnp.where(uvalid, uo, sent))
+    forder = jnp.argsort(fkey)
+    fsorted = fkey[forder]
+    pos = jnp.clip(jnp.searchsorted(fsorted, ukey), 0, F - 1)
+    found = uvalid & (fsorted[pos] == ukey)
+    fidx = jnp.where(found, forder[pos], F)
+
+    old_tag = ftag[jnp.clip(fidx, 0, F - 1)]
+    # update_disjunction parity: no entry (NaN) → first derivation
+    # OVERWRITES; an existing entry ⊕-merges (max), changed iff it grew
+    absent = found & jnp.isnan(old_tag)
+    improved = found & (ut > old_tag)  # NaN compares False
+    changed = absent | improved
+    fresh = uvalid & ~found
+
+    # append new facts (tags included)
+    n_new = jnp.sum(fresh)
+    n_facts_next = n_facts + n_new
+    overflow = overflow | jnp.where(n_facts_next > F, np.int32(4), 0)
+    adest = jnp.where(fresh, n_facts + jnp.cumsum(fresh) - 1, F)
+    nfs = fs.at[adest].set(us, mode="drop")
+    nfp = fp.at[adest].set(up, mode="drop")
+    nfo = fo.at[adest].set(uo, mode="drop")
+    nftag = ftag.at[adest].set(ut, mode="drop")
+    # in-place store for changed facts: overwrite when absent, else the
+    # grown max (ut > old ⇒ max(old, ut) = ut in both cases)
+    nftag = nftag.at[jnp.where(changed, fidx, F)].set(ut, mode="drop")
+
+    # next delta = new ∪ changed facts, with their stored tags
+    dmask = fresh | changed
+    n_dnext = jnp.sum(dmask)
+    ddest = jnp.where(dmask, jnp.cumsum(dmask) - 1, D)
+    nds = jnp.zeros(D, jnp.uint32).at[ddest].set(us, mode="drop")
+    ndp = jnp.zeros(D, jnp.uint32).at[ddest].set(up, mode="drop")
+    ndo = jnp.zeros(D, jnp.uint32).at[ddest].set(uo, mode="drop")
+    ndt = jnp.zeros(D, jnp.float64).at[ddest].set(ut, mode="drop")
+
+    ok = overflow == 0
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    # delta buffers are driver-padded to exactly D, so shapes line up
+    return (
+        sel(nfs, fs),
+        sel(nfp, fp),
+        sel(nfo, fo),
+        sel(nftag, ftag),
+        sel(n_facts_next, n_facts),
+        sel(nds, ds),
+        sel(ndp, dp),
+        sel(ndo, do),
+        sel(ndt, dtag),
+        sel(n_dnext.astype(jnp.int32), np.int32(0)),
+        overflow,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host driver + integration
+# ---------------------------------------------------------------------------
+
+
+def infer_provenance_device(
+    reasoner,
+    provenance,
+    tag_store,
+    initial_delta: Optional[Set[Tuple[int, int, int]]] = None,
+    max_attempts: int = 32,
+) -> Optional[Dict[Tuple[int, int, int], float]]:
+    """Run the tagged fixpoint on device; returns None for host fallback.
+
+    On success the derived facts are appended to ``reasoner.facts`` and
+    ``tag_store`` holds the final tags (exactly like the host path).
+    """
+    if not supports(provenance):
+        return None
+    if any(r.negative_premise for r in reasoner.rules):
+        return None  # stratified NAF stays host-side
+    try:
+        rules, bank = lower_rules(reasoner, reasoner.rules)
+    except Unsupported:
+        return None
+    if not rules:
+        return None
+
+    import jax.numpy as jnp
+
+    s, p, o = reasoner.facts.columns()
+    n0 = len(s)
+    if n0 == 0:
+        return None
+    facts_keys = list(zip(s.tolist(), p.tolist(), o.tolist()))
+    get_opt = tag_store.get_opt
+    one = provenance.one()
+    one_enc = float(_encode_tags(provenance, [one])[0])
+    # NaN = "no explicit TagStore entry" (reads as one() for premises, but
+    # the first derivation OVERWRITES — exact update_disjunction parity)
+    host_tags = [get_opt(Triple(*k)) for k in facts_keys]
+    tags0 = np.where(
+        [t is None for t in host_tags],
+        np.nan,
+        _encode_tags(provenance, [one if t is None else t for t in host_tags]),
+    )
+
+    masks = tuple(jnp.asarray(m) for m in bank.materialize()) or (
+        jnp.zeros(1, dtype=bool),
+    )
+
+    # delta tags are EFFECTIVE values (absent resolves to one())
+    eff0 = np.where(np.isnan(tags0), one_enc, tags0)
+    if initial_delta is not None:
+        key_to_idx = {k: i for i, k in enumerate(facts_keys)}
+        didx = [key_to_idx[k] for k in initial_delta if k in key_to_idx]
+        if not didx:
+            return {}
+        d_s = s[didx]
+        d_p = p[didx]
+        d_o = o[didx]
+        d_t = eff0[didx]
+    else:
+        d_s, d_p, d_o, d_t = s, p, o, eff0
+    nd0 = len(d_s)
+
+    F = _round_cap(4 * n0, 2048)
+    D = _round_cap(max(2 * nd0, n0 // 2, 1024))
+    J = _round_cap(4 * max(nd0, 1024), 1024)
+
+    with jax.enable_x64(True):
+
+        def padu(x, cap):
+            x = jnp.asarray(x, dtype=jnp.uint32)
+            return jnp.concatenate(
+                [x, jnp.zeros(cap - x.shape[0], dtype=jnp.uint32)]
+            )
+
+        def padf(x, cap):
+            x = jnp.asarray(x, dtype=jnp.float64)
+            return jnp.concatenate(
+                [x, jnp.zeros(cap - x.shape[0], dtype=jnp.float64)]
+            )
+
+        fs, fp, fo = padu(s, F), padu(p, F), padu(o, F)
+        ftag = padf(tags0, F)
+        n_facts = n0
+        dels, delp, delo = padu(d_s, D), padu(d_p, D), padu(d_o, D)
+        delt = padf(d_t, D)
+        n_delta = nd0
+        attempts = 0
+        for _round in range(10_000):
+            out = _prov_round(
+                rules,
+                _Caps(F, D, J),
+                fs,
+                fp,
+                fo,
+                ftag,
+                jnp.int32(n_facts),
+                dels,
+                delp,
+                delo,
+                delt,
+                jnp.int32(n_delta),
+                jnp.float64(one_enc),
+                masks,
+            )
+            code = int(out[10])  # one sync per round
+            if code != 0:
+                attempts += 1
+                if attempts > max_attempts:
+                    return None  # graceful host fallback (state untouched)
+                if code & 1:
+                    J *= 2
+                if code & 2:
+                    D *= 2
+                    dels, delp, delo = (
+                        padu(dels, D),
+                        padu(delp, D),
+                        padu(delo, D),
+                    )
+                    delt = padf(delt, D)
+                if code & 4:
+                    newF = F * 2
+                    fs, fp, fo = padu(fs, newF), padu(fp, newF), padu(fo, newF)
+                    ftag = padf(ftag, newF)
+                    F = newF
+                continue  # retry the round (it did not commit)
+            fs, fp, fo, ftag = out[0], out[1], out[2], out[3]
+            n_facts = int(out[4])
+            dels, delp, delo, delt = out[5], out[6], out[7], out[8]
+            n_delta = int(out[9])
+            if n_delta == 0:
+                break
+        else:
+            return None  # round limit: graceful host fallback
+
+        # write back: new facts into the store; every changed-or-new tag
+        # entry into the tag store.  Host parity: each derived fact gets an
+        # explicit entry (update_disjunction inserts on first derivation);
+        # NaN still means "no entry".
+        fs_h = np.asarray(fs[:n_facts])
+        fp_h = np.asarray(fp[:n_facts])
+        fo_h = np.asarray(fo[:n_facts])
+        ft_h = np.asarray(ftag[:n_facts])
+        if n_facts > n0:
+            reasoner.facts.add_batch(fs_h[n0:], fp_h[n0:], fo_h[n0:])
+        tags = tag_store.tags
+        for i in range(n_facts):
+            v = float(ft_h[i])
+            if np.isnan(v):
+                continue  # still no entry
+            if i < n0:
+                v0 = float(tags0[i])
+                if not np.isnan(v0) and v == v0:
+                    continue  # unchanged existing entry
+            tags[(int(fs_h[i]), int(fp_h[i]), int(fo_h[i]))] = _decode_tag(
+                provenance, v
+            )
+    return {}

@@ -131,6 +131,22 @@ def infer_with_provenance(
     """
     if tag_store is None:
         tag_store = seed_tag_store(reasoner, provenance)
+
+    # idempotent scalar semirings (minmax/boolean/expiration) above the
+    # size threshold run the whole tagged fixpoint on device (tags as an
+    # f64 column, ⊕=max ⊗=min); None → host loop below
+    from kolibrie_tpu.reasoner import device_provenance
+
+    if (
+        device_provenance.supports(provenance)
+        and len(reasoner.facts) >= device_provenance.AUTO_MIN_FACTS
+        and device_provenance.infer_provenance_device(
+            reasoner, provenance, tag_store, initial_delta
+        )
+        is not None
+    ):
+        return tag_store
+
     pos_rules, neg_rules = _positive_stratum_rules(reasoner.rules)
 
     facts = reasoner.facts

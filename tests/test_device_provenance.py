@@ -1,0 +1,285 @@
+"""Agreement tests: device tagged fixpoint vs the host provenance loop.
+
+The host provenance semi-naive path is the oracle, the same pattern as the
+untagged device-fixpoint tests.  Covers the three idempotent scalar
+semirings (minmax/boolean/expiration), tag-improvement propagation,
+initial-delta (incremental SDS+) entry, filters, and fallback cases.
+"""
+
+import pytest
+
+from kolibrie_tpu.core.rule import FilterCondition
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.reasoner.device_provenance import (
+    infer_provenance_device,
+    supports,
+)
+from kolibrie_tpu.reasoner.provenance import (
+    AddMultProbability,
+    BooleanProvenance,
+    ExpirationProvenance,
+    MinMaxProbability,
+)
+from kolibrie_tpu.reasoner.provenance_seminaive import (
+    infer_with_provenance,
+    seed_tag_store,
+)
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+
+def _tags_of(reasoner, provenance, store):
+    """(facts, the EXACT explicit-tag map) — the device path must reproduce
+    the host TagStore entry-for-entry, including one()-valued entries that
+    update_disjunction stores for derived facts."""
+    return reasoner.facts.triples_set(), dict(store.tags)
+
+
+def both_paths(build, provenance, initial_delta=None):
+    r_host = build()
+    host_store = seed_tag_store(r_host, provenance)
+    infer_with_provenance(
+        r_host, provenance, host_store, initial_delta=initial_delta
+    )
+    r_dev = build()
+    dev_store = seed_tag_store(r_dev, provenance)
+    out = infer_provenance_device(
+        r_dev, provenance, dev_store, initial_delta=initial_delta
+    )
+    assert out is not None, "device path refused a supported configuration"
+    return _tags_of(r_host, provenance, host_store), _tags_of(
+        r_dev, provenance, dev_store
+    )
+
+
+def _chain_builder(n=20, prob=True):
+    def build():
+        r = Reasoner()
+        for i in range(n):
+            if prob:
+                r.add_tagged_triple(
+                    f"n{i}", "next", f"n{i + 1}", 0.5 + 0.02 * (i % 20)
+                )
+            else:
+                r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    return build
+
+
+def test_minmax_chain_agreement():
+    (hf, ht), (df, dt) = both_paths(_chain_builder(), MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_boolean_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(12):
+            r.add_abox_triple(f"p{i}", "worksAt", f"org{i % 3}")
+            r.add_abox_triple(f"org{i % 3}", "partOf", "corp")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "worksAt", "?o"), ("?o", "partOf", "?c")],
+                [("?x", "memberOf", "?c")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, BooleanProvenance())
+    assert hf == df
+    assert ht == dt
+
+
+def test_expiration_sds_style_agreement():
+    """Expiry tags: derived facts live as long as their shortest premise."""
+
+    def build():
+        r = Reasoner()
+        for i in range(15):
+            r.add_abox_triple(f"s{i}", "observes", f"s{i + 1}")
+        return r
+
+    prov = ExpirationProvenance()
+
+    def run(path):
+        r = build()
+        store = seed_tag_store(r, prov)
+        # per-fact expiries (the S2R window feed would set these)
+        s, p, o = r.facts.columns()
+        for j, k in enumerate(zip(s.tolist(), p.tolist(), o.tolist())):
+            store.tags[Triple(*k)] = 1000 + 37 * j
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "observes", "?y"), ("?y", "observes", "?z")],
+                [("?x", "reaches", "?z")],
+            )
+        )
+        if path == "host":
+            infer_with_provenance(r, prov, store)
+        else:
+            assert (
+                infer_provenance_device(r, prov, store) is not None
+            )
+        return _tags_of(r, prov, store)
+
+    assert run("host") == run("device")
+
+
+def test_tag_improvement_propagates():
+    """A better tag arriving via a longer path must overwrite and re-fire."""
+
+    def build():
+        r = Reasoner()
+        # two routes a->c: direct weak edge, and strong 2-hop route
+        r.add_tagged_triple("a", "next", "c", 0.1)
+        r.add_tagged_triple("a", "next", "b", 0.9)
+        r.add_tagged_triple("b", "next", "c", 0.8)
+        r.add_tagged_triple("c", "next", "d", 0.7)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    prov = MinMaxProbability()
+    (hf, ht), (df, dt) = both_paths(build, prov)
+    assert hf == df
+    assert ht == dt
+    # the a->c tag must be max(0.1 direct, min(0.9, 0.8) via b) = 0.8, and
+    # a->d must ride the improved a->c: min(0.8, 0.7) = 0.7
+    r = build()
+    d = r.dictionary
+    a, nxt, c_, dd = (d.encode(x) for x in ("a", "next", "c", "d"))
+    assert dt[Triple(a, nxt, c_)] == pytest.approx(0.8)
+    assert dt[Triple(a, nxt, dd)] == pytest.approx(0.7)
+
+
+def test_initial_delta_incremental_agreement():
+    """Incremental SDS+ entry: only the delta facts seed round one."""
+
+    def build():
+        r = Reasoner()
+        for i in range(10):
+            r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    prov = ExpirationProvenance()
+
+    def delta_of(r):
+        d = r.dictionary
+        return {
+            (d.encode("n3"), d.encode("next"), d.encode("n4")),
+            (d.encode("n7"), d.encode("next"), d.encode("n8")),
+        }
+
+    def run(path):
+        r = build()
+        store = seed_tag_store(r, prov)
+        s, p, o = r.facts.columns()
+        for j, k in enumerate(zip(s.tolist(), p.tolist(), o.tolist())):
+            store.tags[Triple(*k)] = 5000 + 13 * j
+        if path == "host":
+            infer_with_provenance(
+                r, prov, store, initial_delta=delta_of(r)
+            )
+        else:
+            assert (
+                infer_provenance_device(
+                    r, prov, store, initial_delta=delta_of(r)
+                )
+                is not None
+            )
+        return _tags_of(r, prov, store)
+
+    assert run("host") == run("device")
+
+
+def test_filter_rule_agreement():
+    def build():
+        r = Reasoner()
+        for i in range(14):
+            r.add_tagged_triple(
+                f"item{i}", "price", f'"{i * 10}"', 0.3 + 0.05 * (i % 10)
+            )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "price", "?v")],
+                [("?x", "expensive", "yes")],
+                filters=[FilterCondition("v", ">", 60.0)],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_rederived_untagged_base_fact_gets_overwritten_tag():
+    """update_disjunction semantics: a base fact with NO explicit entry that
+    gets re-derived receives the derivation's tag (first update inserts, it
+    does not ⊕-merge with an implicit one())."""
+
+    def build():
+        r = Reasoner()
+        # a->c exists untagged; it is also derivable via a->b->c with
+        # weaker tags, so its stored tag must become min(0.6, 0.5) = 0.5
+        r.add_abox_triple("a", "next", "c")
+        r.add_tagged_triple("a", "next", "b", 0.6)
+        r.add_tagged_triple("b", "next", "c", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    prov = MinMaxProbability()
+    (hf, ht), (df, dt) = both_paths(build, prov)
+    assert hf == df
+    assert ht == dt
+    r = build()
+    d = r.dictionary
+    key = (d.encode("a"), d.encode("next"), d.encode("c"))
+    assert dt[key] == pytest.approx(0.5)
+
+
+def test_addmult_not_supported():
+    assert not supports(AddMultProbability())
+    r = _chain_builder()()
+    store = seed_tag_store(r, AddMultProbability())
+    assert (
+        infer_provenance_device(r, AddMultProbability(), store) is None
+    )
+
+
+def test_naf_rules_fall_back():
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    r.add_abox_triple("b", "broken", "yes")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?x", "ok", "?y")],
+            negative=[("?y", "broken", "yes")],
+        )
+    )
+    prov = MinMaxProbability()
+    store = seed_tag_store(r, prov)
+    assert infer_provenance_device(r, prov, store) is None

@@ -166,35 +166,14 @@ def test_nt_bulk_parse_agreement():
 
 
 def _parse_with_threads(doc: str, nthreads: int):
-    """Call the multithreaded ctypes entry with an EXPLICIT thread count so
-    the chunk-split/merge/remap path runs even on tiny documents."""
-    import ctypes
+    """Production decode path (bulk_parse_ntriples) with an EXPLICIT thread
+    count so the chunk-split/merge/remap path runs even on tiny documents."""
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
 
-    lib = native_loader.load()
-    raw = doc.encode("utf-8")
-    session = ctypes.c_void_p()
-    n = int(lib.kn_nt_parse_mt(raw, len(raw), nthreads, ctypes.byref(session)))
-    if n < 0:
-        return n, None, None
-    try:
-        ids = np.empty(n * 3, dtype=np.uint32)
-        if n:
-            lib.kn_nt_ids(
-                session, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
-            )
-        n_terms = int(lib.kn_nt_nterms(session))
-        nbytes = int(lib.kn_nt_term_bytes(session))
-        buf = ctypes.create_string_buffer(nbytes)
-        offsets = (ctypes.c_int64 * (n_terms + 1))()
-        lib.kn_nt_terms(session, buf, offsets)
-        blob = buf.raw
-        terms = [
-            blob[offsets[i]: offsets[i + 1]].decode("utf-8", "surrogatepass")
-            for i in range(n_terms)
-        ]
-    finally:
-        lib.kn_nt_free(session)
-    return n, ids.reshape(n, 3), terms
+    result = bulk_parse_ntriples(doc, nthreads=nthreads)
+    assert result is not None
+    ids, terms = result
+    return ids.shape[0], ids, terms
 
 
 def _decoded_triples(n, ids, terms):
