@@ -93,10 +93,26 @@ def load():
             return None
         try:
             _lib = _declare(ctypes.CDLL(_SO_PATH))
-        except (OSError, AttributeError):
-            # AttributeError: a stale prebuilt .so missing newly-required
-            # symbols (mtime check fooled by copied artifacts) — degrade to
-            # the pure-Python paths instead of crashing every parse
+        except AttributeError:
+            # a stale prebuilt .so missing newly-required symbols (mtime
+            # check fooled by copied artifacts): treat as staleness —
+            # rebuild once, then degrade to pure-Python with a warning
+            _lib = None
+            if _build():
+                try:
+                    _lib = _declare(ctypes.CDLL(_SO_PATH))
+                except (OSError, AttributeError):
+                    _lib = None
+            if _lib is None:
+                import warnings
+
+                warnings.warn(
+                    "kolibrie_tpu native library is stale and could not be "
+                    "rebuilt; falling back to pure-Python paths",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        except OSError:
             _lib = None
         return _lib
 

@@ -51,8 +51,6 @@ from kolibrie_tpu.reasoner.device_fixpoint import (
     _scan_premise,
     lower_rules,
 )
-from kolibrie_tpu.core.triple import Triple
-
 __all__ = ["supports", "infer_provenance_device", "AUTO_MIN_FACTS"]
 
 # below this many facts the host loop wins (device dispatch + compile cost)
@@ -77,15 +75,6 @@ def _encode_tags(provenance, tags) -> np.ndarray:
             dtype=np.float64,
         )
     return np.asarray(tags, dtype=np.float64)
-
-
-def _decode_tag(provenance, v: float):
-    name = provenance.name
-    if name == "boolean":
-        return v > 0.5
-    if name == "expiration":
-        return _EXP_FOREVER if np.isinf(v) else int(round(v))
-    return float(v)
 
 
 # ---------------------------------------------------------------------------
@@ -304,12 +293,12 @@ def infer_provenance_device(
     if n0 == 0:
         return None
     facts_keys = list(zip(s.tolist(), p.tolist(), o.tolist()))
-    get_opt = tag_store.get_opt
+    tget = tag_store.tags.get  # keys are plain (s, p, o) tuples
     one = provenance.one()
     one_enc = float(_encode_tags(provenance, [one])[0])
     # NaN = "no explicit TagStore entry" (reads as one() for premises, but
     # the first derivation OVERWRITES — exact update_disjunction parity)
-    host_tags = [get_opt(Triple(*k)) for k in facts_keys]
+    host_tags = [tget(k) for k in facts_keys]
     tags0 = np.where(
         [t is None for t in host_tags],
         np.nan,
@@ -337,7 +326,9 @@ def infer_provenance_device(
 
     F = _round_cap(4 * n0, 2048)
     D = _round_cap(max(2 * nd0, n0 // 2, 1024))
-    J = _round_cap(4 * max(nd0, 1024), 1024)
+    # start TIGHT: the candidate sort scales with J × plans, and the
+    # overflow protocol doubles J cheaply when a round actually needs it
+    J = _round_cap(max(nd0, 1024), 1024)
 
     with jax.enable_x64(True):
 
@@ -408,25 +399,34 @@ def infer_provenance_device(
             return None  # round limit: graceful host fallback
 
         # write back: new facts into the store; every changed-or-new tag
-        # entry into the tag store.  Host parity: each derived fact gets an
-        # explicit entry (update_disjunction inserts on first derivation);
-        # NaN still means "no entry".
+        # entry into the tag store (vectorized — no per-fact Python loop).
+        # Host parity: each derived fact gets an explicit entry
+        # (update_disjunction inserts on first derivation); NaN still means
+        # "no entry".
         fs_h = np.asarray(fs[:n_facts])
         fp_h = np.asarray(fp[:n_facts])
         fo_h = np.asarray(fo[:n_facts])
         ft_h = np.asarray(ftag[:n_facts])
         if n_facts > n0:
             reasoner.facts.add_batch(fs_h[n0:], fp_h[n0:], fo_h[n0:])
-        tags = tag_store.tags
-        for i in range(n_facts):
-            v = float(ft_h[i])
-            if np.isnan(v):
-                continue  # still no entry
-            if i < n0:
-                v0 = float(tags0[i])
-                if not np.isnan(v0) and v == v0:
-                    continue  # unchanged existing entry
-            tags[(int(fs_h[i]), int(fp_h[i]), int(fo_h[i]))] = _decode_tag(
-                provenance, v
+        has_entry = ~np.isnan(ft_h)
+        unchanged = np.zeros(n_facts, dtype=bool)
+        unchanged[:n0] = ~np.isnan(tags0) & (ft_h[:n0] == tags0)
+        sel = np.flatnonzero(has_entry & ~unchanged)
+        if sel.size:
+            vals = ft_h[sel]
+            name = provenance.name
+            if name == "boolean":
+                decoded = (vals > 0.5).tolist()
+            elif name == "expiration":
+                decoded = [
+                    _EXP_FOREVER if np.isinf(v) else int(round(v))
+                    for v in vals.tolist()
+                ]
+            else:
+                decoded = vals.tolist()
+            keys = zip(
+                fs_h[sel].tolist(), fp_h[sel].tolist(), fo_h[sel].tolist()
             )
+            tag_store.tags.update(zip(keys, decoded))
     return {}

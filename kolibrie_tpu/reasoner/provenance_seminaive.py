@@ -29,6 +29,12 @@ from kolibrie_tpu.reasoner.strategies import (
 )
 from kolibrie_tpu.reasoner.tag_store import TagStore
 
+
+def _default_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
 TripleKey = Tuple[int, int, int]
 
 
@@ -134,12 +140,16 @@ def infer_with_provenance(
 
     # idempotent scalar semirings (minmax/boolean/expiration) above the
     # size threshold run the whole tagged fixpoint on device (tags as an
-    # f64 column, ⊕=max ⊗=min); None → host loop below
+    # f64 column, ⊕=max ⊗=min); None → host loop below.  Auto-routing is
+    # TPU-only: the XLA CPU backend's sorts lose to the numpy host loop
+    # (see benches/bench_device_provenance.py), so CPU callers must opt in
+    # via infer_provenance_device directly.
     from kolibrie_tpu.reasoner import device_provenance
 
     if (
         device_provenance.supports(provenance)
         and len(reasoner.facts) >= device_provenance.AUTO_MIN_FACTS
+        and _default_backend() == "tpu"
         and device_provenance.infer_provenance_device(
             reasoner, provenance, tag_store, initial_delta
         )

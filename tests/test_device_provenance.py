@@ -260,6 +260,28 @@ def test_rederived_untagged_base_fact_gets_overwritten_tag():
     assert dt[key] == pytest.approx(0.5)
 
 
+def test_auto_hook_routes_on_tpu_backend(monkeypatch):
+    """infer_with_provenance auto-routes to the device path only when the
+    backend is TPU and the store is big enough."""
+    import kolibrie_tpu.reasoner.provenance_seminaive as ps
+    from kolibrie_tpu.reasoner import device_provenance as dp
+
+    calls = []
+    orig = dp.infer_provenance_device
+
+    def fake_device(reasoner, provenance, tag_store, initial_delta=None):
+        calls.append(True)
+        return orig(reasoner, provenance, tag_store, initial_delta)
+
+    monkeypatch.setattr(ps, "_default_backend", lambda: "tpu")
+    monkeypatch.setattr(dp, "AUTO_MIN_FACTS", 0)
+    monkeypatch.setattr(dp, "infer_provenance_device", fake_device)
+    r = _chain_builder(10)()
+    store = infer_with_provenance(r, MinMaxProbability())
+    assert calls, "device hook did not fire on the TPU backend"
+    assert len(store.tags) > 10
+
+
 def test_addmult_not_supported():
     assert not supports(AddMultProbability())
     r = _chain_builder()()
