@@ -95,23 +95,25 @@ def load():
             _lib = _declare(ctypes.CDLL(_SO_PATH))
         except AttributeError:
             # a stale prebuilt .so missing newly-required symbols (mtime
-            # check fooled by copied artifacts): treat as staleness —
-            # rebuild once, then degrade to pure-Python with a warning
+            # check fooled by copied artifacts).  Rebuild for FUTURE
+            # processes — re-dlopening the same path in THIS process would
+            # return the cached stale handle (glibc dedups by pathname), so
+            # this process degrades to the pure-Python paths.
             _lib = None
-            if _build():
-                try:
-                    _lib = _declare(ctypes.CDLL(_SO_PATH))
-                except (OSError, AttributeError):
-                    _lib = None
-            if _lib is None:
-                import warnings
+            rebuilt = _build()
+            import warnings
 
-                warnings.warn(
-                    "kolibrie_tpu native library is stale and could not be "
-                    "rebuilt; falling back to pure-Python paths",
-                    RuntimeWarning,
-                    stacklevel=2,
+            warnings.warn(
+                "kolibrie_tpu native library was stale; "
+                + (
+                    "rebuilt for the next run — "
+                    if rebuilt
+                    else "rebuild failed — "
                 )
+                + "this process falls back to pure-Python paths",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         except OSError:
             _lib = None
         return _lib

@@ -29,6 +29,7 @@ from kolibrie_tpu.parallel.dist_general import (
     DistGeneralReasoner,
     distributed_seminaive_general,
 )
+from kolibrie_tpu.parallel.dist_provenance import DistProvenanceReasoner
 from kolibrie_tpu.parallel.train_step import (
     dp_train_step,
     make_train_state,
@@ -44,6 +45,7 @@ __all__ = [
     "DistRuleSet",
     "DistributedReasoner",
     "DistGeneralReasoner",
+    "DistProvenanceReasoner",
     "distributed_seminaive",
     "distributed_seminaive_general",
     "dp_train_step",

@@ -1,0 +1,549 @@
+"""Distributed provenance semi-naive fixpoint: tag columns over the mesh.
+
+Extends the general distributed fixpoint
+(:mod:`kolibrie_tpu.parallel.dist_general`) with f64 semiring tag columns
+for the idempotent scalar semirings (minmax / boolean / expiration — the
+same family the single-chip device path accelerates,
+:mod:`kolibrie_tpu.reasoner.device_provenance`): ⊗ = ``min`` carried
+through the routed join chain, ⊕ = ``max`` via group-max dedup on the
+conclusion owner shard, in-place tag improvement on the owner, and
+improved facts re-entering the delta.  Tags ride the same ``all_to_all``
+exchanges as the binding columns (``bucketize`` is dtype-generic), and the
+fixpoint terminates on ``psum(new + improved) == 0``.
+
+TagStore parity follows the single-chip device path exactly: NaN in a tag
+column means "no explicit TagStore entry" — premise reads see ``one()``,
+but a fact's first derivation OVERWRITES (``update_disjunction`` inserts),
+later derivations ⊕-merge.
+
+The subject-owned fact block is authoritative for tags; the object-hash
+mirror's tag column is refreshed for new AND improved facts (routed to the
+object owner and scattered by exact (s,p,o) index lookup) so object-keyed
+premise reads stay consistent.
+
+Stratified NAF stays host-side (`Unsupported`), as do AddMult and the
+structural semirings.
+
+Parity: ``datalog/.../provenance_semi_naive.rs:26-34,134-197`` over
+``semi_naive_parallel.rs``'s partitioning — redesigned as mesh-partitioned
+tagged columnar joins with ICI all-to-all.  Agreement with the host
+provenance loop is tested in ``tests/test_dist_provenance.py`` on the
+virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kolibrie_tpu.ops import round_cap
+from kolibrie_tpu.parallel.dist_fixpoint import _bsearch, _member3
+from kolibrie_tpu.parallel.dist_join import (
+    _LPAD32,
+    _RPAD32,
+    exchange,
+    local_join_u32,
+    shard_of_dev,
+)
+from kolibrie_tpu.parallel.dist_general import (
+    _instantiate,
+    _pos2var,
+    lower_rules_dist,
+)
+from kolibrie_tpu.parallel.sharded_store import partition_rows, shard_of
+from kolibrie_tpu.reasoner.device_fixpoint import Unsupported, _scan_premise
+from kolibrie_tpu.reasoner.device_provenance import (
+    _decode_tags,
+    _seed_tag_arrays,
+    supports,
+)
+
+__all__ = ["DistProvenanceReasoner", "Unsupported"]
+
+
+def _index3(ours, ours_valid, theirs, theirs_valid, miss):
+    """Exact (s,p,o) → row index into ``theirs`` (``miss`` when absent).
+
+    Same 3-level narrowing as ``_member3`` but sorts an index operand along
+    so the matched SORTED position maps back to the original row."""
+    n = theirs[0].shape[0]
+    ts, tp, to = (
+        jnp.where(theirs_valid, c.astype(jnp.uint32), _RPAD32) for c in theirs
+    )
+    perm0 = jnp.arange(n, dtype=jnp.int32)
+    ts, tp, to, perm = lax.sort((ts, tp, to, perm0), num_keys=3)
+    s = jnp.where(ours_valid, ours[0].astype(jnp.uint32), _LPAD32)
+    pcol = ours[1].astype(jnp.uint32)
+    o = ours[2].astype(jnp.uint32)
+    zero = jnp.zeros_like(s, dtype=jnp.int32)
+    full = jnp.full_like(zero, n)
+    lo1 = _bsearch(ts, zero, full, s)
+    hi1 = _bsearch(ts, zero, full, s + 1)
+    lo2 = _bsearch(tp, lo1, hi1, pcol)
+    hi2 = _bsearch(tp, lo1, hi1, pcol + 1)
+    lo3 = _bsearch(to, lo2, hi2, o)
+    idx = jnp.clip(lo3, 0, n - 1)
+    found = ours_valid & (lo3 < hi2) & (to[idx] == o)
+    return jnp.where(found, perm[idx], miss), found
+
+
+def _exchange_tagged(table, tag, valid, key_col, n, axis, bucket_cap):
+    """Route a binding table + its tag column to ``hash(key_col)`` owners."""
+    names = sorted(table)
+    cols = tuple(table[v] for v in names) + (tag,)
+    routed, rvalid, dropped = exchange(
+        cols, valid, shard_of_dev(key_col, n), n, axis, bucket_cap
+    )
+    return dict(zip(names, routed[:-1])), routed[-1], rvalid, dropped
+
+
+def _tagged_round(
+    state,
+    masks,
+    one_enc,
+    *,
+    rules,
+    n,
+    axis,
+    fact_cap,
+    delta_cap,
+    join_cap,
+    bucket_cap,
+):
+    (
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag,
+        gv,
+        ds,
+        dp_,
+        do_,
+        dtag,
+        dv,
+    ) = (a[0] for a in state)
+    masks = tuple(m for m in masks)
+    one_enc = one_enc[0]
+
+    fcols = (fs, fp, fo)
+    overflow = jnp.int32(0)
+    parts: List[tuple] = []
+
+    for lr, plans in rules:
+        for seed, steps in plans:
+            table, valid = _scan_premise(lr.premises[seed], (ds, dp_, do_), dv)
+            tag = dtag  # delta tags are EFFECTIVE values (never NaN)
+            for (j, kv, kpos, extra) in steps:
+                prem = lr.premises[j]
+                table, tag, valid, dropped = _exchange_tagged(
+                    table, tag, valid, table[kv], n, axis, bucket_cap
+                )
+                overflow = overflow + dropped.astype(jnp.int32)
+                if kpos == 0:
+                    side_cols, side_valid, side_key, side_tag = (
+                        fcols,
+                        fv,
+                        fs,
+                        ftag,
+                    )
+                else:
+                    side_cols, side_valid, side_key, side_tag = (
+                        (gs, gp, go),
+                        gv,
+                        go,
+                        gtag,
+                    )
+                ptable, pmask = _scan_premise(prem, side_cols, side_valid)
+                li, ri, jvalid, total = local_join_u32(
+                    table[kv], side_key, join_cap, valid, pmask
+                )
+                overflow = overflow + lax.psum(
+                    jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+                )
+                new_table = {v: c[li] for v, c in table.items()}
+                for v, c in ptable.items():
+                    if v not in new_table:
+                        new_table[v] = c[ri]
+                    elif v in extra:
+                        jvalid = jvalid & (new_table[v] == c[ri])
+                # ⊗ = min; absent (NaN) premise entries read as one()
+                ptag = side_tag[ri]
+                ptag = jnp.where(jnp.isnan(ptag), one_enc, ptag)
+                tag = jnp.minimum(tag[li], ptag)
+                table, valid = new_table, jvalid
+            for f in lr.filters:
+                col = table[f.var]
+                if f.kind == "eq":
+                    valid = valid & (col == np.uint32(f.const_id))
+                elif f.kind == "ne":
+                    valid = valid & (col != np.uint32(f.const_id))
+                else:
+                    m = masks[f.mask_idx]
+                    valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+            # zero-tag pruning
+            valid = valid & (tag > 0.0)
+            L = valid.shape[0]
+            for concl in lr.concls:
+                cols = []
+                for kind, v in concl:
+                    if kind == "const":
+                        cols.append(jnp.full(L, v, dtype=jnp.uint32))
+                    else:
+                        cols.append(table[v])
+                parts.append((cols[0], cols[1], cols[2], tag, valid))
+
+    cs = jnp.concatenate([p[0] for p in parts])
+    cp = jnp.concatenate([p[1] for p in parts])
+    co = jnp.concatenate([p[2] for p in parts])
+    ct = jnp.concatenate([p[3] for p in parts])
+    cv = jnp.concatenate([p[4] for p in parts])
+
+    # route candidates (with tags) to their subject owner
+    (rs_, rp_, ro_, rt_), rv_, drop1 = exchange(
+        (cs, cp, co, ct), cv, shard_of_dev(cs, n), n, axis, bucket_cap
+    )
+    overflow = overflow + drop1.astype(jnp.int32)
+
+    # group-max dedup: 4-key sort with -tag tiebreak, first row per (s,p,o)
+    # group carries its ⊕ (max) tag
+    sent = _RPAD32
+    ss = jnp.where(rv_, rs_, sent)
+    sp = jnp.where(rv_, rp_, sent)
+    so = jnp.where(rv_, ro_, sent)
+    st = jnp.where(rv_, rt_, 0.0)
+    ss, sp, so, negtag = lax.sort((ss, sp, so, -st), num_keys=4)
+    ut_sorted = -negtag
+    isnew = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            (ss[1:] != ss[:-1]) | (sp[1:] != sp[:-1]) | (so[1:] != so[:-1]),
+        ]
+    )
+    isnew = isnew & (ss != sent)
+    n_uniq = jnp.sum(isnew)
+    overflow = overflow + lax.psum(
+        jnp.maximum(n_uniq.astype(jnp.int32) - delta_cap, 0), axis
+    )
+    dest = jnp.where(isnew, jnp.cumsum(isnew) - 1, delta_cap)
+    us = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(ss, mode="drop")
+    up = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(sp, mode="drop")
+    uo = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(so, mode="drop")
+    ut = jnp.zeros(delta_cap, jnp.float64).at[dest].set(ut_sorted, mode="drop")
+    uv = jnp.arange(delta_cap) < n_uniq
+
+    # owner-local exact lookup: index into the subject-owned fact block
+    fidx, found = _index3((us, up, uo), uv, fcols, fv, fact_cap)
+    old_tag = ftag[jnp.clip(fidx, 0, fact_cap - 1)]
+    absent = found & jnp.isnan(old_tag)
+    improved = found & (ut > old_tag)  # NaN compares False
+    changed = absent | improved
+    fresh = uv & ~found
+
+    # append new facts (with tags) to the subject-owned block
+    n_fact_local = jnp.sum(fv)
+    n_new = jnp.sum(fresh)
+    overflow = overflow + lax.psum(
+        jnp.maximum(
+            (n_fact_local + n_new).astype(jnp.int32) - fact_cap, 0
+        ),
+        axis,
+    )
+    adest = jnp.where(fresh, n_fact_local + jnp.cumsum(fresh) - 1, fact_cap)
+    fs = fs.at[adest].set(us, mode="drop")
+    fp = fp.at[adest].set(up, mode="drop")
+    fo = fo.at[adest].set(uo, mode="drop")
+    ftag = ftag.at[adest].set(ut, mode="drop")
+    fv = fv.at[adest].set(jnp.ones(delta_cap, bool), mode="drop")
+    # in-place store for changed facts (overwrite-or-grown-max = ut)
+    ftag = ftag.at[jnp.where(changed, fidx, fact_cap)].set(ut, mode="drop")
+
+    # next delta = new ∪ changed (subject-owned rows with final tags)
+    dmask = fresh | changed
+    n_dnext = jnp.sum(dmask)
+    ddest = jnp.where(dmask, jnp.cumsum(dmask) - 1, delta_cap)
+    nds = jnp.zeros(delta_cap, jnp.uint32).at[ddest].set(us, mode="drop")
+    ndp = jnp.zeros(delta_cap, jnp.uint32).at[ddest].set(up, mode="drop")
+    ndo = jnp.zeros(delta_cap, jnp.uint32).at[ddest].set(uo, mode="drop")
+    ndt = jnp.zeros(delta_cap, jnp.float64).at[ddest].set(ut, mode="drop")
+    ndv = jnp.arange(delta_cap) < n_dnext
+
+    # refresh the object-hash mirror for new AND changed rows: route to the
+    # object owner, append the fresh ones, scatter tags for the rest
+    mflag = _compact(fresh, dmask, ddest, delta_cap)
+    (ms_, mp_, mo_, mt_, mfresh), mv, drop2 = exchange(
+        (nds, ndp, ndo, ndt, mflag),
+        ndv,
+        shard_of_dev(ndo, n),
+        n,
+        axis,
+        bucket_cap,
+    )
+    overflow = overflow + drop2.astype(jnp.int32)
+    mfresh_b = mv & (mfresh > 0)
+    mold_b = mv & (mfresh == 0)
+    n_g_local = jnp.sum(gv)
+    n_gnew = jnp.sum(mfresh_b)
+    overflow = overflow + lax.psum(
+        jnp.maximum((n_g_local + n_gnew).astype(jnp.int32) - fact_cap, 0),
+        axis,
+    )
+    gdest = jnp.where(mfresh_b, n_g_local + jnp.cumsum(mfresh_b) - 1, fact_cap)
+    gs = gs.at[gdest].set(ms_, mode="drop")
+    gp = gp.at[gdest].set(mp_, mode="drop")
+    go = go.at[gdest].set(mo_, mode="drop")
+    gtag = gtag.at[gdest].set(mt_, mode="drop")
+    gv = gv.at[gdest].set(jnp.ones_like(mfresh_b), mode="drop")
+    gidx, gfound = _index3(
+        (ms_, mp_, mo_), mold_b, (gs, gp, go), gv, fact_cap
+    )
+    gtag = gtag.at[jnp.where(gfound, gidx, fact_cap)].set(mt_, mode="drop")
+
+    new_count = lax.psum(n_dnext.astype(jnp.int32), axis)
+    out_state = tuple(
+        a[None]
+        for a in (
+            fs,
+            fp,
+            fo,
+            ftag,
+            fv,
+            gs,
+            gp,
+            go,
+            gtag,
+            gv,
+            nds,
+            ndp,
+            ndo,
+            ndt,
+            ndv,
+        )
+    )
+    return out_state, new_count[None], overflow[None]
+
+
+def _compact(flags, mask, dest, cap):
+    """Compact ``flags`` (u32 0/1) through the same scatter that built the
+    next-delta columns, so row i of the delta carries its fresh/changed
+    provenance."""
+    return (
+        jnp.zeros(cap, jnp.uint32)
+        .at[dest]
+        .set(jnp.where(mask, flags.astype(jnp.uint32), 0), mode="drop")
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+# ---------------------------------------------------------------------------
+
+
+class DistProvenanceReasoner:
+    """Host driver for the distributed tagged fixpoint (see module doc).
+
+    ``infer()`` runs the closure for an idempotent scalar semiring over the
+    mesh, writes derived facts into ``reasoner.facts`` and final tags into
+    ``tag_store`` (host-TagStore parity), and returns the derived count.
+    Raises :class:`Unsupported` for NAF rules, unsupported semirings, or
+    rule shapes the distributed planner cannot route.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        reasoner,
+        provenance,
+        tag_store,
+        fact_cap: Optional[int] = None,
+        delta_cap: Optional[int] = None,
+        join_cap: Optional[int] = None,
+        bucket_cap: Optional[int] = None,
+    ):
+        if not supports(provenance):
+            raise Unsupported(f"semiring {provenance.name!r} is not scalar-idempotent")
+        if any(r.negative_premise for r in reasoner.rules):
+            raise Unsupported("stratified NAF stays host-side")
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n = mesh.devices.size
+        self.reasoner = reasoner
+        self.provenance = provenance
+        self.tag_store = tag_store
+        self.rules, self.bank = lower_rules_dist(reasoner, reasoner.rules)
+        n_local = max(1, -(-len(reasoner.facts) // self.n))
+        self.fact_cap = fact_cap or round_cap(8 * n_local, 512)
+        self.delta_cap = delta_cap or round_cap(4 * n_local, 256)
+        self.join_cap = join_cap or round_cap(4 * n_local, 256)
+        self.bucket_cap = bucket_cap or round_cap(4 * n_local, 256)
+
+    def _round_fn(self):
+        body = partial(
+            _tagged_round,
+            rules=self.rules,
+            n=self.n,
+            axis=self.axis,
+            fact_cap=self.fact_cap,
+            delta_cap=self.delta_cap,
+            join_cap=self.join_cap,
+            bucket_cap=self.bucket_cap,
+        )
+        spec = P(self.axis, None)
+        rep = P()
+        n_masks = len(self.bank.exprs)
+        return jax.jit(
+            jax.shard_map(
+                lambda state, masks, one: body(state, masks, one),
+                mesh=self.mesh,
+                in_specs=((spec,) * 15, (rep,) * n_masks, P(self.axis)),
+                out_specs=((spec,) * 15, P(self.axis), P(self.axis)),
+            )
+        )
+
+    def infer(self, max_rounds: int = 256, max_attempts: int = 8) -> int:
+        r = self.reasoner
+        s, p, o = r.facts.columns()
+        n0 = len(s)
+        if n0 == 0 or not self.rules:
+            return 0
+        tags0, one_enc = _seed_tag_arrays(
+            self.provenance,
+            self.tag_store,
+            list(zip(s.tolist(), p.tolist(), o.tolist())),
+        )
+        for _attempt in range(max_attempts):
+            result = self._try_infer(s, p, o, tags0, one_enc, max_rounds)
+            if result is not None:
+                return self._write_back(s, p, o, tags0, *result)
+            self.fact_cap *= 2
+            self.delta_cap *= 2
+            self.join_cap *= 2
+            self.bucket_cap *= 2
+        raise RuntimeError(
+            "distributed tagged fixpoint capacities failed to converge"
+        )
+
+    def _try_infer(self, s, p, o, tags0, one_enc, max_rounds):
+        n = self.n
+        sh = NamedSharding(self.mesh, P(self.axis, None))
+        with jax.enable_x64(True):
+            try:
+                (ss, sp, so, stg), sv = partition_rows(
+                    (s, p, o, tags0), s, n, self.fact_cap
+                )
+                (os_, op, oo, otg), ov = partition_rows(
+                    (s, p, o, tags0), o, n, self.fact_cap
+                )
+            except ValueError:
+                # a shard's initial load exceeds fact_cap: let infer()'s
+                # doubling protocol retry, like every other capacity
+                return None
+            # delta = all facts (subject-partitioned), EFFECTIVE tags
+            eff = np.where(np.isnan(stg), one_enc, stg)
+            if self.delta_cap < self.fact_cap:
+                per_shard = sv.sum(axis=1)
+                if int(per_shard.max(initial=0)) > self.delta_cap:
+                    return None
+                dsl = np.zeros((n, self.delta_cap), np.uint32)
+                dpl = np.zeros((n, self.delta_cap), np.uint32)
+                dol = np.zeros((n, self.delta_cap), np.uint32)
+                dtl = np.zeros((n, self.delta_cap), np.float64)
+                dvl = np.zeros((n, self.delta_cap), bool)
+                w = self.delta_cap
+                dsl[:, :w] = ss[:, :w]
+                dpl[:, :w] = sp[:, :w]
+                dol[:, :w] = so[:, :w]
+                dtl[:, :w] = eff[:, :w]
+                dvl[:, :w] = sv[:, :w]
+            else:
+                pad = self.delta_cap - self.fact_cap
+                padw = lambda a, fill, dt: np.concatenate(  # noqa: E731
+                    [a, np.full((n, pad), fill, dt)], axis=1
+                )
+                dsl = padw(ss, 0, np.uint32)
+                dpl = padw(sp, 0, np.uint32)
+                dol = padw(so, 0, np.uint32)
+                dtl = padw(eff, 0.0, np.float64)
+                dvl = padw(sv, False, bool)
+
+            put = lambda a: jax.device_put(a, sh)  # noqa: E731
+            state = tuple(
+                put(a)
+                for a in (
+                    ss,
+                    sp,
+                    so,
+                    stg,
+                    sv,
+                    os_,
+                    op,
+                    oo,
+                    otg,
+                    ov,
+                    dsl,
+                    dpl,
+                    dol,
+                    dtl,
+                    dvl,
+                )
+            )
+            masks = tuple(jnp.asarray(m) for m in self.bank.materialize())
+            one_arr = put(np.full((n, 1), one_enc, np.float64))
+            round_fn = self._round_fn()
+            for _ in range(max_rounds):
+                state, count, overflow = round_fn(state, masks, one_arr)
+                if int(overflow[0]) > 0:
+                    return None
+                if int(count[0]) == 0:
+                    fs = np.asarray(state[0]).reshape(-1)
+                    fp = np.asarray(state[1]).reshape(-1)
+                    fo = np.asarray(state[2]).reshape(-1)
+                    ft = np.asarray(state[3]).reshape(-1)
+                    fv = np.asarray(state[4]).reshape(-1)
+                    return fs[fv], fp[fv], fo[fv], ft[fv]
+            raise RuntimeError(
+                "distributed tagged fixpoint hit the round limit"
+            )
+
+    def _write_back(self, s, p, o, tags0, fs, fp, fo, ft):
+        """Append derived facts; store changed-or-new tag entries
+        (vectorized, host-TagStore parity)."""
+        prov = self.provenance
+        base = dict(
+            zip(
+                zip(s.tolist(), p.tolist(), o.tolist()),
+                tags0.tolist(),
+            )
+        )
+        keys = list(zip(fs.tolist(), fp.tolist(), fo.tolist()))
+        new_rows = []
+        entries = {}
+        for k, v in zip(keys, ft.tolist()):
+            v0 = base.get(k)
+            if v0 is None:
+                new_rows.append(k)
+                if not np.isnan(v):
+                    entries[k] = v
+            else:
+                if not np.isnan(v) and not (v == v0 or (np.isnan(v0) and np.isnan(v))):
+                    entries[k] = v
+        if new_rows:
+            arr = np.asarray(sorted(new_rows), dtype=np.uint32)
+            self.reasoner.facts.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
+        if entries:
+            ks = list(entries)
+            decoded = _decode_tags(
+                prov, np.asarray([entries[k] for k in ks])
+            )
+            self.tag_store.tags.update(zip(ks, decoded))
+        return len(new_rows)

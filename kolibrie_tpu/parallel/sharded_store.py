@@ -51,7 +51,9 @@ def partition_rows(
         cap = max(8, 1 << (need - 1).bit_length() if need else 3)
     if need > cap:
         raise ValueError(f"shard capacity {cap} < max shard load {need}")
-    out_cols = [np.zeros((n_shards, cap), dtype=np.uint32) for _ in cols]
+    # dtype-preserving: payload columns (e.g. f64 provenance tags) ride the
+    # same placement as the u32 id columns
+    out_cols = [np.zeros((n_shards, cap), dtype=c.dtype) for c in cols]
     valid = np.zeros((n_shards, cap), dtype=bool)
     order = np.argsort(dest, kind="stable")
     offs = np.concatenate([[0], np.cumsum(counts)])

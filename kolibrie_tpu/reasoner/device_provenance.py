@@ -77,6 +77,37 @@ def _encode_tags(provenance, tags) -> np.ndarray:
     return np.asarray(tags, dtype=np.float64)
 
 
+def _decode_tags(provenance, vals: np.ndarray) -> list:
+    """Vectorized inverse of :func:`_encode_tags` (shared by the single-chip
+    and distributed write-backs)."""
+    name = provenance.name
+    if name == "boolean":
+        return (vals > 0.5).tolist()
+    if name == "expiration":
+        return [
+            _EXP_FOREVER if np.isinf(v) else int(round(v))
+            for v in vals.tolist()
+        ]
+    return vals.tolist()
+
+
+def _seed_tag_arrays(provenance, tag_store, keys) -> Tuple[np.ndarray, float]:
+    """(tags0, one_enc) for a fact-key list: NaN = "no explicit TagStore
+    entry" (premise reads see one(); the first derivation overwrites —
+    update_disjunction parity).  Shared by both device drivers."""
+    tget = tag_store.tags.get  # keys are plain (s, p, o) tuples
+    host_tags = [tget(k) for k in keys]
+    one = provenance.one()
+    tags0 = np.where(
+        [t is None for t in host_tags],
+        np.nan,
+        _encode_tags(
+            provenance, [one if t is None else t for t in host_tags]
+        ),
+    )
+    return tags0, float(_encode_tags(provenance, [one])[0])
+
+
 # ---------------------------------------------------------------------------
 # Jitted round
 # ---------------------------------------------------------------------------
@@ -293,17 +324,7 @@ def infer_provenance_device(
     if n0 == 0:
         return None
     facts_keys = list(zip(s.tolist(), p.tolist(), o.tolist()))
-    tget = tag_store.tags.get  # keys are plain (s, p, o) tuples
-    one = provenance.one()
-    one_enc = float(_encode_tags(provenance, [one])[0])
-    # NaN = "no explicit TagStore entry" (reads as one() for premises, but
-    # the first derivation OVERWRITES — exact update_disjunction parity)
-    host_tags = [tget(k) for k in facts_keys]
-    tags0 = np.where(
-        [t is None for t in host_tags],
-        np.nan,
-        _encode_tags(provenance, [one if t is None else t for t in host_tags]),
-    )
+    tags0, one_enc = _seed_tag_arrays(provenance, tag_store, facts_keys)
 
     masks = tuple(jnp.asarray(m) for m in bank.materialize()) or (
         jnp.zeros(1, dtype=bool),
@@ -414,17 +435,7 @@ def infer_provenance_device(
         unchanged[:n0] = ~np.isnan(tags0) & (ft_h[:n0] == tags0)
         sel = np.flatnonzero(has_entry & ~unchanged)
         if sel.size:
-            vals = ft_h[sel]
-            name = provenance.name
-            if name == "boolean":
-                decoded = (vals > 0.5).tolist()
-            elif name == "expiration":
-                decoded = [
-                    _EXP_FOREVER if np.isinf(v) else int(round(v))
-                    for v in vals.tolist()
-                ]
-            else:
-                decoded = vals.tolist()
+            decoded = _decode_tags(provenance, ft_h[sel])
             keys = zip(
                 fs_h[sel].tolist(), fp_h[sel].tolist(), fo_h[sel].tolist()
             )

@@ -1,0 +1,198 @@
+"""Agreement tests: distributed tagged fixpoint vs the host provenance
+loop, on the virtual 8-device CPU mesh (conftest.py).
+
+Covers the idempotent scalar semirings (minmax / boolean / expiration)
+with multi-premise rules, filters, cross-shard tag improvement, and the
+Unsupported fallbacks (NAF, AddMult).
+"""
+
+import pytest
+
+import jax
+
+from kolibrie_tpu.core.rule import FilterCondition
+from kolibrie_tpu.parallel import make_mesh
+from kolibrie_tpu.parallel.dist_provenance import (
+    DistProvenanceReasoner,
+    Unsupported,
+)
+from kolibrie_tpu.reasoner.provenance import (
+    AddMultProbability,
+    BooleanProvenance,
+    ExpirationProvenance,
+    MinMaxProbability,
+)
+from kolibrie_tpu.reasoner.provenance_seminaive import (
+    infer_with_provenance,
+    seed_tag_store,
+)
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def _result(reasoner, store):
+    return reasoner.facts.triples_set(), dict(store.tags)
+
+
+def both_paths(mesh, build, provenance, **caps):
+    r_host = build()
+    host_store = seed_tag_store(r_host, provenance)
+    infer_with_provenance(r_host, provenance, host_store)
+    r_dist = build()
+    dist_store = seed_tag_store(r_dist, provenance)
+    DistProvenanceReasoner(
+        mesh, r_dist, provenance, dist_store, **caps
+    ).infer()
+    return _result(r_host, host_store), _result(r_dist, dist_store)
+
+
+def test_minmax_two_premise_agreement(mesh):
+    def build():
+        r = Reasoner()
+        for i in range(24):
+            r.add_tagged_triple(
+                f"p{i}", "worksAt", f"org{i % 5}", 0.4 + 0.02 * i
+            )
+            r.add_tagged_triple(
+                f"org{i % 5}", "partOf", "corp", 0.6 + 0.01 * (i % 5)
+            )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "worksAt", "?o"), ("?o", "partOf", "?c")],
+                [("?x", "memberOf", "?c")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
+
+
+def test_expiration_transitive_agreement(mesh):
+    """Recursive rule: expiry tags propagate min() across shards and
+    improved tags re-fire (multi-round cross-shard delta)."""
+    from kolibrie_tpu.core.triple import Triple
+
+    prov = ExpirationProvenance()
+
+    def build():
+        r = Reasoner()
+        for i in range(20):
+            r.add_abox_triple(f"n{i}", "next", f"n{i + 1}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    def run(path):
+        r = build()
+        store = seed_tag_store(r, prov)
+        s, p, o = r.facts.columns()
+        for j, k in enumerate(zip(s.tolist(), p.tolist(), o.tolist())):
+            store.tags[k] = 10_000 + 101 * j
+        if path == "host":
+            infer_with_provenance(r, prov, store)
+        else:
+            DistProvenanceReasoner(mesh, r, prov, store).infer()
+        return _result(r, store)
+
+    assert run("host") == run("dist")
+
+
+def test_boolean_filter_agreement(mesh):
+    def build():
+        r = Reasoner()
+        for i in range(18):
+            r.add_abox_triple(f"item{i}", "price", f'"{i * 10}"')
+            r.add_abox_triple(f"item{i}", "inStock", "yes")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "price", "?v"), ("?x", "inStock", "yes")],
+                [("?x", "sellable", "yes")],
+                filters=[FilterCondition("v", ">", 50.0)],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, BooleanProvenance())
+    assert host == dist
+
+
+def test_three_premise_agreement(mesh):
+    def build():
+        r = Reasoner()
+        for i in range(15):
+            r.add_tagged_triple(f"a{i}", "p", f"b{i % 4}", 0.5 + 0.03 * i)
+            r.add_tagged_triple(f"b{i % 4}", "q", f"c{i % 3}", 0.7)
+            r.add_tagged_triple(f"c{i % 3}", "r", f"d{i % 2}", 0.9)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y"), ("?y", "q", "?z"), ("?z", "r", "?w")],
+                [("?x", "reach", "?w")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
+
+
+def test_capacity_doubling_converges(mesh):
+    def build():
+        r = Reasoner()
+        for i in range(30):
+            r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.9)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(
+        mesh,
+        build,
+        MinMaxProbability(),
+        fact_cap=512,
+        delta_cap=64,
+        join_cap=64,
+        bucket_cap=64,
+    )
+    assert host == dist
+
+
+def test_naf_unsupported(mesh):
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?x", "ok", "?y")],
+            negative=[("?y", "broken", "yes")],
+        )
+    )
+    prov = MinMaxProbability()
+    store = seed_tag_store(r, prov)
+    with pytest.raises(Unsupported):
+        DistProvenanceReasoner(mesh, r, prov, store)
+
+
+def test_addmult_unsupported(mesh):
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    r.add_rule(
+        r.rule_from_strings([("?x", "p", "?y")], [("?x", "q", "?y")])
+    )
+    prov = AddMultProbability()
+    store = seed_tag_store(r, prov)
+    with pytest.raises(Unsupported):
+        DistProvenanceReasoner(mesh, r, prov, store)
