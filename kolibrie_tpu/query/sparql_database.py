@@ -170,6 +170,96 @@ class SparqlDatabase:
         self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
         return int(ids.shape[0])
 
+    # --------------------------------------------------- whole-database ops
+
+    def _remap_from(self, other: "SparqlDatabase"):
+        """Id remap other→self: ``(remap, qremap)`` where ``remap`` is a
+        vectorized per-plain-id array (other's terms bulk-interned into
+        self's dictionary) and ``qremap`` maps other's quoted-triple ids
+        after a store merge (None when other has no quoted triples)."""
+        its = other.dictionary.id_to_str
+        n_plain = len(its)
+        remap = np.zeros(n_plain, dtype=np.uint32)
+        if n_plain > 1:
+            remap[1:] = self.dictionary.encode_batch(its[1:])
+        if len(other.quoted) == 0:
+            return remap, None
+        term_remap = {i: int(remap[i]) for i in range(n_plain)}
+        qremap = self.quoted.merge(other.quoted, term_remap)
+        return remap, qremap
+
+    @staticmethod
+    def _apply_remap(col: np.ndarray, remap: np.ndarray, qremap) -> np.ndarray:
+        from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+        if qremap is None:
+            return remap[col]
+        quoted = (col & QUOTED_BIT) != 0
+        out = remap[np.where(quoted, 0, col)]
+        if quoted.any():
+            out[quoted] = [qremap[int(q)] for q in col[quoted]]
+        return out
+
+    def union(self, other: "SparqlDatabase") -> "SparqlDatabase":
+        """New database holding both stores' triples: other's ids re-encoded
+        through a merged dictionary, probability seeds merged, prefixes/
+        UDFs/registries/execution mode from self.  Parity: the reference's
+        whole-DB ``union`` (``sparql_database.rs:1990-2041``) — vectorized
+        remap instead of a per-triple decode/encode loop."""
+        out = self.clone()
+        remap, qremap = out._remap_from(other)
+        s, p, o = other.store.columns()
+        out.store.add_batch(
+            *(self._apply_remap(c, remap, qremap) for c in (s, p, o))
+        )
+
+        def map_id(i: int) -> int:
+            from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+            if qremap is not None and (i & QUOTED_BIT):
+                return qremap[i]
+            return int(remap[i])
+
+        for (ts, tp, to), prob in other.probability_seeds.items():
+            out.probability_seeds[
+                (map_id(ts), map_id(tp), map_id(to))
+            ] = prob
+        return out
+
+    def par_join(
+        self, other: "SparqlDatabase", predicate: str
+    ) -> "SparqlDatabase":
+        """New database with the join of the two stores along ``predicate``:
+        for self ``(a, p, b)`` and other ``(b, p, c)``, emit ``(a, p, c)``.
+        Shares self's dictionary (ids remain valid); other's ids are
+        remapped first, so the databases need not share an id space.
+        Parity: ``sparql_database.rs:2042-2117`` ``par_join`` — one
+        vectorized sort join instead of a rayon fold."""
+        from kolibrie_tpu.ops.join import join_indices
+
+        out = SparqlDatabase()
+        out.dictionary = self.dictionary  # shared, like the reference
+        out.quoted = self.quoted
+        out.prefixes = dict(self.prefixes)
+        pid = self.dictionary.encode(predicate)
+        remap, qremap = self._remap_from(other)
+        os_, op, oo = (
+            self._apply_remap(c, remap, qremap)
+            for c in other.store.columns()
+        )
+        s, p, o = self.store.columns()
+        lmask = p == pid
+        rmask = op == pid
+        li, ri = join_indices(
+            o[lmask].astype(np.uint64), os_[rmask].astype(np.uint64)
+        )
+        ls = s[lmask][li]
+        ro = oo[rmask][ri]
+        out.store.add_batch(
+            ls, np.full(len(ls), pid, dtype=np.uint32), ro
+        )
+        return out
+
     def parse_rdf(self, data: str) -> int:
         """RDF/XML. Parity: ``sparql_database.rs:401`` ``parse_rdf``."""
         return self._ingest(rdf_parsers.parse_rdf_xml(data))

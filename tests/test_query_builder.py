@@ -293,3 +293,91 @@ def test_query_engine_explain_no_false_positives():
         exp = engine.explain(q)
         assert exp.storage_mode == StorageMode.STATIC, q
         assert exp.will_use_volcano, q
+
+
+# ------------------------------------------------- whole-database operations
+
+
+def _decoded_set(db):
+    return {
+        (db.decode_term(t.subject), db.decode_term(t.predicate),
+         db.decode_term(t.object))
+        for t in db.store
+    }
+
+
+def test_union_merges_stores_and_dictionaries():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    a = SparqlDatabase()
+    a.parse_ntriples("<http://x/s1> <http://x/p> <http://x/o1> .")
+    b = SparqlDatabase()
+    # note: b's ids for these terms differ from a's
+    b.parse_ntriples(
+        "<http://x/extra> <http://x/q> <http://x/s1> .\n"
+        "<http://x/s1> <http://x/p> <http://x/o1> ."  # duplicate of a's
+    )
+    b.probability_seeds[
+        (b.dictionary.encode("<http://x/extra>"),) * 3
+    ] = 0.7  # dummy-shaped seed exercising the remap
+
+    u = a.union(b)
+    assert _decoded_set(u) == _decoded_set(a) | _decoded_set(b)
+    assert len(u.store) == 2  # the shared triple deduplicates
+    # originals untouched
+    assert len(a.store) == 1 and len(b.store) == 2
+    # remapped seed refers to u's id for the term
+    k = next(iter(u.probability_seeds))
+    assert u.dictionary.decode(k[0]) == "<http://x/extra>"
+
+
+def test_par_join_composes_predicate_paths():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    a = SparqlDatabase()
+    a.parse_ntriples(
+        "<http://x/a> <http://x/knows> <http://x/b> .\n"
+        "<http://x/a2> <http://x/knows> <http://x/b2> .\n"
+        "<http://x/a> <http://x/other> <http://x/zz> ."
+    )
+    b = SparqlDatabase()
+    b.parse_ntriples(
+        "<http://x/b> <http://x/knows> <http://x/c> .\n"
+        "<http://x/b> <http://x/knows> <http://x/c2> .\n"
+        "<http://x/nomatch> <http://x/knows> <http://x/d> ."
+    )
+    j = a.par_join(b, "http://x/knows")
+    assert _decoded_set(j) == {
+        ("http://x/a", "http://x/knows", "http://x/c"),
+        ("http://x/a", "http://x/knows", "http://x/c2"),
+    }
+    # shares a's dictionary object (reference Arc-clone semantics)
+    assert j.dictionary is a.dictionary
+
+
+def test_union_preserves_registries_and_quoted_seeds():
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    a = SparqlDatabase()
+    a.parse_ntriples("<http://x/s> <http://x/p> <http://x/o> .")
+    a.udfs["MYFN"] = len
+    a.execution_mode = "host"
+    b = SparqlDatabase()
+    # RDF-star: quoted triple as subject, with a probability seed keyed on
+    # the quoted id (bit 31 set) — the union remap must route it through
+    # the merged quoted store, not the plain-term array
+    b.parse_ntriples(
+        "<< <http://x/s> <http://x/p> <http://x/o> >> "
+        "<http://x/certainty> \"0.9\" ."
+    )
+    t = next(iter(b.store))
+    b.probability_seeds[(t.subject, t.predicate, t.object)] = 0.9
+
+    u = a.union(b)
+    assert "MYFN" in u.udfs
+    assert u.execution_mode == "host"
+    assert len(u.store) == 2
+    (k, prob), = u.probability_seeds.items()
+    assert prob == 0.9
+    # the quoted subject id must resolve in u's quoted store
+    assert u.decode_term(k[0]).startswith("<<")
