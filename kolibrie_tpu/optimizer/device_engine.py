@@ -934,6 +934,180 @@ def try_device_execute(db, plan) -> Optional[BindingTable]:
 
 
 # ---------------------------------------------------------------------------
+# Device GROUP BY / aggregation (BASELINE config 2 on device)
+# ---------------------------------------------------------------------------
+
+_AGG_SENT = 0xFFFFFFFFFFFFFFFF  # u64 sentinel for invalid rows' group keys
+
+
+@partial(jax.jit, static_argnames=("gpos", "funcs", "apos", "cap"))
+def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
+    """Segment-reduce the final plan table ON DEVICE: sort rows by group
+    key, first-occurrence segment ids, scatter-reduce per aggregate.
+
+    ``gpos``: positions of the (≤2) group columns in ``cols``; ``funcs``:
+    aggregate names; ``apos``: per-aggregate value column position (or -1
+    for COUNT(*)).  Returns (group id cols, f64 agg arrays, n_groups) with
+    static length ``cap`` — readback is O(groups), not O(rows), which is
+    the whole point on a tunneled TPU."""
+    import jax.numpy as jnp
+
+    n = valid.shape[0]
+    if gpos:
+        k = cols[gpos[0]].astype(jnp.uint64)
+        if len(gpos) == 2:
+            k = (k << np.uint64(32)) | cols[gpos[1]].astype(jnp.uint64)
+        key = jnp.where(valid, k, np.uint64(_AGG_SENT))
+    else:
+        # aggregate without GROUP BY: one group holding every valid row
+        key = jnp.where(valid, np.uint64(0), np.uint64(_AGG_SENT))
+    order = jnp.argsort(key)
+    ks = key[order]
+    rowok = ks != np.uint64(_AGG_SENT)
+    isnew = (
+        jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]]) & rowok
+    )
+    if not gpos:
+        # SPARQL: an empty input still yields ONE group (COUNT()=0)
+        isnew = isnew.at[0].set(True)
+    seg = jnp.cumsum(isnew) - 1
+    n_groups = jnp.sum(isnew)
+    segc = jnp.where(rowok, seg, cap)
+
+    group_cols = []
+    gdest = jnp.where(isnew, seg, cap)
+    for g in gpos:
+        src = cols[g][order]
+        group_cols.append(
+            jnp.zeros(cap, jnp.uint32).at[gdest].set(src, mode="drop")
+        )
+
+    agg_out = []
+    for func, ap in zip(funcs, apos):
+        if func == "COUNT" and ap < 0:
+            counts = (
+                jnp.zeros(cap, jnp.float64)
+                .at[segc]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            agg_out.append(counts)
+            continue
+        col = cols[ap][order]
+        if func == "COUNT":
+            ok = segc < cap
+            bound = ok & (col != np.uint32(0))  # 0 = UNBOUND sentinel
+            agg_out.append(
+                jnp.zeros(cap, jnp.float64)
+                .at[jnp.where(bound, segc, cap)]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            continue
+        vals = numf[jnp.minimum(col, numf.shape[0] - 1)]
+        ok = (segc < cap) & ~jnp.isnan(vals)
+        dst = jnp.where(ok, segc, cap)
+        v0 = jnp.where(ok, vals, 0.0)
+        if func in ("SUM", "AVG"):
+            sums = (
+                jnp.zeros(cap, jnp.float64).at[dst].add(v0, mode="drop")
+            )
+            cnts = (
+                jnp.zeros(cap, jnp.float64)
+                .at[dst]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            res = sums / jnp.where(cnts == 0, 1.0, cnts) if func == "AVG" else sums
+            # empty segments (all values non-numeric) are NaN, like host
+            agg_out.append(jnp.where(cnts == 0, jnp.nan, res))
+        elif func == "MIN":
+            mins = (
+                jnp.full(cap, jnp.inf, jnp.float64)
+                .at[dst]
+                .min(jnp.where(ok, vals, jnp.inf), mode="drop")
+            )
+            agg_out.append(jnp.where(jnp.isinf(mins), jnp.nan, mins))
+        else:  # MAX
+            maxs = (
+                jnp.full(cap, -jnp.inf, jnp.float64)
+                .at[dst]
+                .max(jnp.where(ok, vals, -jnp.inf), mode="drop")
+            )
+            agg_out.append(jnp.where(jnp.isinf(maxs), jnp.nan, maxs))
+
+    return tuple(group_cols), tuple(agg_out), n_groups
+
+
+_DEVICE_AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
+
+
+def try_device_execute_aggregated(db, plan, q) -> Optional[BindingTable]:
+    """Plan execution + GROUP BY/aggregation entirely on device; readback is
+    one row per GROUP.  ``None`` → host fallback (plan or aggregate shape
+    not expressible: >2 group vars, DISTINCT aggregates, SAMPLE,
+    GROUP_CONCAT, expression group keys)."""
+    agg_items = [i for i in q.select if i.kind == "agg"]
+    if not agg_items and not q.group_by:
+        return None
+    if any(i.kind == "expr" for i in q.select):
+        return None  # host semantics drop exprs in agg queries; stay exact
+    if len(q.group_by) > 2:
+        return None
+    for item in agg_items:
+        a = item.agg
+        if a.func not in _DEVICE_AGG_FUNCS or a.distinct:
+            return None
+    try:
+        lowered = lower_plan(db, plan)
+    except Unsupported:
+        return None
+    out_vars = lowered.out_vars
+    gpos = []
+    for g in q.group_by:
+        if g not in out_vars:
+            return None
+        gpos.append(out_vars.index(g))
+    funcs, apos = [], []
+    for item in agg_items:
+        a = item.agg
+        if a.var is None:
+            apos.append(-1)
+        elif a.var in out_vars:
+            apos.append(out_vars.index(a.var))
+        else:
+            return None
+        funcs.append(a.func)
+
+    from kolibrie_tpu.query.executor import _encode_numbers
+
+    cap = 1024
+    with jax.enable_x64(True):
+        numf_dev = lowered._device_numf()  # per-db device cache
+        out_cols, valid = lowered.converge(lowered.run())
+        for _attempt in range(8):
+            gcols, aggs, n_groups = _segment_aggregate(
+                tuple(out_cols),
+                valid,
+                numf_dev,
+                tuple(gpos),
+                tuple(funcs),
+                tuple(apos),
+                cap,
+            )
+            ng = int(n_groups)
+            if ng <= cap:
+                break
+            cap = _round_cap(2 * ng)
+        else:
+            raise RuntimeError("group capacity failed to converge")
+    table: BindingTable = {}
+    for g, col in zip(q.group_by, gcols):
+        table[g] = np.asarray(col)[:ng].astype(np.uint32)
+    enc = db.dictionary.encode
+    for item, arr in zip(agg_items, aggs):
+        table[item.agg.alias] = _encode_numbers(enc, np.asarray(arr)[:ng])
+    return table
+
+
+# ---------------------------------------------------------------------------
 # Prepared queries (bench / repeated-execution API)
 # ---------------------------------------------------------------------------
 

@@ -56,8 +56,17 @@ _DEVICE_AUTO_MIN = 100_000
 # --------------------------------------------------------------------------
 
 
-def eval_where(db, where: WhereClause, use_optimizer: bool = True) -> BindingTable:
-    """Evaluate a group graph pattern to a binding table (IDs)."""
+def eval_where(
+    db,
+    where: WhereClause,
+    use_optimizer: bool = True,
+    prebuilt_plan=None,
+) -> BindingTable:
+    """Evaluate a group graph pattern to a binding table (IDs).
+
+    ``prebuilt_plan``: physical plan already produced for this WHERE (the
+    device-aggregation attempt plans first; on fallback the plan is reused
+    here instead of running the optimizer twice)."""
     engine = ExecutionEngine(db, subquery_eval=lambda sq: eval_select_to_table(db, sq.query))
     resolved = [resolve_pattern(db, p) for p in where.patterns]
     # filters referencing BIND outputs can only run after the binds
@@ -69,10 +78,13 @@ def eval_where(db, where: WhereClause, use_optimizer: bool = True) -> BindingTab
         f for f in where.filters if set(_filter_vars(f)) & bind_vars
     ]
     if use_optimizer:
-        logical = build_logical_plan(resolved, plan_filters, [], where.values)
-        stats = db.get_or_build_stats()
-        planner = Streamertail(stats)
-        plan = planner.find_best_plan(logical)
+        if prebuilt_plan is not None:
+            plan = prebuilt_plan
+        else:
+            logical = build_logical_plan(resolved, plan_filters, [], where.values)
+            stats = db.get_or_build_stats()
+            planner = Streamertail(stats)
+            plan = planner.find_best_plan(logical)
         table = None
         mode = getattr(db, "execution_mode", "auto")
         if mode == "device" or (
@@ -194,7 +206,14 @@ def _naive_eval(
 def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> BindingTable:
     """Run a SELECT down to a binding table projected to its variables
     (aggregates resolved).  Used for subqueries and ML input queries."""
-    table = eval_where(db, q.where, use_optimizer)
+    prebuilt_plan = None
+    if q.group_by or any(i.kind == "agg" for i in q.select):
+        table, prebuilt_plan = _try_device_aggregate(db, q, use_optimizer)
+        if table is not None:
+            if q.distinct:
+                table = unique_table(table)
+            return table
+    table = eval_where(db, q.where, use_optimizer, prebuilt_plan=prebuilt_plan)
     if q.group_by or any(i.kind == "agg" for i in q.select):
         table = _group_and_aggregate_table(db, table, q)
     else:
@@ -209,6 +228,42 @@ def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> Bind
     if q.distinct:
         table = unique_table(table)
     return table
+
+
+def _try_device_aggregate(
+    db, q: SelectQuery, use_optimizer: bool
+) -> Tuple[Optional[BindingTable], Optional[object]]:
+    """Aggregate query fused ON DEVICE (plan + GROUP BY segment-reduce in
+    one device pipeline; readback is one row per group).  Returns
+    ``(table, plan)``: table None → the normal eval_where + host
+    aggregation path, which reuses the returned plan when present (no
+    second optimizer run on fallback)."""
+    if not use_optimizer:
+        return None, None
+    mode = getattr(db, "execution_mode", "auto")
+    if not (
+        mode == "device" or (mode == "auto" and len(db.store) >= _DEVICE_AUTO_MIN)
+    ):
+        return None, None
+    w = q.where
+    if (
+        w.subqueries
+        or w.unions
+        or w.optionals
+        or w.minus
+        or w.binds
+        or w.not_blocks
+        or not w.patterns
+    ):
+        return None, None
+    from kolibrie_tpu.optimizer.device_engine import (
+        try_device_execute_aggregated,
+    )
+
+    resolved = [resolve_pattern(db, p) for p in w.patterns]
+    logical = build_logical_plan(resolved, list(w.filters), [], w.values)
+    plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
+    return try_device_execute_aggregated(db, plan, q), plan
 
 
 def _group_key_cols(table: BindingTable, group_by: List[str]):

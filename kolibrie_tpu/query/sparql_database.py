@@ -177,6 +177,8 @@ class SparqlDatabase:
         vectorized per-plain-id array (other's terms bulk-interned into
         self's dictionary) and ``qremap`` maps other's quoted-triple ids
         after a store merge (None when other has no quoted triples)."""
+        from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
         its = other.dictionary.id_to_str
         n_plain = len(its)
         remap = np.zeros(n_plain, dtype=np.uint32)
@@ -184,7 +186,14 @@ class SparqlDatabase:
             remap[1:] = self.dictionary.encode_batch(its[1:])
         if len(other.quoted) == 0:
             return remap, None
-        term_remap = {i: int(remap[i]) for i in range(n_plain)}
+        # only the plain ids actually referenced inside quoted triples need
+        # dict entries (not the whole id space)
+        refs = set()
+        for _qid, (qs, qp, qo) in other.quoted.items():
+            for t in (qs, qp, qo):
+                if not (t & QUOTED_BIT):
+                    refs.add(t)
+        term_remap = {i: int(remap[i]) for i in refs}
         qremap = self.quoted.merge(other.quoted, term_remap)
         return remap, qremap
 
@@ -241,7 +250,12 @@ class SparqlDatabase:
         out.dictionary = self.dictionary  # shared, like the reference
         out.quoted = self.quoted
         out.prefixes = dict(self.prefixes)
-        pid = self.dictionary.encode(predicate)
+        # normalized non-interning lookup (<iri> brackets accepted); an
+        # unknown predicate joins nothing and must not pollute the SHARED
+        # dictionary with a garbage term
+        pid = self.lookup_term_str(predicate)
+        if pid is None:
+            return out
         remap, qremap = self._remap_from(other)
         os_, op, oo = (
             self._apply_remap(c, remap, qremap)

@@ -261,3 +261,70 @@ def test_store_mutation_between_executions():
     dev2, host2 = run_both(db, q)
     assert sorted(dev2) == sorted(host2)
     assert len(dev2) == len(dev1) + 1
+
+
+def test_device_aggregation_shapes():
+    """The fused device GROUP BY path must agree with the host aggregation
+    for every supported aggregate shape."""
+    db = employee_db()
+    queries = [
+        # single group var, multiple aggregates
+        PREFIXES + """
+        SELECT ?d (COUNT(?e) AS ?n) (SUM(?s) AS ?sum) (MIN(?s) AS ?lo)
+               (MAX(?s) AS ?hi) WHERE {
+            ?e ex:dept ?d . ?e ex:salary ?s
+        } GROUP BY ?d""",
+        # two group vars
+        PREFIXES + """
+        SELECT ?d ?w (COUNT(?e) AS ?n) WHERE {
+            ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w
+        } GROUP BY ?d ?w""",
+        # aggregate with no GROUP BY (single group)
+        PREFIXES + """
+        SELECT (COUNT(?e) AS ?n) (AVG(?s) AS ?avg) WHERE {
+            ?e ex:salary ?s
+        }""",
+        # COUNT(*) via bare COUNT
+        PREFIXES + """
+        SELECT ?d (COUNT(?e) AS ?n) WHERE { ?e ex:dept ?d } GROUP BY ?d""",
+        # aggregation over a filtered join
+        PREFIXES + """
+        SELECT ?d (COUNT(?e) AS ?n) WHERE {
+            ?e ex:dept ?d . ?e ex:salary ?s . FILTER(?s > 50000)
+        } GROUP BY ?d""",
+    ]
+    for q in queries:
+        dev, host = run_both(db, q)
+        assert sorted(dev) == sorted(host), q
+
+
+def test_device_aggregation_fused_path_used(monkeypatch):
+    """Above the auto threshold the fused path must actually run (guard
+    against silent fallback)."""
+    import kolibrie_tpu.optimizer.device_engine as de
+
+    db = employee_db()
+    called = []
+    orig = de.try_device_execute_aggregated
+
+    def spy(db_, plan, q):
+        out = orig(db_, plan, q)
+        called.append(out is not None)
+        return out
+
+    monkeypatch.setattr(de, "try_device_execute_aggregated", spy)
+    q = PREFIXES + """
+    SELECT ?d (COUNT(?e) AS ?n) WHERE { ?e ex:dept ?d } GROUP BY ?d"""
+    execute_query_volcano(q, db)
+    assert called and called[0], "fused device aggregation did not run"
+
+
+def test_device_aggregation_distinct_falls_back():
+    """DISTINCT aggregates are host-only; results must still be exact."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?d (COUNT(DISTINCT ?w) AS ?n) WHERE {
+        ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w
+    } GROUP BY ?d"""
+    dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
