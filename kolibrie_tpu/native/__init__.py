@@ -19,6 +19,7 @@ _NATIVE_DIR = os.path.join(
 )
 _SO_PATH = os.path.join(_NATIVE_DIR, "libkolibrie_native.so")
 _SRC_PATH = os.path.join(_NATIVE_DIR, "kolibrie_native.cpp")
+_MAKEFILE_PATH = os.path.join(_NATIVE_DIR, "Makefile")
 
 _lock = threading.Lock()
 _lib = None
@@ -85,9 +86,10 @@ def load():
         _load_attempted = True
         if os.environ.get("KOLIBRIE_NATIVE", "1") == "0":
             return None
-        stale = not os.path.exists(_SO_PATH) or (
-            os.path.exists(_SRC_PATH)
-            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+        stale = not os.path.exists(_SO_PATH) or any(
+            os.path.exists(dep)
+            and os.path.getmtime(dep) > os.path.getmtime(_SO_PATH)
+            for dep in (_SRC_PATH, _MAKEFILE_PATH)
         )
         if stale and not _build():
             return None

@@ -1024,14 +1024,26 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
                 .at[dst]
                 .min(jnp.where(ok, vals, jnp.inf), mode="drop")
             )
-            agg_out.append(jnp.where(jnp.isinf(mins), jnp.nan, mins))
+            # emptiness decided by COUNT, not by the ±inf identity value —
+            # a genuine infinite literal must survive (host-path parity)
+            cnt = (
+                jnp.zeros(cap, jnp.float64)
+                .at[dst]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            agg_out.append(jnp.where(cnt == 0, jnp.nan, mins))
         else:  # MAX
             maxs = (
                 jnp.full(cap, -jnp.inf, jnp.float64)
                 .at[dst]
                 .max(jnp.where(ok, vals, -jnp.inf), mode="drop")
             )
-            agg_out.append(jnp.where(jnp.isinf(maxs), jnp.nan, maxs))
+            cnt = (
+                jnp.zeros(cap, jnp.float64)
+                .at[dst]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            agg_out.append(jnp.where(cnt == 0, jnp.nan, maxs))
 
     return tuple(group_cols), tuple(agg_out), n_groups
 
@@ -1039,11 +1051,15 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
 _DEVICE_AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
 
 
-def try_device_execute_aggregated(db, plan, q) -> Optional[BindingTable]:
+def try_device_execute_aggregated(
+    db, plan, q, lowered: Optional[LoweredPlan] = None
+) -> Optional[BindingTable]:
     """Plan execution + GROUP BY/aggregation entirely on device; readback is
     one row per GROUP.  ``None`` → host fallback (plan or aggregate shape
     not expressible: >2 group vars, DISTINCT aggregates, SAMPLE,
-    GROUP_CONCAT, expression group keys)."""
+    GROUP_CONCAT, expression group keys).  ``lowered``: caller-supplied
+    device lowering of ``plan`` (avoids lowering the same plan twice when
+    the caller also owns the fallback path)."""
     agg_items = [i for i in q.select if i.kind == "agg"]
     if not agg_items and not q.group_by:
         return None
@@ -1055,10 +1071,11 @@ def try_device_execute_aggregated(db, plan, q) -> Optional[BindingTable]:
         a = item.agg
         if a.func not in _DEVICE_AGG_FUNCS or a.distinct:
             return None
-    try:
-        lowered = lower_plan(db, plan)
-    except Unsupported:
-        return None
+    if lowered is None:
+        try:
+            lowered = lower_plan(db, plan)
+        except Unsupported:
+            return None
     out_vars = lowered.out_vars
     gpos = []
     for g in q.group_by:

@@ -56,17 +56,31 @@ _DEVICE_AUTO_MIN = 100_000
 # --------------------------------------------------------------------------
 
 
+def _device_routed(db) -> bool:
+    """THE routing rule for "does this query run on the device engine":
+    explicit ``execution_mode == "device"``, or auto mode over a store big
+    enough that device dispatch beats the host numpy engine."""
+    mode = getattr(db, "execution_mode", "auto")
+    return mode == "device" or (
+        mode == "auto" and len(db.store) >= _DEVICE_AUTO_MIN
+    )
+
+
 def eval_where(
     db,
     where: WhereClause,
     use_optimizer: bool = True,
     prebuilt_plan=None,
+    prebuilt_lowered=None,
 ) -> BindingTable:
     """Evaluate a group graph pattern to a binding table (IDs).
 
     ``prebuilt_plan``: physical plan already produced for this WHERE (the
     device-aggregation attempt plans first; on fallback the plan is reused
-    here instead of running the optimizer twice)."""
+    here instead of running the optimizer twice).  ``prebuilt_lowered``:
+    the matching device-lowered plan — an object to execute directly,
+    ``False`` if lowering already failed (skip the device path), None if
+    no lowering was attempted yet."""
     engine = ExecutionEngine(db, subquery_eval=lambda sq: eval_select_to_table(db, sq.query))
     resolved = [resolve_pattern(db, p) for p in where.patterns]
     # filters referencing BIND outputs can only run after the binds
@@ -86,10 +100,9 @@ def eval_where(
             planner = Streamertail(stats)
             plan = planner.find_best_plan(logical)
         table = None
-        mode = getattr(db, "execution_mode", "auto")
-        if mode == "device" or (
-            mode == "auto" and len(db.store) >= _DEVICE_AUTO_MIN
-        ):
+        if prebuilt_lowered is not None and prebuilt_lowered is not False:
+            table = prebuilt_lowered.execute()
+        elif prebuilt_lowered is None and _device_routed(db):
             from kolibrie_tpu.optimizer.device_engine import try_device_execute
 
             table = try_device_execute(db, plan)
@@ -207,13 +220,22 @@ def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> Bind
     """Run a SELECT down to a binding table projected to its variables
     (aggregates resolved).  Used for subqueries and ML input queries."""
     prebuilt_plan = None
+    prebuilt_lowered = None
     if q.group_by or any(i.kind == "agg" for i in q.select):
-        table, prebuilt_plan = _try_device_aggregate(db, q, use_optimizer)
+        table, prebuilt_plan, prebuilt_lowered = _try_device_aggregate(
+            db, q, use_optimizer
+        )
         if table is not None:
             if q.distinct:
                 table = unique_table(table)
             return table
-    table = eval_where(db, q.where, use_optimizer, prebuilt_plan=prebuilt_plan)
+    table = eval_where(
+        db,
+        q.where,
+        use_optimizer,
+        prebuilt_plan=prebuilt_plan,
+        prebuilt_lowered=prebuilt_lowered,
+    )
     if q.group_by or any(i.kind == "agg" for i in q.select):
         table = _group_and_aggregate_table(db, table, q)
     else:
@@ -232,19 +254,15 @@ def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> Bind
 
 def _try_device_aggregate(
     db, q: SelectQuery, use_optimizer: bool
-) -> Tuple[Optional[BindingTable], Optional[object]]:
+) -> Tuple[Optional[BindingTable], Optional[object], Optional[object]]:
     """Aggregate query fused ON DEVICE (plan + GROUP BY segment-reduce in
     one device pipeline; readback is one row per group).  Returns
-    ``(table, plan)``: table None → the normal eval_where + host
-    aggregation path, which reuses the returned plan when present (no
-    second optimizer run on fallback)."""
-    if not use_optimizer:
-        return None, None
-    mode = getattr(db, "execution_mode", "auto")
-    if not (
-        mode == "device" or (mode == "auto" and len(db.store) >= _DEVICE_AUTO_MIN)
-    ):
-        return None, None
+    ``(table, plan, lowered)``: table None → the normal eval_where + host
+    aggregation path, which reuses the returned plan AND device-lowered
+    plan when present (neither the optimizer nor plan lowering runs
+    twice on fallback; lowered False = lowering failed, don't retry)."""
+    if not use_optimizer or not _device_routed(db):
+        return None, None, None
     w = q.where
     if (
         w.subqueries
@@ -255,15 +273,25 @@ def _try_device_aggregate(
         or w.not_blocks
         or not w.patterns
     ):
-        return None, None
+        return None, None, None
     from kolibrie_tpu.optimizer.device_engine import (
+        Unsupported,
+        lower_plan,
         try_device_execute_aggregated,
     )
 
     resolved = [resolve_pattern(db, p) for p in w.patterns]
     logical = build_logical_plan(resolved, list(w.filters), [], w.values)
     plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
-    return try_device_execute_aggregated(db, plan, q), plan
+    try:
+        lowered = lower_plan(db, plan)
+    except Unsupported:
+        return None, plan, False
+    return (
+        try_device_execute_aggregated(db, plan, q, lowered=lowered),
+        plan,
+        lowered,
+    )
 
 
 def _group_key_cols(table: BindingTable, group_by: List[str]):
@@ -356,7 +384,9 @@ def _encode_numbers(enc, values: np.ndarray) -> np.ndarray:
         if np.isnan(v):
             out[i] = UNBOUND
         else:
-            sv = str(int(v)) if float(v) == int(v) else f"{v:g}"
+            # non-finite stays float-formatted ("inf"/"-inf"); int(inf) raises
+            isint = np.isfinite(v) and float(v) == int(v)
+            sv = str(int(v)) if isint else f"{v:g}"
             out[i] = enc(f'"{sv}"')
     return out
 

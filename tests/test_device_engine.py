@@ -307,8 +307,8 @@ def test_device_aggregation_fused_path_used(monkeypatch):
     called = []
     orig = de.try_device_execute_aggregated
 
-    def spy(db_, plan, q):
-        out = orig(db_, plan, q)
+    def spy(db_, plan, q, lowered=None):
+        out = orig(db_, plan, q, lowered=lowered)
         called.append(out is not None)
         return out
 
@@ -327,4 +327,25 @@ def test_device_aggregation_distinct_falls_back():
         ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w
     } GROUP BY ?d"""
     dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
+
+
+def test_device_aggregation_infinite_literal():
+    """A genuinely infinite numeric literal ("1e999" parses to +inf) must
+    survive MIN/MAX on both paths — the empty-segment identity (±inf) is
+    distinguished from real infinities by COUNT, not by value."""
+    db = employee_db()
+    db.parse_ntriples(
+        '<http://example.org/e0> <http://example.org/salary> "1e999" .'
+    )
+    q = PREFIXES + """
+    SELECT ?d (MAX(?s) AS ?m) WHERE {
+        ?e ex:dept ?d . ?e ex:salary ?s
+    } GROUP BY ?d"""
+    dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
+    assert any("inf" in row[1] for row in dev), dev
+    # MIN is unaffected by +inf but must agree too
+    qmin = q.replace("MAX", "MIN")
+    dev, host = run_both(db, qmin)
     assert sorted(dev) == sorted(host)
