@@ -1006,30 +1006,25 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
         ok = (segc < cap) & ~jnp.isnan(vals)
         dst = jnp.where(ok, segc, cap)
         v0 = jnp.where(ok, vals, 0.0)
+        # one numeric-value count per segment, shared by every func below:
+        # emptiness (→ NaN → UNBOUND) is decided by COUNT, never by the
+        # reduction's identity value — a genuine ±inf literal must survive
+        cnt = (
+            jnp.zeros(cap, jnp.float64)
+            .at[dst]
+            .add(jnp.ones(n, jnp.float64), mode="drop")
+        )
         if func in ("SUM", "AVG"):
             sums = (
                 jnp.zeros(cap, jnp.float64).at[dst].add(v0, mode="drop")
             )
-            cnts = (
-                jnp.zeros(cap, jnp.float64)
-                .at[dst]
-                .add(jnp.ones(n, jnp.float64), mode="drop")
-            )
-            res = sums / jnp.where(cnts == 0, 1.0, cnts) if func == "AVG" else sums
-            # empty segments (all values non-numeric) are NaN, like host
-            agg_out.append(jnp.where(cnts == 0, jnp.nan, res))
+            res = sums / jnp.where(cnt == 0, 1.0, cnt) if func == "AVG" else sums
+            agg_out.append(jnp.where(cnt == 0, jnp.nan, res))
         elif func == "MIN":
             mins = (
                 jnp.full(cap, jnp.inf, jnp.float64)
                 .at[dst]
                 .min(jnp.where(ok, vals, jnp.inf), mode="drop")
-            )
-            # emptiness decided by COUNT, not by the ±inf identity value —
-            # a genuine infinite literal must survive (host-path parity)
-            cnt = (
-                jnp.zeros(cap, jnp.float64)
-                .at[dst]
-                .add(jnp.ones(n, jnp.float64), mode="drop")
             )
             agg_out.append(jnp.where(cnt == 0, jnp.nan, mins))
         else:  # MAX
@@ -1037,11 +1032,6 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
                 jnp.full(cap, -jnp.inf, jnp.float64)
                 .at[dst]
                 .max(jnp.where(ok, vals, -jnp.inf), mode="drop")
-            )
-            cnt = (
-                jnp.zeros(cap, jnp.float64)
-                .at[dst]
-                .add(jnp.ones(n, jnp.float64), mode="drop")
             )
             agg_out.append(jnp.where(cnt == 0, jnp.nan, maxs))
 

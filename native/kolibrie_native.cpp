@@ -18,9 +18,11 @@
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <cctype>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <string_view>
 #include <thread>
@@ -210,43 +212,82 @@ struct SddManager {
 
 // ─────────────────────── N-Triples bulk tokenizer ────────────────────────
 
-// Transparent hashing so interning can probe with a string_view into the
-// raw input buffer — a std::string is only constructed on FIRST sight of a
-// term, which removes the per-occurrence allocation the old tokenizer paid.
-struct SvHash {
-  using is_transparent = void;
-  size_t operator()(std::string_view sv) const {
-    return std::hash<std::string_view>{}(sv);
-  }
-  size_t operator()(const std::string &s) const {
-    return std::hash<std::string_view>{}(std::string_view(s));
+// Interning runs on a flat open-addressing table (power-of-two slots of
+// {hash, id}, linear probing) over a bump arena that owns the term bytes.
+// Compared with an unordered_map keyed by std::string this removes the
+// per-term node allocation and the pointer-chasing probe — the 6M-probe/
+// 1M-insert interning loop is the tokenizer's hot path.  Probing compares
+// string_views straight into the raw input buffer; bytes are copied once,
+// into the arena, on FIRST sight of a term.
+struct NtArena {
+  std::vector<std::unique_ptr<char[]>> blocks;
+  size_t used = 0, cap = 0;
+
+  const char *add(const char *src, size_t n) {
+    // blocks.empty() guard: a zero-length first term (e.g. "<>") must not
+    // dereference back() before any block exists
+    if (blocks.empty() || used + n > cap) {
+      cap = std::max<size_t>(n, (size_t)1 << 20);
+      blocks.emplace_back(new char[cap]);
+      used = 0;
+    }
+    char *dst = blocks.back().get() + used;
+    std::memcpy(dst, src, n);
+    used += n;
+    return dst;
   }
 };
 
 struct NtSession {
+  struct Slot {
+    uint64_t hash;
+    uint32_t id;  // 0 = empty (term ids are 1-based)
+  };
+
   std::vector<uint32_t> ids;  // n_triples * 3, 1-based term indices
-  std::vector<std::string> terms;
-  std::unordered_map<std::string, uint32_t, SvHash, std::equal_to<>> term_map;
+  std::vector<std::pair<const char *, uint32_t>> terms;  // (bytes, len)
+  std::vector<Slot> slots = std::vector<Slot>(1 << 12);
+  NtArena arena;
   int64_t term_bytes = 0;
 
-  uint32_t intern_view(std::string_view sv) {
-    auto it = term_map.find(sv);
-    if (it != term_map.end()) return it->second;
-    uint32_t id = (uint32_t)terms.size() + 1;
-    term_bytes += (int64_t)sv.size();
-    term_map.emplace(std::string(sv), id);
-    terms.emplace_back(sv);
-    return id;
+  std::string_view term_view(uint32_t id) const {
+    const auto &t = terms[id - 1];
+    return std::string_view(t.first, t.second);
   }
 
-  uint32_t intern(std::string &&s) {
-    auto it = term_map.find(std::string_view(s));
-    if (it != term_map.end()) return it->second;
-    uint32_t id = (uint32_t)terms.size() + 1;
-    term_bytes += (int64_t)s.size();
-    term_map.emplace(s, id);
-    terms.push_back(std::move(s));
-    return id;
+  uint32_t intern_view(std::string_view sv) {
+    uint64_t h = std::hash<std::string_view>{}(sv);
+    size_t mask = slots.size() - 1;
+    size_t i = (size_t)h & mask;
+    while (true) {
+      Slot &sl = slots[i];
+      if (sl.id == 0) {
+        uint32_t id = (uint32_t)terms.size() + 1;
+        term_bytes += (int64_t)sv.size();
+        terms.emplace_back(arena.add(sv.data(), sv.size()),
+                           (uint32_t)sv.size());
+        sl = {h, id};
+        if (2 * ++count_ >= slots.size()) grow();
+        return id;
+      }
+      if (sl.hash == h && term_view(sl.id) == sv) return sl.id;
+      i = (i + 1) & mask;
+    }
+  }
+
+ private:
+  size_t count_ = 0;
+
+  void grow() {
+    std::vector<Slot> bigger(slots.size() * 2);
+    size_t mask = bigger.size() - 1;
+    for (const Slot &sl : slots) {
+      if (sl.id == 0) continue;
+      size_t i = (size_t)sl.hash & mask;
+      while (bigger[i].id != 0) i = (i + 1) & mask;
+      bigger[i] = sl;
+    }
+    slots.swap(bigger);
   }
 };
 
@@ -497,12 +538,15 @@ int nt_parse_mt_impl(const char *data, int64_t len, int nthreads,
     if (rcs[t] != 0) return nt_parse_impl(data, len, out);  // spanning stmt
   }
   // merge: chunk 0 seeds the output; later chunks remap through interning
+  // (locals stay alive through the loop, so views into their arenas are
+  // valid while out.intern_view copies the bytes it keeps)
   out = std::move(locals[0]);
   for (int t = 1; t < nthreads; t++) {
     NtSession &loc = locals[t];
     std::vector<uint32_t> remap(loc.terms.size() + 1);
     for (size_t k = 0; k < loc.terms.size(); k++) {
-      remap[k + 1] = out.intern(std::move(loc.terms[k]));
+      remap[k + 1] = out.intern_view(
+          std::string_view(loc.terms[k].first, loc.terms[k].second));
     }
     size_t base = out.ids.size();
     out.ids.resize(base + loc.ids.size());
@@ -694,8 +738,8 @@ void kn_nt_terms(void *session, char *out, int64_t *offsets) {
   int64_t i = 0;
   for (auto &t : s->terms) {
     offsets[i++] = pos;
-    std::memcpy(out + pos, t.data(), t.size());
-    pos += (int64_t)t.size();
+    std::memcpy(out + pos, t.first, t.second);
+    pos += (int64_t)t.second;
   }
   offsets[i] = pos;
 }

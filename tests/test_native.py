@@ -276,3 +276,17 @@ def test_sparql_database_parse_ntriples_empty_and_comment_only():
     db = SparqlDatabase()
     assert db.parse_ntriples("# only a comment\n") == 0
     assert len(db) == 0
+
+
+def test_nt_bulk_parse_empty_first_term():
+    """A zero-length first term ("<>") must intern safely — the arena must
+    not touch blocks.back() before any block exists (regression: segfault)."""
+    from kolibrie_tpu.native.nt_native import bulk_parse_ntriples
+
+    r = bulk_parse_ntriples("<> <http://p> <http://o> .\n")
+    if r is None:  # native unavailable: Python path covers it elsewhere
+        return
+    ids, terms = r
+    assert ids.shape == (1, 3)
+    assert terms[ids[0, 0] - 1] == ""
+    assert terms[ids[0, 1] - 1] == "http://p"
