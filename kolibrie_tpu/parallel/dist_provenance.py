@@ -61,7 +61,7 @@ from kolibrie_tpu.reasoner.device_fixpoint import Unsupported, _scan_premise
 from kolibrie_tpu.reasoner.device_provenance import (
     _decode_tags,
     _seed_tag_arrays,
-    supports,
+    supports_idempotent,
 )
 
 __all__ = ["DistProvenanceReasoner", "Unsupported"]
@@ -370,7 +370,7 @@ class DistProvenanceReasoner:
         join_cap: Optional[int] = None,
         bucket_cap: Optional[int] = None,
     ):
-        if not supports(provenance):
+        if not supports_idempotent(provenance):
             raise Unsupported(f"semiring {provenance.name!r} is not scalar-idempotent")
         if any(r.negative_premise for r in reasoner.rules):
             raise Unsupported("stratified NAF stays host-side")

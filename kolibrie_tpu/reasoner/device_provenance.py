@@ -1,5 +1,4 @@
-"""Device (TPU) provenance semi-naive fixpoint for idempotent scalar
-semirings.
+"""Device (TPU) provenance semi-naive fixpoint for the scalar semirings.
 
 The host provenance loop (:mod:`kolibrie_tpu.reasoner.provenance_seminaive`)
 runs per-derivation tag algebra in Python.  For the three IDEMPOTENT scalar
@@ -16,9 +15,14 @@ f64 column, ⊗ (conjunction over a derivation's premises) is ``min`` and
 Because ⊕ is idempotent, duplicate discoveries of the same derivation are
 harmless — the per-seed delta expansion (every premise position seeded from
 the delta, remaining positions joined against ALL facts) needs no old/delta
-store split, unlike the non-idempotent host path (AddMult) which must count
-each derivation exactly once.  AddMult and the structural semirings
-(SDD/TopK/DNF) stay host-side.
+store split.
+
+The NON-idempotent AddMult semiring (⊕ = noisy-OR a+b−ab, ⊗ = product)
+runs a separate round program (:func:`_prov_round_addmult`) with
+exactly-once derivation accounting: old/delta premise decomposition, the
+delta carried as fact-row indices, and per-group ⊕ as a segment noisy-OR
+in log space.  Only the structural semirings (SDD/TopK/DNF), whose tags
+are pointer-shaped proof objects, stay host-side.
 
 A round is one XLA program: delta-seeded premise joins with tag ``min``
 carried through the join chain, filter masks, conclusion instantiation,
@@ -58,11 +62,57 @@ AUTO_MIN_FACTS = 20_000
 
 _IDEMPOTENT = ("minmax", "boolean", "expiration")
 
+# addmult (noisy-OR/product) is NON-idempotent: it runs a separate round
+# program with exactly-once derivation accounting (see _prov_round_addmult)
+_DEVICE_SEMIRINGS = _IDEMPOTENT + ("addmult",)
+
 _EXP_FOREVER = 0xFFFF_FFFF_FFFF_FFFF
+
+# host TagStore parity: AddMultProbability.tag_eq treats |Δ| < 1e-12 as
+# "unchanged", which is also what terminates cyclic noisy-OR fixpoints
+_ADDMULT_TAG_EQ = 1e-12
 
 
 def supports(provenance) -> bool:
+    return getattr(provenance, "name", None) in _DEVICE_SEMIRINGS
+
+
+def supports_idempotent(provenance) -> bool:
+    """True only for the scalar-IDEMPOTENT semirings (min/max tag algebra).
+    The distributed tagged round hardwires ⊗=min/⊕=max with no exactly-once
+    accounting, so it must gate on THIS predicate, not :func:`supports`."""
     return getattr(provenance, "name", None) in _IDEMPOTENT
+
+
+def _addmult_order_sensitive(rules) -> bool:
+    """True when within-round tag updates could be VISIBLE to a later rule,
+    making the non-idempotent fixpoint depend on rule evaluation order.
+
+    The host loop (reference parity: ``provenance_semi_naive.rs:163-193``
+    reads ``tag_store.get_tag`` live) lets rule j read a tag that rule i<j
+    improved in the same round; the device round reads a round-start
+    snapshot.  For idempotent ⊕ both converge to the same fixpoint; for
+    addmult the accumulated noisy-OR values genuinely differ.  The device
+    path therefore only takes rule sets where rule i's conclusion predicates
+    never feed rule j>i's premises — then no mid-round improvement can be
+    observed and snapshot ≡ live.  (A rule's OWN conclusions are safe: the
+    host pre-aggregates per rule and writes after it.)  Variable predicates
+    count as wildcards."""
+
+    def preds(terms):
+        out = set()
+        for t in terms:
+            p = t.predicate
+            out.add(None if p.is_variable else int(p.value))
+        return out
+
+    for i, ri in enumerate(rules):
+        concl = preds(ri.conclusion)
+        for rj in rules[i + 1:]:
+            prem = preds(rj.premise)
+            if None in concl or None in prem or (concl & prem):
+                return True
+    return False
 
 
 def _encode_tags(provenance, tags) -> np.ndarray:
@@ -290,6 +340,211 @@ def _prov_round(
 
 
 # ---------------------------------------------------------------------------
+# Non-idempotent round: AddMult (noisy-OR ⊕, product ⊗)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("rules", "caps"))
+def _prov_round_addmult(
+    rules: tuple,
+    caps: _Caps,
+    fs,
+    fp,
+    fo,
+    ftag,
+    n_facts,
+    didx,
+    n_delta,
+    masks,
+):
+    """One EXACTLY-ONCE tagged semi-naive round for the addmult semiring.
+
+    Non-idempotent ⊕ (a+b-ab) must see every derivation exactly once, so
+    the round differs from the idempotent program in three ways:
+
+    - **Decomposition** (host parity: ``eval_rule_body``'s old/delta split,
+      ``provenance_semi_naive.rs:26-34``): for the plan seeded at premise
+      position k, premise j < k scans OLD facts (facts minus delta), j > k
+      scans ALL facts, so a derivation touching several delta facts is
+      counted at exactly one seed position.
+    - **Delta as fact-row indices** (``didx``): the delta is always a set of
+      committed fact rows, so membership ("old" mask) is one scatter, and
+      delta columns/tags are gathers — no separate delta buffers to keep
+      consistent.
+    - **⊕ within the round** is a segment noisy-OR in log space:
+      group tag = 1 - ∏(1-pᵢ) = -expm1(Σ log1p(-pᵢ)) over the group's
+      derivations (exactly ⊕ folded over the group, in any order).
+
+    Merge with the stored tag matches ``TagStore.update_disjunction``:
+    absent (NaN) → the group tag is inserted verbatim; saturated (≥ 1.0)
+    short-circuits; otherwise new = old + g - old·g, and the fact re-enters
+    the delta iff |new - old| ≥ 1e-12 (``AddMultProbability.tag_eq``) —
+    the same cutoff that makes cyclic noisy-OR fixpoints terminate on the
+    host.  Returns the same (state..., overflow) protocol as
+    :func:`_prov_round`; an overflowing round does not commit.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices, pack2
+
+    F, D, J = caps.fact, caps.delta, caps.join
+    fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
+    dvalid = jnp.arange(D, dtype=jnp.int32) < n_delta
+    fcols = (fs, fp, fo)
+    didx_c = jnp.clip(didx, 0, F - 1)
+    dcols = tuple(c[didx_c] for c in fcols)
+    dtag_eff = ftag[didx_c]
+    dtag_eff = jnp.where(jnp.isnan(dtag_eff), 1.0, dtag_eff)  # one() = 1.0
+    in_delta = (
+        jnp.zeros(F, bool)
+        .at[jnp.where(dvalid, didx_c, F)]
+        .set(True, mode="drop")
+    )
+    old_valid = fvalid & ~in_delta
+
+    overflow = np.int32(0)
+    parts: List[tuple] = []  # (s, p, o, tag, valid) static-cap blocks
+    for rule in rules:
+        for order, keys in rule.plans:
+            seed = order[0]
+            table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
+            valid = m
+            tag = dtag_eff
+            for step, j in enumerate(order[1:]):
+                pvalid = old_valid if j < seed else fvalid
+                ptable, pm = _scan_premise(rule.premises[j], fcols, pvalid)
+                kv = keys[step]
+                lkey = _pack([table[v] for v in kv], valid, _LPAD)
+                rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
+                li, ri, jvalid, total = join_indices(lkey, rkey, J)
+                overflow = overflow | jnp.where(total > J, np.int32(1), 0)
+                new_table = {}
+                for v, c in table.items():
+                    new_table[v] = c[li]
+                for v, c in ptable.items():
+                    if v not in new_table:
+                        new_table[v] = c[ri]
+                # ⊗ = product; absent (NaN) entries read as one()
+                ptag = ftag[ri]
+                ptag = jnp.where(jnp.isnan(ptag), 1.0, ptag)
+                tag = tag[li] * ptag
+                table, valid = new_table, jvalid
+            valid = _eval_filters(rule, table, valid, masks)
+            # zero-tag pruning (provenance_semi_naive.rs:171)
+            valid = valid & (tag > 0.0)
+            n = valid.shape[0]
+            for concl in rule.concls:
+                out = []
+                for kind, v in concl:
+                    if kind == "var":
+                        out.append(table[v])
+                    else:
+                        out.append(jnp.full(n, v, dtype=jnp.uint32))
+                parts.append((out[0], out[1], out[2], tag, valid))
+
+    cs = jnp.concatenate([p[0] for p in parts])
+    cp = jnp.concatenate([p[1] for p in parts])
+    co = jnp.concatenate([p[2] for p in parts])
+    ctag = jnp.concatenate([p[3] for p in parts])
+    cv = jnp.concatenate([p[4] for p in parts])
+
+    # group candidates by (s,p,o); ⊕ over each group = segment noisy-OR in
+    # log space (order-free, unlike the idempotent max-tag sort trick)
+    sent = np.uint32(0xFFFFFFFF)
+    ss = jnp.where(cv, cs, sent)
+    sp = jnp.where(cv, cp, sent)
+    so = jnp.where(cv, co, sent)
+    stag = jnp.where(cv, jnp.clip(ctag, 0.0, 1.0), 0.0)
+    ss, sp, so, stag = lax.sort((ss, sp, so, stag), num_keys=3)
+    isnew = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            (ss[1:] != ss[:-1]) | (sp[1:] != sp[:-1]) | (so[1:] != so[:-1]),
+        ]
+    )
+    isnew = isnew & (ss != sent)
+    n_uniq = jnp.sum(isnew)
+    overflow = overflow | jnp.where(n_uniq > D, np.int32(2), 0)
+    seg = jnp.cumsum(isnew) - 1
+    segdst = jnp.where(ss != sent, seg, D)
+    # log1p(-p): p=1 → -inf → group tag exactly 1.0; p∈[0,1) stays finite
+    logsum = (
+        jnp.zeros(D, jnp.float64)
+        .at[segdst]
+        .add(jnp.log1p(-stag), mode="drop")
+    )
+    gtag = -jnp.expm1(logsum)  # 1 - ∏(1-pᵢ)
+    dest = jnp.where(isnew, seg, D)
+    us = jnp.zeros(D, jnp.uint32).at[dest].set(ss, mode="drop")
+    up = jnp.zeros(D, jnp.uint32).at[dest].set(sp, mode="drop")
+    uo = jnp.zeros(D, jnp.uint32).at[dest].set(so, mode="drop")
+    uvalid = jnp.arange(D) < n_uniq
+
+    # exact (s,p,o) → fact-index lookup (same machinery as _prov_round)
+    fsp = pack2(jnp.where(fvalid, fs, sent), jnp.where(fvalid, fp, sent))
+    usp = pack2(jnp.where(uvalid, us, sent), jnp.where(uvalid, up, sent))
+    union = jnp.sort(jnp.concatenate([fsp, usp]))
+    rank_f = jnp.searchsorted(union, fsp).astype(jnp.uint32)
+    rank_u = jnp.searchsorted(union, usp).astype(jnp.uint32)
+    fkey = pack2(rank_f, jnp.where(fvalid, fo, sent))
+    ukey = pack2(rank_u, jnp.where(uvalid, uo, sent))
+    forder = jnp.argsort(fkey)
+    fsorted = fkey[forder]
+    pos = jnp.clip(jnp.searchsorted(fsorted, ukey), 0, F - 1)
+    found = uvalid & (fsorted[pos] == ukey)
+    fidx = jnp.where(found, forder[pos], F)
+
+    old_tag = ftag[jnp.clip(fidx, 0, F - 1)]
+    absent = found & jnp.isnan(old_tag)
+    saturated = found & (old_tag >= 1.0)  # NaN compares False
+    new_tag = old_tag + gtag - old_tag * gtag
+    improved = (
+        found
+        & ~absent
+        & ~saturated
+        & (jnp.abs(new_tag - old_tag) >= _ADDMULT_TAG_EQ)
+    )
+    changed = absent | improved
+    merged = jnp.where(absent, gtag, new_tag)
+    fresh = uvalid & ~found
+
+    # append new facts (tags included)
+    n_new = jnp.sum(fresh)
+    n_facts_next = n_facts + n_new
+    overflow = overflow | jnp.where(n_facts_next > F, np.int32(4), 0)
+    adest = jnp.where(fresh, n_facts + jnp.cumsum(fresh) - 1, F)
+    nfs = fs.at[adest].set(us, mode="drop")
+    nfp = fp.at[adest].set(up, mode="drop")
+    nfo = fo.at[adest].set(uo, mode="drop")
+    nftag = ftag.at[adest].set(gtag, mode="drop")
+    nftag = nftag.at[jnp.where(changed, fidx, F)].set(merged, mode="drop")
+
+    # next delta = indices of new ∪ changed fact rows
+    dmask = fresh | changed
+    row_idx = jnp.where(fresh, adest, fidx).astype(jnp.int32)
+    n_dnext = jnp.sum(dmask)
+    ddest = jnp.where(dmask, jnp.cumsum(dmask) - 1, D)
+    ndidx = jnp.zeros(D, jnp.int32).at[ddest].set(row_idx, mode="drop")
+
+    ok = overflow == 0
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    return (
+        sel(nfs, fs),
+        sel(nfp, fp),
+        sel(nfo, fo),
+        sel(nftag, ftag),
+        sel(n_facts_next, n_facts),
+        sel(ndidx, didx),
+        sel(n_dnext.astype(jnp.int32), np.int32(0)),
+        overflow,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Host driver + integration
 # ---------------------------------------------------------------------------
 
@@ -310,6 +565,10 @@ def infer_provenance_device(
         return None
     if any(r.negative_premise for r in reasoner.rules):
         return None  # stratified NAF stays host-side
+    if provenance.name == "addmult" and _addmult_order_sensitive(
+        reasoner.rules
+    ):
+        return None  # order-dependent accumulation: host semantics win
     try:
         rules, bank = lower_rules(reasoner, reasoner.rules)
     except Unsupported:
@@ -334,110 +593,281 @@ def infer_provenance_device(
     eff0 = np.where(np.isnan(tags0), one_enc, tags0)
     if initial_delta is not None:
         key_to_idx = {k: i for i, k in enumerate(facts_keys)}
-        didx = [key_to_idx[k] for k in initial_delta if k in key_to_idx]
-        if not didx:
+        didx = np.asarray(
+            sorted(key_to_idx[k] for k in initial_delta if k in key_to_idx),
+            dtype=np.int32,
+        )
+        if didx.size == 0:
             return {}
-        d_s = s[didx]
-        d_p = p[didx]
-        d_o = o[didx]
-        d_t = eff0[didx]
     else:
-        d_s, d_p, d_o, d_t = s, p, o, eff0
+        didx = np.arange(n0, dtype=np.int32)
+
+    if provenance.name == "addmult":
+        return _drive_addmult(
+            reasoner,
+            provenance,
+            tag_store,
+            rules,
+            masks,
+            s,
+            p,
+            o,
+            tags0,
+            didx,
+            n0,
+            max_attempts,
+        )
+
+    d_s = s[didx]
+    d_p = p[didx]
+    d_o = o[didx]
+    d_t = eff0[didx]
     nd0 = len(d_s)
 
-    F = _round_cap(4 * n0, 2048)
-    D = _round_cap(max(2 * nd0, n0 // 2, 1024))
-    # start TIGHT: the candidate sort scales with J × plans, and the
-    # overflow protocol doubles J cheaply when a round actually needs it
-    J = _round_cap(max(nd0, 1024), 1024)
-
     with jax.enable_x64(True):
+        st = {
+            "fs": _pad_u32(s, 0),
+            "fp": _pad_u32(p, 0),
+            "fo": _pad_u32(o, 0),
+            "ftag": _pad_f64(tags0, 0),
+            "n_facts": n0,
+            "ds": _pad_u32(d_s, 0),
+            "dp": _pad_u32(d_p, 0),
+            "do": _pad_u32(d_o, 0),
+            "dt": _pad_f64(d_t, 0),
+            "n_delta": nd0,
+        }
 
-        def padu(x, cap):
-            x = jnp.asarray(x, dtype=jnp.uint32)
-            return jnp.concatenate(
-                [x, jnp.zeros(cap - x.shape[0], dtype=jnp.uint32)]
-            )
-
-        def padf(x, cap):
-            x = jnp.asarray(x, dtype=jnp.float64)
-            return jnp.concatenate(
-                [x, jnp.zeros(cap - x.shape[0], dtype=jnp.float64)]
-            )
-
-        fs, fp, fo = padu(s, F), padu(p, F), padu(o, F)
-        ftag = padf(tags0, F)
-        n_facts = n0
-        dels, delp, delo = padu(d_s, D), padu(d_p, D), padu(d_o, D)
-        delt = padf(d_t, D)
-        n_delta = nd0
-        attempts = 0
-        for _round in range(10_000):
+        def round_fn(caps, st):
             out = _prov_round(
                 rules,
-                _Caps(F, D, J),
-                fs,
-                fp,
-                fo,
-                ftag,
-                jnp.int32(n_facts),
-                dels,
-                delp,
-                delo,
-                delt,
-                jnp.int32(n_delta),
+                caps,
+                st["fs"],
+                st["fp"],
+                st["fo"],
+                st["ftag"],
+                jnp.int32(st["n_facts"]),
+                st["ds"],
+                st["dp"],
+                st["do"],
+                st["dt"],
+                jnp.int32(st["n_delta"]),
                 jnp.float64(one_enc),
                 masks,
             )
             code = int(out[10])  # one sync per round
             if code != 0:
-                attempts += 1
-                if attempts > max_attempts:
-                    return None  # graceful host fallback (state untouched)
-                if code & 1:
-                    J *= 2
-                if code & 2:
-                    D *= 2
-                    dels, delp, delo = (
-                        padu(dels, D),
-                        padu(delp, D),
-                        padu(delo, D),
-                    )
-                    delt = padf(delt, D)
-                if code & 4:
-                    newF = F * 2
-                    fs, fp, fo = padu(fs, newF), padu(fp, newF), padu(fo, newF)
-                    ftag = padf(ftag, newF)
-                    F = newF
-                continue  # retry the round (it did not commit)
-            fs, fp, fo, ftag = out[0], out[1], out[2], out[3]
-            n_facts = int(out[4])
-            dels, delp, delo, delt = out[5], out[6], out[7], out[8]
-            n_delta = int(out[9])
-            if n_delta == 0:
-                break
-        else:
-            return None  # round limit: graceful host fallback
+                return None, code
+            return {
+                "fs": out[0],
+                "fp": out[1],
+                "fo": out[2],
+                "ftag": out[3],
+                "n_facts": int(out[4]),
+                "ds": out[5],
+                "dp": out[6],
+                "do": out[7],
+                "dt": out[8],
+                "n_delta": int(out[9]),
+            }, 0
 
-        # write back: new facts into the store; every changed-or-new tag
-        # entry into the tag store (vectorized — no per-fact Python loop).
-        # Host parity: each derived fact gets an explicit entry
-        # (update_disjunction inserts on first derivation); NaN still means
-        # "no entry".
-        fs_h = np.asarray(fs[:n_facts])
-        fp_h = np.asarray(fp[:n_facts])
-        fo_h = np.asarray(fo[:n_facts])
-        ft_h = np.asarray(ftag[:n_facts])
-        if n_facts > n0:
-            reasoner.facts.add_batch(fs_h[n0:], fp_h[n0:], fo_h[n0:])
-        has_entry = ~np.isnan(ft_h)
-        unchanged = np.zeros(n_facts, dtype=bool)
-        unchanged[:n0] = ~np.isnan(tags0) & (ft_h[:n0] == tags0)
-        sel = np.flatnonzero(has_entry & ~unchanged)
-        if sel.size:
-            decoded = _decode_tags(provenance, ft_h[sel])
-            keys = zip(
-                fs_h[sel].tolist(), fp_h[sel].tolist(), fo_h[sel].tolist()
+        def pad_delta(st, D):
+            for k in ("ds", "dp", "do"):
+                st[k] = _pad_u32(st[k], D)
+            st["dt"] = _pad_f64(st["dt"], D)
+            return st
+
+        st = _run_overflow_protocol(
+            round_fn, st, n0, nd0, pad_delta, max_attempts
+        )
+        if st is None:
+            return None  # graceful host fallback (reasoner state untouched)
+        _write_back(
+            reasoner,
+            provenance,
+            tag_store,
+            st["fs"],
+            st["fp"],
+            st["fo"],
+            st["ftag"],
+            st["n_facts"],
+            n0,
+            tags0,
+        )
+    return {}
+
+
+def _pad_u32(x, cap):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, dtype=jnp.uint32)
+    pad = max(cap - x.shape[0], 0)
+    return jnp.concatenate([x, jnp.zeros(pad, dtype=jnp.uint32)])
+
+
+def _pad_f64(x, cap):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, dtype=jnp.float64)
+    pad = max(cap - x.shape[0], 0)
+    return jnp.concatenate([x, jnp.zeros(pad, dtype=jnp.float64)])
+
+
+def _pad_i32(x, cap):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, dtype=jnp.int32)
+    pad = max(cap - x.shape[0], 0)
+    return jnp.concatenate([x, jnp.zeros(pad, dtype=jnp.int32)])
+
+
+def _run_overflow_protocol(round_fn, st, n0, nd0, pad_delta, max_attempts):
+    """THE shared static-capacity fixpoint protocol (both round programs):
+    run rounds until the delta drains; an overflowing round does NOT commit
+    — the failing capacity doubles (bit0 join, bit1 delta, bit2 fact) and
+    the round retries from the preserved state.
+
+    ``round_fn(caps, st) -> (next_st | None, code)``; ``st`` holds fact
+    buffers under keys fs/fp/fo/ftag (+ counts n_facts/n_delta), with the
+    delta representation private to the caller (re-padded by ``pad_delta``).
+    Returns the final state, or None after ``max_attempts`` overflows or
+    10k rounds (graceful host fallback).
+    """
+    F = _round_cap(4 * n0, 2048)
+    D = _round_cap(max(2 * nd0, n0 // 2, 1024))
+    # start TIGHT: the candidate sort scales with J × plans, and the
+    # overflow protocol doubles J cheaply when a round actually needs it
+    J = _round_cap(max(nd0, 1024), 1024)
+    for k in ("fs", "fp", "fo"):
+        st[k] = _pad_u32(st[k], F)
+    st["ftag"] = _pad_f64(st["ftag"], F)
+    st = pad_delta(st, D)
+
+    attempts = 0
+    for _round in range(10_000):
+        new_st, code = round_fn(_Caps(F, D, J), st)
+        if code != 0:
+            attempts += 1
+            if attempts > max_attempts:
+                return None
+            if code & 1:
+                J *= 2
+            if code & 2:
+                D *= 2
+                st = pad_delta(st, D)
+            if code & 4:
+                F *= 2
+                for k in ("fs", "fp", "fo"):
+                    st[k] = _pad_u32(st[k], F)
+                st["ftag"] = _pad_f64(st["ftag"], F)
+            continue  # retry the round (it did not commit)
+        st = new_st
+        if st["n_delta"] == 0:
+            return st
+    return None  # round limit
+
+
+def _write_back(
+    reasoner, provenance, tag_store, fs, fp, fo, ftag, n_facts, n0, tags0
+) -> None:
+    """Write back: new facts into the store; every changed-or-new tag entry
+    into the tag store (vectorized — no per-fact Python loop).  Host parity:
+    each derived fact gets an explicit entry (update_disjunction inserts on
+    first derivation); NaN still means "no entry"."""
+    fs_h = np.asarray(fs[:n_facts])
+    fp_h = np.asarray(fp[:n_facts])
+    fo_h = np.asarray(fo[:n_facts])
+    ft_h = np.asarray(ftag[:n_facts])
+    if n_facts > n0:
+        reasoner.facts.add_batch(fs_h[n0:], fp_h[n0:], fo_h[n0:])
+    has_entry = ~np.isnan(ft_h)
+    unchanged = np.zeros(n_facts, dtype=bool)
+    unchanged[:n0] = ~np.isnan(tags0) & (ft_h[:n0] == tags0)
+    sel = np.flatnonzero(has_entry & ~unchanged)
+    if sel.size:
+        decoded = _decode_tags(provenance, ft_h[sel])
+        keys = zip(
+            fs_h[sel].tolist(), fp_h[sel].tolist(), fo_h[sel].tolist()
+        )
+        tag_store.tags.update(zip(keys, decoded))
+
+
+def _drive_addmult(
+    reasoner,
+    provenance,
+    tag_store,
+    rules,
+    masks,
+    s,
+    p,
+    o,
+    tags0,
+    didx0: np.ndarray,
+    n0: int,
+    max_attempts: int,
+) -> Optional[Dict[Tuple[int, int, int], float]]:
+    """Host driver for the exactly-once addmult rounds: the shared overflow
+    protocol with the delta carried as fact-row INDICES."""
+    import jax.numpy as jnp
+
+    nd0 = int(didx0.size)
+
+    with jax.enable_x64(True):
+        st = {
+            "fs": _pad_u32(s, 0),
+            "fp": _pad_u32(p, 0),
+            "fo": _pad_u32(o, 0),
+            "ftag": _pad_f64(tags0, 0),
+            "n_facts": n0,
+            "didx": _pad_i32(didx0, 0),
+            "n_delta": nd0,
+        }
+
+        def round_fn(caps, st):
+            out = _prov_round_addmult(
+                rules,
+                caps,
+                st["fs"],
+                st["fp"],
+                st["fo"],
+                st["ftag"],
+                jnp.int32(st["n_facts"]),
+                st["didx"],
+                jnp.int32(st["n_delta"]),
+                masks,
             )
-            tag_store.tags.update(zip(keys, decoded))
+            code = int(out[7])  # one sync per round
+            if code != 0:
+                return None, code
+            return {
+                "fs": out[0],
+                "fp": out[1],
+                "fo": out[2],
+                "ftag": out[3],
+                "n_facts": int(out[4]),
+                "didx": out[5],
+                "n_delta": int(out[6]),
+            }, 0
+
+        def pad_delta(st, D):
+            st["didx"] = _pad_i32(st["didx"], D)
+            return st
+
+        st = _run_overflow_protocol(
+            round_fn, st, n0, nd0, pad_delta, max_attempts
+        )
+        if st is None:
+            return None  # graceful host fallback (reasoner state untouched)
+        _write_back(
+            reasoner,
+            provenance,
+            tag_store,
+            st["fs"],
+            st["fp"],
+            st["fo"],
+            st["ftag"],
+            st["n_facts"],
+            n0,
+            tags0,
+        )
     return {}

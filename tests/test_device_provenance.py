@@ -282,13 +282,179 @@ def test_auto_hook_routes_on_tpu_backend(monkeypatch):
     assert len(store.tags) > 10
 
 
-def test_addmult_not_supported():
-    assert not supports(AddMultProbability())
-    r = _chain_builder()()
+def _close_tags(ht, dt, tol=1e-9):
+    """Same keys; float tags equal within tolerance (the device noisy-OR
+    folds each group's ⊕ in one log-space reduction, the host pairwise —
+    identical in real arithmetic, fp-close)."""
+    assert set(ht) == set(dt)
+    for k, v in ht.items():
+        assert abs(v - dt[k]) <= tol, (k, v, dt[k])
+
+
+def test_addmult_chain_agreement():
+    """Non-idempotent semiring on device: product ⊗ down a transitive
+    chain, noisy-OR ⊕ across alternate derivations."""
+    assert supports(AddMultProbability())
+    (hf, ht), (df, dt) = both_paths(_chain_builder(), AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+
+
+def test_addmult_diamond_multiple_derivations():
+    """Two proof paths for the same conclusion must ⊕-combine exactly once
+    each (the exactly-once decomposition; duplicates would inflate the
+    noisy-OR)."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "left", "m1", 0.8)
+        r.add_tagged_triple("m1", "right", "z", 0.7)
+        r.add_tagged_triple("a", "left", "m2", 0.6)
+        r.add_tagged_triple("m2", "right", "z", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "left", "?y"), ("?y", "right", "?z")],
+                [("?x", "reaches", "?z")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+    # independent check of the noisy-OR value:
+    # 0.8·0.7 ⊕ 0.6·0.5 = 0.56 + 0.30 − 0.56·0.30 = 0.692
+    tag = [v for v in dt.values() if v == pytest.approx(0.692)]
+    assert tag, dt
+
+
+def test_addmult_cyclic_converges_and_agrees():
+    """Cyclic program: tags keep improving with geometrically shrinking
+    increments until the 1e-12 tag_eq cutoff — both paths must terminate
+    and land on the same fixpoint."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.9)
+        r.add_tagged_triple("b", "p", "c", 0.8)
+        r.add_tagged_triple("c", "p", "a", 0.7)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y"), ("?y", "p", "?z")],
+                [("?x", "p", "?z")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt, tol=1e-6)
+
+
+def test_addmult_filters_and_constants():
+    def build():
+        r = Reasoner()
+        for i in range(8):
+            r.add_tagged_triple(f"s{i}", "score", f"v{i}", 0.3 + 0.05 * i)
+            r.add_abox_triple(f"s{i}", "kind", "sensor")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "score", "?v"), ("?x", "kind", "sensor")],
+                [("?x", "flagged", "yes")],
+                filters=[
+                    FilterCondition("x", "!=", r.dictionary.encode("s0"))
+                ],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+
+
+def test_addmult_order_sensitive_falls_back():
+    """When rule i's conclusions feed rule j>i's premises, the host's live
+    tag reads make the noisy-OR accumulation evaluation-order-dependent —
+    the snapshot-reading device round must decline (host fallback) instead
+    of silently computing a different fixpoint."""
+
+    def build():
+        r = Reasoner()
+        for i in range(5):
+            r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.9)
+            r.add_tagged_triple(f"n{i}", "alt", f"n{i + 1}", 0.4)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "alt", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    r = build()
     store = seed_tag_store(r, AddMultProbability())
-    assert (
-        infer_provenance_device(r, AddMultProbability(), store) is None
+    assert infer_provenance_device(r, AddMultProbability(), store) is None
+
+
+def test_addmult_independent_conclusions_multi_rule():
+    """Multiple rules ARE device-eligible when no rule's conclusions feed a
+    later rule's premises (snapshot ≡ live reads)."""
+
+    def build():
+        r = Reasoner()
+        for i in range(12):
+            r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.8)
+            r.add_tagged_triple(f"n{i}", "alt", f"n{i + 1}", 0.4)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "alt", "?y"), ("?y", "next", "?z")],
+                [("?x", "near", "?z")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "hop2", "?z")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+
+
+def test_addmult_initial_delta():
+    """Explicit-delta entry: only derivations reachable from the delta
+    re-fire; agreement against the host explicit-delta loop."""
+
+    def build():
+        r = Reasoner()
+        for i in range(10):
+            r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.9)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    r0 = build()
+    s, p, o = r0.facts.columns()
+    delta = {(int(s[0]), int(p[0]), int(o[0]))}
+    (hf, ht), (df, dt) = both_paths(
+        build, AddMultProbability(), initial_delta=delta
     )
+    assert hf == df
+    _close_tags(ht, dt)
 
 
 def test_naf_rules_fall_back():
