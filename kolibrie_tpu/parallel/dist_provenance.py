@@ -21,8 +21,16 @@ mirror's tag column is refreshed for new AND improved facts (routed to the
 object owner and scattered by exact (s,p,o) index lookup) so object-keyed
 premise reads stay consistent.
 
-Stratified NAF stays host-side (`Unsupported`), as do AddMult and the
-structural semirings.
+The non-idempotent AddMult semiring also runs distributed (``kind=
+"addmult"``): the round adds exactly-once accounting — OLD (facts \\ delta)
+views of both fact blocks for premise positions before the seed, and ⊕ as
+a shard-local segment noisy-OR in log space (every derivation of a fact
+lands on its subject owner, so the local reduction is globally exact) —
+mirroring the single-chip :func:`_prov_round_addmult`.  Rule sets whose
+accumulation is evaluation-order-dependent (a rule's conclusions feed a
+later rule's premises) are refused, exactly like the single-chip path.
+Stratified NAF stays host-side (`Unsupported`), as do the structural
+semirings.
 
 Parity: ``datalog/.../provenance_semi_naive.rs:26-34,134-197`` over
 ``semi_naive_parallel.rs``'s partitioning — redesigned as mesh-partitioned
@@ -59,6 +67,8 @@ from kolibrie_tpu.parallel.dist_general import (
 from kolibrie_tpu.parallel.sharded_store import partition_rows, shard_of
 from kolibrie_tpu.reasoner.device_fixpoint import Unsupported, _scan_premise
 from kolibrie_tpu.reasoner.device_provenance import (
+    _ADDMULT_TAG_EQ,
+    _addmult_order_sensitive,
     _decode_tags,
     _seed_tag_arrays,
     supports_idempotent,
@@ -115,6 +125,7 @@ def _tagged_round(
     delta_cap,
     join_cap,
     bucket_cap,
+    kind="idem",
 ):
     (
         fs,
@@ -140,6 +151,34 @@ def _tagged_round(
     overflow = jnp.int32(0)
     parts: List[tuple] = []
 
+    if kind == "addmult":
+        # exactly-once decomposition needs OLD (= facts \ delta) views of
+        # both fact blocks.  The delta is subject-partitioned like the
+        # subject-owned block (local lookup); the object mirror's mask
+        # needs one routing of the delta to object owners.
+        didx_f, dfound_f = _index3((ds, dp_, do_), dv, fcols, fv, fact_cap)
+        in_f = (
+            jnp.zeros(fact_cap, bool)
+            .at[jnp.where(dfound_f, didx_f, fact_cap)]
+            .set(True, mode="drop")
+        )
+        old_fv = fv & ~in_f
+        (rds, rdp, rdo), rdv, dropd = exchange(
+            (ds, dp_, do_), dv, shard_of_dev(do_, n), n, axis, bucket_cap
+        )
+        overflow = overflow + dropd.astype(jnp.int32)
+        didx_g, dfound_g = _index3(
+            (rds, rdp, rdo), rdv, (gs, gp, go), gv, fact_cap
+        )
+        in_g = (
+            jnp.zeros(fact_cap, bool)
+            .at[jnp.where(dfound_g, didx_g, fact_cap)]
+            .set(True, mode="drop")
+        )
+        old_gv = gv & ~in_g
+    else:
+        old_fv, old_gv = fv, gv  # idempotent ⊕: duplicates are harmless
+
     for lr, plans in rules:
         for seed, steps in plans:
             table, valid = _scan_premise(lr.premises[seed], (ds, dp_, do_), dv)
@@ -151,19 +190,11 @@ def _tagged_round(
                 )
                 overflow = overflow + dropped.astype(jnp.int32)
                 if kpos == 0:
-                    side_cols, side_valid, side_key, side_tag = (
-                        fcols,
-                        fv,
-                        fs,
-                        ftag,
-                    )
+                    side_cols, side_key, side_tag = fcols, fs, ftag
+                    side_valid = old_fv if j < seed else fv
                 else:
-                    side_cols, side_valid, side_key, side_tag = (
-                        (gs, gp, go),
-                        gv,
-                        go,
-                        gtag,
-                    )
+                    side_cols, side_key, side_tag = (gs, gp, go), go, gtag
+                    side_valid = old_gv if j < seed else gv
                 ptable, pmask = _scan_premise(prem, side_cols, side_valid)
                 li, ri, jvalid, total = local_join_u32(
                     table[kv], side_key, join_cap, valid, pmask
@@ -177,10 +208,14 @@ def _tagged_round(
                         new_table[v] = c[ri]
                     elif v in extra:
                         jvalid = jvalid & (new_table[v] == c[ri])
-                # ⊗ = min; absent (NaN) premise entries read as one()
+                # ⊗ (min for the idempotent family, product for addmult);
+                # absent (NaN) premise entries read as one()
                 ptag = side_tag[ri]
                 ptag = jnp.where(jnp.isnan(ptag), one_enc, ptag)
-                tag = jnp.minimum(tag[li], ptag)
+                if kind == "addmult":
+                    tag = tag[li] * ptag
+                else:
+                    tag = jnp.minimum(tag[li], ptag)
                 table, valid = new_table, jvalid
             for f in lr.filters:
                 col = table[f.var]
@@ -215,15 +250,23 @@ def _tagged_round(
     )
     overflow = overflow + drop1.astype(jnp.int32)
 
-    # group-max dedup: 4-key sort with -tag tiebreak, first row per (s,p,o)
-    # group carries its ⊕ (max) tag
+    # group the candidates per (s,p,o) — every derivation of a fact lands
+    # on its subject owner, so a shard-local segment ⊕ is globally exact
     sent = _RPAD32
     ss = jnp.where(rv_, rs_, sent)
     sp = jnp.where(rv_, rp_, sent)
     so = jnp.where(rv_, ro_, sent)
-    st = jnp.where(rv_, rt_, 0.0)
-    ss, sp, so, negtag = lax.sort((ss, sp, so, -st), num_keys=4)
-    ut_sorted = -negtag
+    if kind == "addmult":
+        # ⊕ = noisy-OR over the group, folded as a segment reduction in
+        # log space: 1 - ∏(1-pᵢ) = -expm1(Σ log1p(-pᵢ))
+        st = jnp.where(rv_, jnp.clip(rt_, 0.0, 1.0), 0.0)
+        ss, sp, so, st = lax.sort((ss, sp, so, st), num_keys=3)
+    else:
+        # idempotent ⊕ = max: 4-key sort with -tag tiebreak, first row per
+        # group carries the max
+        st = jnp.where(rv_, rt_, 0.0)
+        ss, sp, so, negtag = lax.sort((ss, sp, so, -st), num_keys=4)
+        st = -negtag
     isnew = jnp.concatenate(
         [
             jnp.ones(1, bool),
@@ -239,14 +282,40 @@ def _tagged_round(
     us = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(ss, mode="drop")
     up = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(sp, mode="drop")
     uo = jnp.zeros(delta_cap, jnp.uint32).at[dest].set(so, mode="drop")
-    ut = jnp.zeros(delta_cap, jnp.float64).at[dest].set(ut_sorted, mode="drop")
+    if kind == "addmult":
+        seg = jnp.cumsum(isnew) - 1
+        segdst = jnp.where(ss != sent, seg, delta_cap)
+        logsum = (
+            jnp.zeros(delta_cap, jnp.float64)
+            .at[segdst]
+            .add(jnp.log1p(-st), mode="drop")
+        )
+        ut = -jnp.expm1(logsum)
+        import os as _os
+        if _os.environ.get("KOLIBRIE_DEBUG_DIST"):
+            _tagged_round._debug = (cs, cp, co, ct, cv, ss, sp, so, st, ut)
+    else:
+        ut = jnp.zeros(delta_cap, jnp.float64).at[dest].set(st, mode="drop")
     uv = jnp.arange(delta_cap) < n_uniq
 
     # owner-local exact lookup: index into the subject-owned fact block
     fidx, found = _index3((us, up, uo), uv, fcols, fv, fact_cap)
     old_tag = ftag[jnp.clip(fidx, 0, fact_cap - 1)]
     absent = found & jnp.isnan(old_tag)
-    improved = found & (ut > old_tag)  # NaN compares False
+    if kind == "addmult":
+        # update_disjunction parity: saturated (≥1) short-circuits; else
+        # new = old ⊕ g with the 1e-12 tag_eq change cutoff
+        saturated = found & (old_tag >= 1.0)  # NaN compares False
+        merged = old_tag + ut - old_tag * ut
+        improved = (
+            found
+            & ~absent
+            & ~saturated
+            & (jnp.abs(merged - old_tag) >= _ADDMULT_TAG_EQ)
+        )
+        ut = jnp.where(improved, merged, ut)  # stored/delta value
+    else:
+        improved = found & (ut > old_tag)  # NaN compares False
     changed = absent | improved
     fresh = uv & ~found
 
@@ -370,8 +439,20 @@ class DistProvenanceReasoner:
         join_cap: Optional[int] = None,
         bucket_cap: Optional[int] = None,
     ):
-        if not supports_idempotent(provenance):
-            raise Unsupported(f"semiring {provenance.name!r} is not scalar-idempotent")
+        if supports_idempotent(provenance):
+            self.kind = "idem"
+        elif getattr(provenance, "name", None) == "addmult":
+            if _addmult_order_sensitive(reasoner.rules):
+                raise Unsupported(
+                    "addmult accumulation is rule-evaluation-order-dependent"
+                    " for this rule set (a rule's conclusions feed a later"
+                    " rule's premises): host semantics win"
+                )
+            self.kind = "addmult"
+        else:
+            raise Unsupported(
+                f"semiring {provenance.name!r} has no distributed tag algebra"
+            )
         if any(r.negative_premise for r in reasoner.rules):
             raise Unsupported("stratified NAF stays host-side")
         self.mesh = mesh
@@ -397,6 +478,7 @@ class DistProvenanceReasoner:
             delta_cap=self.delta_cap,
             join_cap=self.join_cap,
             bucket_cap=self.bucket_cap,
+            kind=self.kind,
         )
         spec = P(self.axis, None)
         rep = P()

@@ -186,13 +186,92 @@ def test_naf_unsupported(mesh):
         DistProvenanceReasoner(mesh, r, prov, store)
 
 
-def test_addmult_unsupported(mesh):
+def _close_tags(ht, dt, tol=1e-9):
+    assert set(ht) == set(dt)
+    for k, v in ht.items():
+        assert abs(v - dt[k]) <= tol, (k, v, dt[k])
+
+
+def test_addmult_chain_agreement(mesh):
+    """Non-idempotent ⊕ over the mesh: transitive chain, exactly-once
+    derivation accounting across shards."""
+
+    def build():
+        r = Reasoner()
+        for i in range(24):
+            r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.5 + 0.01 * i)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "next", "?y"), ("?y", "next", "?z")],
+                [("?x", "next", "?z")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(mesh, build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+
+
+def test_addmult_diamond_agreement(mesh):
+    """Two proof paths ⊕-combine exactly once each across shards
+    (duplicates would inflate the noisy-OR)."""
+
+    def build():
+        r = Reasoner()
+        for i in range(10):
+            r.add_tagged_triple(f"a{i}", "left", f"m{2 * i}", 0.8)
+            r.add_tagged_triple(f"m{2 * i}", "right", f"z{i}", 0.7)
+            r.add_tagged_triple(f"a{i}", "left", f"m{2 * i + 1}", 0.6)
+            r.add_tagged_triple(f"m{2 * i + 1}", "right", f"z{i}", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "left", "?y"), ("?y", "right", "?z")],
+                [("?x", "reaches", "?z")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(mesh, build, AddMultProbability())
+    assert hf == df
+    _close_tags(ht, dt)
+    assert any(v == pytest.approx(0.692) for v in dt.values()), dt
+
+
+def test_addmult_order_sensitive_unsupported(mesh):
+    """A rule whose conclusions feed a later rule's premises makes addmult
+    accumulation order-dependent — the distributed path must refuse."""
+    r = Reasoner()
+    for i in range(4):
+        r.add_tagged_triple(f"n{i}", "next", f"n{i + 1}", 0.9)
+        r.add_tagged_triple(f"n{i}", "alt", f"n{i + 1}", 0.4)
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "next", "?y"), ("?y", "next", "?z")],
+            [("?x", "next", "?z")],
+        )
+    )
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "alt", "?y"), ("?y", "next", "?z")],
+            [("?x", "next", "?z")],
+        )
+    )
+    prov = AddMultProbability()
+    store = seed_tag_store(r, prov)
+    with pytest.raises(Unsupported):
+        DistProvenanceReasoner(mesh, r, prov, store)
+
+
+def test_structural_semiring_unsupported(mesh):
+    from kolibrie_tpu.reasoner.provenance import TopKProofs
+
     r = Reasoner()
     r.add_abox_triple("a", "p", "b")
     r.add_rule(
         r.rule_from_strings([("?x", "p", "?y")], [("?x", "q", "?y")])
     )
-    prov = AddMultProbability()
+    prov = TopKProofs(k=3)
     store = seed_tag_store(r, prov)
     with pytest.raises(Unsupported):
         DistProvenanceReasoner(mesh, r, prov, store)
