@@ -32,6 +32,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 N_EMPLOYEES = 25_000  # x4 predicates = 100K triples
@@ -78,11 +81,24 @@ def build_db():
 def main():
     import jax
 
+    if os.environ.get("KOLIBRIE_BENCH_CPU"):
+        # The env preloads jax with the axon (TPU tunnel) platform via
+        # sitecustomize; JAX_PLATFORMS is too late.  This is the reliable
+        # CPU override (same mechanism as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
     from kolibrie_tpu.optimizer.device_engine import PreparedQuery
     from kolibrie_tpu.query.executor import execute_query_volcano
 
     db, t_load = build_db()
     platform = jax.devices()[0].platform
+    # Off-TPU (CPU fallback attempt) the full dispatch protocol takes >15
+    # minutes; a reduced protocol keeps the attempt inside the supervisor's
+    # per-attempt timeout while still measuring the same pipeline.
+    if platform == "tpu":
+        n_dispatch, scan_k, gap = N_DISPATCH, SCAN_K, DISPATCH_GAP_S
+    else:
+        n_dispatch, scan_k, gap = 5, 4, 0.0
 
     # ---- host baseline: full e2e and operator-pipeline-only --------------
     db.execution_mode = "host"
@@ -106,27 +122,39 @@ def main():
     out = prep.run()
     jax.block_until_ready(out)
     times = []
-    for _ in range(N_DISPATCH):
+    for _ in range(n_dispatch):
         t0 = time.perf_counter()
         out = prep.run()
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
-        time.sleep(DISPATCH_GAP_S)
+        time.sleep(gap)
     dev_t = min(times)
 
     # ---- amortized: K plan executions per dispatch (tunnel latency is
     # ~1ms/dispatch and swamps a sub-ms plan; the scan carries a dependency
     # so XLA cannot hoist the body) -----------------------------------------
-    outk = prep.run_amortized(SCAN_K)
-    jax.block_until_ready(outk)
-    times_k = []
-    for _ in range(N_DISPATCH):
-        t0 = time.perf_counter()
-        outk = prep.run_amortized(SCAN_K)
-        jax.block_until_ready(outk)
-        times_k.append(time.perf_counter() - t0)
-        time.sleep(DISPATCH_GAP_S)
-    dev_tk = min(times_k) / SCAN_K
+    def time_amortized(n_samples):
+        ok = prep.run_amortized(scan_k)
+        jax.block_until_ready(ok)
+        ts = []
+        for _ in range(n_samples):
+            t0 = time.perf_counter()
+            ok = prep.run_amortized(scan_k)
+            jax.block_until_ready(ok)
+            ts.append(time.perf_counter() - t0)
+            time.sleep(gap)
+        return ok, min(ts) / scan_k
+
+    outk, dev_tk = time_amortized(n_dispatch)
+
+    # ---- Pallas vs XLA join formulation on the SAME engine plan ----------
+    # (the default path picked above is Pallas on TPU / XLA elsewhere; the
+    # toggle is a static jit arg, so each setting compiles separately)
+    os.environ["KOLIBRIE_PALLAS_JOIN"] = "0"
+    _, xla_tk = time_amortized(max(5, n_dispatch // 3))
+    os.environ["KOLIBRIE_PALLAS_JOIN"] = "1"
+    _, pallas_tk = time_amortized(max(5, n_dispatch // 3))
+    del os.environ["KOLIBRIE_PALLAS_JOIN"]
 
     # ---- correctness AFTER timing (readback poisons later dispatches) ----
     rows = prep.fetch(out)
@@ -151,12 +179,15 @@ def main():
                     "single_dispatch_triples_per_sec": round(N_TRIPLES / dev_t, 1),
                     "host_engine_exec_ms": round(1000 * host_exec, 3),
                     "host_e2e_ms": round(1000 * host_e2e, 2),
+                    "pallas_join_exec_ms": round(1000 * pallas_tk, 4),
+                    "xla_join_exec_ms": round(1000 * xla_tk, 4),
+                    "pallas_vs_xla_join": round(xla_tk / pallas_tk, 3),
                     "rows": len(rows),
                     "bulk_load_s": round(t_load, 3),
                     "note": "public-API prepared query: SPARQL parse + "
                     "Streamertail plan once, then the plan's single XLA "
                     "program over device-resident store orders; value = "
-                    f"throughput amortized over {SCAN_K} executions/dispatch "
+                    f"throughput amortized over {scan_k} executions/dispatch "
                     "(materialized columns produced every iteration); rows "
                     "verified equal to the host numpy engine",
                 },
@@ -165,5 +196,84 @@ def main():
     )
 
 
+# ---------------------------------------------------------------------------
+# Supervisor: the TPU behind the axon tunnel has contention windows where
+# backend init / first dispatch raises UNAVAILABLE (this cost round 2 its
+# only driver-captured number).  The benchmark body therefore runs in a
+# child process (a failed jax backend init cannot be retried in-process),
+# the supervisor retries with backoff, and the last attempt falls back to
+# forced-CPU so ONE parseable JSON line is always printed.
+# ---------------------------------------------------------------------------
+
+ATTEMPT_TIMEOUT_S = 900
+BACKOFFS_S = (5, 20, 45)  # sleeps between the TPU attempts
+
+
+def _run_child(env_extra):
+    env = dict(os.environ)
+    env["KOLIBRIE_BENCH_CHILD"] = "1"
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=ATTEMPT_TIMEOUT_S,
+            env=env,
+        )
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return None, err + f"\n[supervisor] attempt timed out after {ATTEMPT_TIMEOUT_S}s"
+    if rc == 0:
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    json.loads(line)
+                    return line, None
+                except ValueError:
+                    continue
+        return None, f"rc=0 but no JSON line in stdout:\n{out[-2000:]}\n{err[-2000:]}"
+    return None, f"rc={rc}\n{err[-4000:]}"
+
+
+def supervise():
+    failures = []
+    for i, backoff in enumerate((*BACKOFFS_S, None)):
+        line, fail = _run_child({})
+        if line is not None:
+            print(line)
+            return 0
+        failures.append(f"attempt {i + 1}: {fail}")
+        if backoff is not None:
+            time.sleep(backoff)
+    # Last resort: forced-CPU child so the round still records a real
+    # engine-path number (metric name carries the platform).
+    line, fail = _run_child({"KOLIBRIE_BENCH_CPU": "1"})
+    if line is not None:
+        rec = json.loads(line)
+        rec.setdefault("secondary", {})["tpu_failures"] = failures
+        print(json.dumps(rec))
+        return 0
+    failures.append(f"cpu fallback: {fail}")
+    print(
+        json.dumps(
+            {
+                "metric": "bgp_join_employee100k_engine_triples_per_sec",
+                "value": None,
+                "unit": "triples/sec/chip",
+                "vs_baseline": None,
+                "error": failures,
+            }
+        )
+    )
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("KOLIBRIE_BENCH_CHILD"):
+        main()
+    else:
+        sys.exit(supervise())

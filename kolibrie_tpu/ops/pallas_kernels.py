@@ -74,6 +74,22 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def pallas_join_enabled() -> bool:
+    """Should the engine route eligible joins through the Pallas kernel?
+
+    Default: only on real TPU (interpreted Pallas is far slower than the
+    XLA formulation on CPU, so the test suite keeps the XLA path unless it
+    opts in).  ``KOLIBRIE_PALLAS_JOIN=1`` forces the kernel path anywhere
+    (tests exercise it in interpret mode); ``=0`` forces it off on TPU.
+    """
+    import os
+
+    env = os.environ.get("KOLIBRIE_PALLAS_JOIN")
+    if env is not None:
+        return env != "0"
+    return jax.default_backend() == "tpu"
+
+
 # ---------------------------------------------------------------------------
 # merge join
 # ---------------------------------------------------------------------------
@@ -153,40 +169,20 @@ def _merge_join_kernel(
         valid_out_ref[r, :] = valid[0, :]
 
 
-@partial(jax.jit, static_argnames=("cap",))
-def merge_join(
-    lkey: jnp.ndarray,
+def _pallas_join_core(
+    lkey_u: jnp.ndarray,
     lval: jnp.ndarray,
-    rkey: jnp.ndarray,
-    rval: jnp.ndarray,
+    rkey_u: jnp.ndarray,
     cap: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Equi-join of two subject-sorted runs, Pallas-tiled materialization.
-
-    ``lkey``/``rkey`` must be sorted ascending.  Returns
-    ``(key, lval, rval, valid, total)`` of static length ``cap`` (`total` is
-    the true match count; if ``total > cap`` the caller re-runs with a
-    larger capacity — the standard static-shape contract of
-    :mod:`kolibrie_tpu.ops.device_join`).
-
-    Pipeline: XLA pre-pass (searchsorted run bounds, nonzero-row compaction,
-    cumsum, per-tile merge-path partition) → Pallas tile kernel (gather-free
-    one-hot materialization) → one XLA row gather for the right payload.
-
-    Keys/payloads are treated as u32; inside the kernel they ride as
-    bitcast int32 (pure passthrough, exact for the full u32 range — the
-    sorted-order-sensitive searchsorted runs on the u32 originals).
-
-    Inputs past ``_PALLAS_MAX_LEFT_ROWS`` route to the pure-XLA
-    formulation: the current Mosaic toolchain raises a device fault once
-    row-start offsets cross 2^19 under multi-thousand-tile grids (verified
-    empirically on v5e; block-index, pipeline-lookahead and SMEM-size
-    causes ruled out), so the kernel path is gated to the proven range.
-    The XLA path is the same algorithm (searchsorted + cumsum expansion)
-    and is what the device query engine uses throughout.
+    """Shared Pallas pipeline: returns ``(key, lval, pos, valid, total)``
+    where ``pos`` is the matching RIGHT row index (int32) and outputs have
+    static length ``cap`` rounded up to whole (G, TILE) blocks.  ``rkey_u``
+    must be sorted ascending; ``lkey_u`` may be in any order (the merge-path
+    partition runs over the cumsum of per-left-row match counts, which is
+    monotone regardless of left key order).  ``total`` is an exact i64
+    match count.
     """
-    lkey_u = lkey.astype(jnp.uint32)
-    rkey_u = rkey.astype(jnp.uint32)
     n_groups = max(1, -(-cap // (G * TILE)))
     n_tiles = n_groups * G
     cap = n_tiles * TILE
@@ -194,16 +190,12 @@ def merge_join(
     def _bc(x):
         return lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32)
 
-    if lkey.shape[0] == 0 or rkey.shape[0] == 0:
-        z = jnp.zeros(cap, jnp.uint32)
-        return z, z, z, jnp.zeros(cap, bool), jnp.int32(0)
-    if lkey.shape[0] > _PALLAS_MAX_LEFT_ROWS:
-        return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
-
     # --- XLA pre-pass -----------------------------------------------------
     low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
     high = jnp.searchsorted(rkey_u, lkey_u, side="right").astype(jnp.int32)
     counts = high - low
+    with jax.enable_x64(True):
+        total64 = jnp.sum(counts.astype(jnp.int64))
     # Compact to rows with ≥1 match (stable: False sorts before True).
     order = jnp.argsort(counts == 0, stable=True)
     lkey_c = _bc(lkey_u)[order]
@@ -277,12 +269,105 @@ def merge_join(
     lval_o = lax.bitcast_convert_type(lval_o.reshape(cap), jnp.uint32)
     pos_o = pos_o.reshape(cap)
     valid_o = valid_o.reshape(cap).astype(bool)
+    return key_o, lval_o, pos_o, valid_o, total64
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def merge_join(
+    lkey: jnp.ndarray,
+    lval: jnp.ndarray,
+    rkey: jnp.ndarray,
+    rval: jnp.ndarray,
+    cap: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Equi-join of two runs (right sorted), Pallas-tiled materialization.
+
+    ``rkey`` must be sorted ascending (``lkey`` may be in any order).
+    Returns ``(key, lval, rval, valid, total)`` of static length ``cap``
+    rounded up to whole tiles (``total`` is the true match count; if
+    ``total > cap`` the caller re-runs with a larger capacity — the standard
+    static-shape contract of :mod:`kolibrie_tpu.ops.device_join`).
+
+    Pipeline: XLA pre-pass (searchsorted run bounds, nonzero-row compaction,
+    cumsum, per-tile merge-path partition) → Pallas tile kernel (gather-free
+    one-hot materialization) → one XLA row gather for the right payload.
+
+    Keys/payloads are treated as u32; inside the kernel they ride as
+    bitcast int32 (pure passthrough, exact for the full u32 range — the
+    sorted-order-sensitive searchsorted runs on the u32 originals).
+
+    Inputs past ``_PALLAS_MAX_LEFT_ROWS`` route to the pure-XLA
+    formulation: the current Mosaic toolchain raises a device fault once
+    row-start offsets cross 2^19 under multi-thousand-tile grids (verified
+    empirically on v5e; block-index, pipeline-lookahead and SMEM-size
+    causes ruled out), so the kernel path is gated to the proven range.
+    The XLA path is the same algorithm (searchsorted + cumsum expansion).
+    """
+    lkey_u = lkey.astype(jnp.uint32)
+    rkey_u = rkey.astype(jnp.uint32)
+    n_groups = max(1, -(-cap // (G * TILE)))
+    cap = n_groups * G * TILE
+    if lkey.shape[0] == 0 or rkey.shape[0] == 0:
+        z = jnp.zeros(cap, jnp.uint32)
+        return z, z, z, jnp.zeros(cap, bool), jnp.int32(0)
+    if lkey.shape[0] > _PALLAS_MAX_LEFT_ROWS:
+        return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
+    key_o, lval_o, pos_o, valid_o, total = _pallas_join_core(
+        lkey_u, lval, rkey_u, cap
+    )
     rval_o = jnp.where(
         valid_o,
         rval.astype(jnp.uint32)[jnp.clip(pos_o, 0, max(rval.shape[0] - 1, 0))],
         jnp.uint32(0),
     )
     return key_o, lval_o, rval_o, valid_o, total
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def merge_join_indices(
+    lkey: jnp.ndarray,
+    rkey_sorted: jnp.ndarray,
+    cap: int,
+    lvalid: Optional[jnp.ndarray] = None,
+    rvalid_prefix: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Index-returning Pallas merge join: the drop-in kernel twin of
+    :func:`kolibrie_tpu.ops.device_join.join_indices_presorted` for
+    single-u32-key joins (the device query engine's ``rsorted`` join node).
+
+    Returns ``(li, ri, valid, total)``: int32 row indices into the ORIGINAL
+    left/right inputs, static length ``cap`` rounded up to whole tiles.
+    The left payload slot of the shared tile kernel carries the left row
+    index through compaction, so the engine can gather arbitrarily many
+    binding columns afterwards.  ``rvalid_prefix`` must be a prefix mask
+    (range-scan validity), which keeps the sentinel-masked right keys
+    sorted; ``lvalid`` may have holes (left order is irrelevant — see
+    :func:`_pallas_join_core`).
+    """
+    lkey_u = lkey.astype(jnp.uint32)
+    rkey_u = rkey_sorted.astype(jnp.uint32)
+    if lvalid is not None:
+        lkey_u = jnp.where(lvalid, lkey_u, np.uint32(0xFFFFFFFE))
+    if rvalid_prefix is not None:
+        rkey_u = jnp.where(rvalid_prefix, rkey_u, np.uint32(0xFFFFFFFF))
+    n_groups = max(1, -(-cap // (G * TILE)))
+    cap_r = n_groups * G * TILE
+    ln, rn = lkey_u.shape[0], rkey_u.shape[0]
+    if ln == 0 or rn == 0:
+        z = jnp.zeros(cap_r, jnp.int32)
+        return z, z, jnp.zeros(cap_r, bool), jnp.int32(0)
+    if ln > _PALLAS_MAX_LEFT_ROWS:
+        from kolibrie_tpu.ops.device_join import join_indices_presorted
+
+        li, ri, valid, total = join_indices_presorted(lkey_u, rkey_u, cap_r)
+        return li, ri.astype(jnp.int32), valid, total
+    _, li_o, pos_o, valid_o, total = _pallas_join_core(
+        lkey_u, jnp.arange(ln, dtype=jnp.uint32), rkey_u, cap_r
+    )
+    li = lax.bitcast_convert_type(li_o, jnp.int32)
+    li = jnp.where(valid_o, jnp.clip(li, 0, ln - 1), 0)
+    ri = jnp.where(valid_o, jnp.clip(pos_o, 0, rn - 1), 0)
+    return li, ri, valid_o, total
 
 
 def _xla_merge_join(lkey, lval, rkey, rval, cap):

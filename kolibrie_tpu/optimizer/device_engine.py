@@ -154,7 +154,9 @@ def _pack_key(cols: List, valid, pad_sentinel):
     return jnp.where(valid, key, jnp.uint64(pad_sentinel))
 
 
-def _plan_body(spec: PlanSpec, order_arrays, scalars, masks, values, numf):
+def _plan_body(
+    spec: PlanSpec, order_arrays, scalars, masks, values, numf, use_pallas=False
+):
     import jax.numpy as jnp
 
     from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices
@@ -229,16 +231,34 @@ def _plan_body(spec: PlanSpec, order_arrays, scalars, masks, values, numf):
 
             lcols, lvalid, _ = eval_node(node.left)
             rcols, rvalid, _ = eval_node(node.right)
-            lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
-            rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
-            if node.rsorted:
+            if node.rsorted and use_pallas:
                 # right child is a bare range scan whose order presents the
-                # key column sorted, and its validity is a prefix mask — the
-                # sentinel-masked key stays sorted, so skip the argsort
+                # single u32 key column sorted with prefix validity — the
+                # exact contract of the Pallas merge-join tile kernel
+                # (ops/pallas_kernels.py), which is the engine's production
+                # join on TPU (BASELINE north star: physical operators as
+                # Pallas kernels).
+                from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
+
+                kv = node.key_vars[0]
+                li, ri, valid, total = merge_join_indices(
+                    lcols[kv], rcols[kv], node.cap, lvalid, rvalid
+                )
+                # kernel outputs are padded to whole tiles; matches are a
+                # prefix, so slicing restores the node's static capacity
+                li, ri, valid = li[: node.cap], ri[: node.cap], valid[: node.cap]
+            elif node.rsorted:
+                # same join, pure-XLA formulation (searchsorted + cumsum
+                # expansion) — used off-TPU where interpreted Pallas would
+                # be slow, and overridable via KOLIBRIE_PALLAS_JOIN
+                lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
+                rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
                 li, ri, valid, total = join_indices_presorted(
                     lkey, rkey, node.cap
                 )
             else:
+                lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
+                rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
                 li, ri, valid, total = join_indices(lkey, rkey, node.cap)
             counts.append(total)
             out = {}
@@ -260,13 +280,26 @@ def _plan_body(spec: PlanSpec, order_arrays, scalars, masks, values, numf):
     return out, valid, tuple(counts)
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def _run_plan(spec: PlanSpec, order_arrays, scalars, masks, values, numf):
-    return _plan_body(spec, order_arrays, scalars, masks, values, numf)
+@partial(jax.jit, static_argnames=("spec", "use_pallas"))
+def _run_plan(
+    spec: PlanSpec, use_pallas: bool, order_arrays, scalars, masks, values, numf
+):
+    return _plan_body(
+        spec, order_arrays, scalars, masks, values, numf, use_pallas
+    )
 
 
-@partial(jax.jit, static_argnames=("spec", "k"))
-def _run_plan_k(spec: PlanSpec, k: int, order_arrays, scalars, masks, values, numf):
+@partial(jax.jit, static_argnames=("spec", "k", "use_pallas"))
+def _run_plan_k(
+    spec: PlanSpec,
+    k: int,
+    use_pallas: bool,
+    order_arrays,
+    scalars,
+    masks,
+    values,
+    numf,
+):
     """Execute the SAME compiled plan body ``k`` times in one dispatch with a
     loop-carried dependency (benchmark amortization: the shared-TPU tunnel's
     per-dispatch latency otherwise swamps sub-millisecond plans).  Returns
@@ -279,7 +312,9 @@ def _run_plan_k(spec: PlanSpec, k: int, order_arrays, scalars, masks, values, nu
         # carry >= 0 always, so the shift is 0 at runtime — but XLA cannot
         # hoist the iteration body because scalars depends on the carry
         sc = scalars + (carry >> jnp.int64(62)).astype(scalars.dtype)
-        out, valid, _counts = _plan_body(spec, order_arrays, sc, masks, values, numf)
+        out, valid, _counts = _plan_body(
+            spec, order_arrays, sc, masks, values, numf, use_pallas
+        )
         checksum = sum(c.astype(jnp.uint64).sum() for c in out)
         nrows = jnp.sum(valid).astype(jnp.int64)
         return nrows, (checksum, nrows)
@@ -872,16 +907,20 @@ class LoweredPlan:
 
     def run(self, tag: int = 0):
         """One dispatch (no readback).  Returns (out_cols, valid, counts)."""
+        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
         spec, args = self.build(tag)
         with jax.enable_x64(True):
-            return _run_plan(spec, *args)
+            return _run_plan(spec, pallas_join_enabled(), *args)
 
     def run_k(self, k: int, tag: int = 0):
         """``k`` plan executions amortized into one dispatch (see
         :func:`_run_plan_k`); returns (checksums, row counts), no readback."""
+        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
         spec, args = self.build(tag)
         with jax.enable_x64(True):
-            return _run_plan_k(spec, k, *args)
+            return _run_plan_k(spec, k, pallas_join_enabled(), *args)
 
     def _store_caps(self) -> None:
         self.db.__dict__.setdefault("_device_cap_cache", {})[self.cap_key] = tuple(
@@ -937,36 +976,41 @@ def try_device_execute(db, plan) -> Optional[BindingTable]:
 # Device GROUP BY / aggregation (BASELINE config 2 on device)
 # ---------------------------------------------------------------------------
 
-_AGG_SENT = 0xFFFFFFFFFFFFFFFF  # u64 sentinel for invalid rows' group keys
+@partial(jax.jit, static_argnames=("gpos", "funcs", "apos", "distincts", "cap"))
+def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, distincts, cap):
+    """Segment-reduce the final plan table ON DEVICE: stable multi-operand
+    sort by the group key columns, first-occurrence segment ids,
+    scatter-reduce per aggregate.
 
-
-@partial(jax.jit, static_argnames=("gpos", "funcs", "apos", "cap"))
-def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
-    """Segment-reduce the final plan table ON DEVICE: sort rows by group
-    key, first-occurrence segment ids, scatter-reduce per aggregate.
-
-    ``gpos``: positions of the (≤2) group columns in ``cols``; ``funcs``:
-    aggregate names; ``apos``: per-aggregate value column position (or -1
-    for COUNT(*)).  Returns (group id cols, f64 agg arrays, n_groups) with
-    static length ``cap`` — readback is O(groups), not O(rows), which is
-    the whole point on a tunneled TPU."""
+    ``gpos``: positions of the group columns in ``cols`` (ANY count — the
+    key rides as parallel sort operands, not a packed word); ``funcs``:
+    aggregate names (COUNT/SUM/AVG/MIN/MAX/SAMPLE); ``apos``: per-aggregate
+    value column position (or -1 for COUNT(*)); ``distincts``: per-aggregate
+    DISTINCT flag (honored for COUNT — host parity: other funcs ignore it).
+    Returns (group id cols, f64-or-id agg arrays, n_groups) with static
+    length ``cap`` — readback is O(groups), not O(rows), which is the whole
+    point on a tunneled TPU."""
     import jax.numpy as jnp
+    from jax import lax
 
     n = valid.shape[0]
+    sent = np.uint32(0xFFFFFFFF)  # never a real ID (dictionary.rs:36-40)
     if gpos:
-        k = cols[gpos[0]].astype(jnp.uint64)
-        if len(gpos) == 2:
-            k = (k << np.uint64(32)) | cols[gpos[1]].astype(jnp.uint64)
-        key = jnp.where(valid, k, np.uint64(_AGG_SENT))
+        keys = [jnp.where(valid, cols[g], sent) for g in gpos]
     else:
         # aggregate without GROUP BY: one group holding every valid row
-        key = jnp.where(valid, np.uint64(0), np.uint64(_AGG_SENT))
-    order = jnp.argsort(key)
-    ks = key[order]
-    rowok = ks != np.uint64(_AGG_SENT)
-    isnew = (
-        jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]]) & rowok
+        keys = [jnp.where(valid, jnp.uint32(0), sent)]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = lax.sort(
+        (*keys, iota), num_keys=len(keys), is_stable=True
     )
+    order = sorted_ops[-1]
+    ks = sorted_ops[:-1]
+    rowok = ks[0] != sent  # invalid rows carry the sentinel in EVERY key
+    isnew = jnp.zeros(n, bool).at[0].set(True)
+    for k in ks:
+        isnew = isnew | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    isnew = isnew & rowok
     if not gpos:
         # SPARQL: an empty input still yields ONE group (COUNT()=0)
         isnew = isnew.at[0].set(True)
@@ -976,14 +1020,28 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
 
     group_cols = []
     gdest = jnp.where(isnew, seg, cap)
-    for g in gpos:
-        src = cols[g][order]
+    for k in ks[: len(gpos)]:
         group_cols.append(
-            jnp.zeros(cap, jnp.uint32).at[gdest].set(src, mode="drop")
+            jnp.zeros(cap, jnp.uint32).at[gdest].set(k, mode="drop")
         )
 
+    def _distinct_first(vcol):
+        """Mask (in ORIGINAL row order) of the first occurrence of each
+        (group key, value) pair — one extra sort per DISTINCT aggregate."""
+        ops = lax.sort((*keys, jnp.where(valid, vcol, sent), iota),
+                       num_keys=len(keys) + 1)
+        vs, it2 = ops[-2], ops[-1]
+        firstp = jnp.zeros(n, bool).at[0].set(True)
+        for k in ops[: len(keys)]:
+            firstp = firstp | jnp.concatenate(
+                [jnp.ones(1, bool), k[1:] != k[:-1]]
+            )
+        firstp = firstp | jnp.concatenate([jnp.ones(1, bool), vs[1:] != vs[:-1]])
+        # back to original row order
+        return jnp.zeros(n, bool).at[it2].set(firstp)
+
     agg_out = []
-    for func, ap in zip(funcs, apos):
+    for func, ap, dst_flag in zip(funcs, apos, distincts):
         if func == "COUNT" and ap < 0:
             counts = (
                 jnp.zeros(cap, jnp.float64)
@@ -993,9 +1051,25 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
             agg_out.append(counts)
             continue
         col = cols[ap][order]
+        if func == "SAMPLE":
+            # stable sort ⇒ the segment's first row is the FIRST row of the
+            # group in plan-output order (host parity: seg[0]); value is a
+            # term id, not a number.  The forced group of a no-GROUP-BY
+            # aggregate can be EMPTY — its gdest points at an invalid row,
+            # so guard with the per-group row count (host: UNBOUND=0).
+            cnt0 = (
+                jnp.zeros(cap, jnp.float64)
+                .at[segc]
+                .add(jnp.ones(n, jnp.float64), mode="drop")
+            )
+            ids = jnp.zeros(cap, jnp.uint32).at[gdest].set(col, mode="drop")
+            agg_out.append(jnp.where(cnt0 == 0, jnp.uint32(0), ids))
+            continue
         if func == "COUNT":
             ok = segc < cap
             bound = ok & (col != np.uint32(0))  # 0 = UNBOUND sentinel
+            if dst_flag:
+                bound = bound & _distinct_first(cols[ap])[order]
             agg_out.append(
                 jnp.zeros(cap, jnp.float64)
                 .at[jnp.where(bound, segc, cap)]
@@ -1038,7 +1112,7 @@ def _segment_aggregate(cols, valid, numf, gpos, funcs, apos, cap):
     return tuple(group_cols), tuple(agg_out), n_groups
 
 
-_DEVICE_AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
+_DEVICE_AGG_FUNCS = ("COUNT", "SUM", "AVG", "MIN", "MAX", "SAMPLE")
 
 
 def try_device_execute_aggregated(
@@ -1046,20 +1120,22 @@ def try_device_execute_aggregated(
 ) -> Optional[BindingTable]:
     """Plan execution + GROUP BY/aggregation entirely on device; readback is
     one row per GROUP.  ``None`` → host fallback (plan or aggregate shape
-    not expressible: >2 group vars, DISTINCT aggregates, SAMPLE,
-    GROUP_CONCAT, expression group keys).  ``lowered``: caller-supplied
-    device lowering of ``plan`` (avoids lowering the same plan twice when
-    the caller also owns the fallback path)."""
+    not expressible: GROUP_CONCAT, DISTINCT on non-COUNT aggregates,
+    expression group keys).  Any number of group variables (multi-operand
+    key sort), COUNT(DISTINCT ?v), and SAMPLE run on device.  ``lowered``:
+    caller-supplied device lowering of ``plan`` (avoids lowering the same
+    plan twice when the caller also owns the fallback path)."""
     agg_items = [i for i in q.select if i.kind == "agg"]
     if not agg_items and not q.group_by:
         return None
     if any(i.kind == "expr" for i in q.select):
         return None  # host semantics drop exprs in agg queries; stay exact
-    if len(q.group_by) > 2:
-        return None
     for item in agg_items:
         a = item.agg
-        if a.func not in _DEVICE_AGG_FUNCS or a.distinct:
+        if a.func not in _DEVICE_AGG_FUNCS:
+            return None
+        if a.distinct and a.func != "COUNT":
+            # host parity: DISTINCT only changes COUNT semantics there
             return None
     if lowered is None:
         try:
@@ -1097,6 +1173,7 @@ def try_device_execute_aggregated(
                 tuple(gpos),
                 tuple(funcs),
                 tuple(apos),
+                tuple(bool(i.agg.distinct) for i in agg_items),
                 cap,
             )
             ng = int(n_groups)
@@ -1110,8 +1187,138 @@ def try_device_execute_aggregated(
         table[g] = np.asarray(col)[:ng].astype(np.uint32)
     enc = db.dictionary.encode
     for item, arr in zip(agg_items, aggs):
-        table[item.agg.alias] = _encode_numbers(enc, np.asarray(arr)[:ng])
+        if item.agg.func == "SAMPLE":
+            # the aggregate IS a term id, not a numeric result
+            table[item.agg.alias] = np.asarray(arr)[:ng].astype(np.uint32)
+        else:
+            table[item.agg.alias] = _encode_numbers(enc, np.asarray(arr)[:ng])
     return table
+
+
+# ---------------------------------------------------------------------------
+# Device ORDER BY + LIMIT (top-k readback)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("opos", "descs", "k"))
+def _order_limit(cols, valid, numf, opos, descs, k):
+    """ORDER BY + LIMIT on device: numeric sort keys gathered from the
+    per-ID numeric table, lexsort-composed stable argsorts (host
+    ``np.lexsort`` parity), first-``k`` slice.  Readback is O(k), not
+    O(rows).  Returns ``(sliced cols, sliced valid, n_valid, nan_seen)``;
+    ``nan_seen`` means a non-numeric key value exists and the caller must
+    fall back to the host string-rank ordering."""
+    import jax.numpy as jnp
+
+    n = valid.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    nan_seen = jnp.zeros((), bool)
+    keys = []
+    for pos, desc in zip(opos, descs):
+        vals = numf[jnp.minimum(cols[pos], numf.shape[0] - 1)]
+        nan_seen = nan_seen | jnp.any(jnp.isnan(vals) & valid)
+        keys.append(-vals if desc else vals)
+    # lexsort composition: secondary keys first, primary key last, then
+    # validity as the outermost key so invalid rows sink to the end
+    for key in reversed(keys):
+        perm = perm[jnp.argsort(key[perm], stable=True)]
+    vkey = jnp.where(valid, 0, 1)
+    perm = perm[jnp.argsort(vkey[perm], stable=True)]
+    top = perm[:k]
+    out = tuple(c[top] for c in cols)
+    return out, valid[top], jnp.sum(valid), nan_seen
+
+
+def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
+    """ORDER BY + LIMIT entirely on device: plan execution, numeric-key
+    top-k sort, O(limit) readback (SURVEY §7 step 3 "ORDER BY (device
+    sort)").  ``None`` → host fallback (shape not expressible, or a sort
+    key is non-numeric — host orders those by decoded-string rank)."""
+    from kolibrie_tpu.query.ast import Var
+    from kolibrie_tpu.query.executor import (
+        _device_routed,
+        format_results,
+    )
+
+    if not _device_routed(db):
+        return None
+    if q.limit is None or not q.order_by or q.distinct or q.group_by:
+        return None
+    if any(i.kind != "var" for i in q.select) and not q.select_all():
+        return None
+    w = q.where
+    if (
+        w.subqueries
+        or w.unions
+        or w.optionals
+        or w.minus
+        or w.binds
+        or w.not_blocks
+        or not w.patterns
+    ):
+        return None
+    from kolibrie_tpu.optimizer.engine import resolve_pattern
+    from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
+
+    resolved = [resolve_pattern(db, p) for p in w.patterns]
+    try:
+        logical = build_logical_plan(resolved, list(w.filters), [], w.values)
+        plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
+        lowered = lower_plan(db, plan)
+    except Unsupported:
+        return None
+    out_vars = lowered.out_vars
+    # host parity: eval_select_to_table projects to the SELECT variables
+    # BEFORE ordering, so a sort key outside the projection is a no-op
+    # there — leave those to the host path rather than diverge
+    sel_vars = (
+        set(out_vars)
+        if q.select_all()
+        else {i.var for i in q.select if i.kind == "var"}
+    )
+    opos, descs = [], []
+    for cond in q.order_by:
+        if (
+            not isinstance(cond.expr, Var)
+            or cond.expr.name not in out_vars
+            or cond.expr.name not in sel_vars
+        ):
+            return None
+        opos.append(out_vars.index(cond.expr.name))
+        descs.append(bool(cond.descending))
+    k = _round_cap((q.offset or 0) + q.limit, 8)
+    with jax.enable_x64(True):
+        numf_dev = lowered._device_numf()
+        out_cols, valid = lowered.converge(lowered.run())
+        top_cols, top_valid, _n_valid, nan_seen = _order_limit(
+            tuple(out_cols),
+            valid,
+            numf_dev,
+            tuple(opos),
+            tuple(descs),
+            k,
+        )
+        if bool(nan_seen):
+            # non-numeric key: host string-rank ordering applies — but the
+            # device result is already converged, so reuse it instead of
+            # letting execute_select re-plan and re-execute the whole query
+            from kolibrie_tpu.query.executor import _order_table
+
+            table = lowered.to_table(out_cols, valid)
+            table = {v: table[v] for v in out_vars if v in sel_vars}
+            table = _order_table(db, table, q.order_by)
+            rows = format_results(db, table, q)
+            start = q.offset or 0
+            return rows[start : start + q.limit]
+    tv = np.asarray(top_valid)
+    table: BindingTable = {
+        v: np.asarray(c)[tv].astype(np.uint32)
+        for v, c in zip(out_vars, top_cols)
+        if v in sel_vars
+    }
+    rows = format_results(db, table, q)
+    start = q.offset or 0
+    return rows[start : start + q.limit]
 
 
 # ---------------------------------------------------------------------------

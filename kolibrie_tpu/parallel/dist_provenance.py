@@ -231,8 +231,8 @@ def _tagged_round(
             L = valid.shape[0]
             for concl in lr.concls:
                 cols = []
-                for kind, v in concl:
-                    if kind == "const":
+                for tkind, v in concl:
+                    if tkind == "const":
                         cols.append(jnp.full(L, v, dtype=jnp.uint32))
                     else:
                         cols.append(table[v])
@@ -291,9 +291,6 @@ def _tagged_round(
             .add(jnp.log1p(-st), mode="drop")
         )
         ut = -jnp.expm1(logsum)
-        import os as _os
-        if _os.environ.get("KOLIBRIE_DEBUG_DIST"):
-            _tagged_round._debug = (cs, cp, co, ct, cv, ss, sp, so, st, ut)
     else:
         ut = jnp.zeros(delta_cap, jnp.float64).at[dest].set(st, mode="drop")
     uv = jnp.arange(delta_cap) < n_uniq

@@ -488,6 +488,15 @@ def _apply_limit_offset(rows: Rows, q: SelectQuery) -> Rows:
 
 
 def execute_select(db, q: SelectQuery, use_optimizer: bool = True) -> Rows:
+    if use_optimizer and q.order_by and q.limit is not None:
+        # ORDER BY + LIMIT fused on device: top-k sort, O(limit) readback
+        from kolibrie_tpu.optimizer.device_engine import (
+            try_device_execute_ordered,
+        )
+
+        rows = try_device_execute_ordered(db, q)
+        if rows is not None:
+            return rows
     table = eval_select_to_table(db, q, use_optimizer)
     table = _order_table(db, table, q.order_by)
     rows = format_results(db, table, q)

@@ -319,8 +319,9 @@ def test_device_aggregation_fused_path_used(monkeypatch):
     assert called and called[0], "fused device aggregation did not run"
 
 
-def test_device_aggregation_distinct_falls_back():
-    """DISTINCT aggregates are host-only; results must still be exact."""
+def test_device_aggregation_count_distinct():
+    """COUNT(DISTINCT ?v) runs on device (per-(group,value) first-occurrence
+    mask via a second key sort) and must match the host path exactly."""
     db = employee_db()
     q = PREFIXES + """
     SELECT ?d (COUNT(DISTINCT ?w) AS ?n) WHERE {
@@ -328,6 +329,42 @@ def test_device_aggregation_distinct_falls_back():
     } GROUP BY ?d"""
     dev, host = run_both(db, q)
     assert sorted(dev) == sorted(host)
+
+
+def test_device_aggregation_three_group_vars():
+    """>2 group variables ride as parallel sort operands (no packed-u64
+    limit); agreement with the host path."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?d ?w ?s (COUNT(?e) AS ?n) WHERE {
+        ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w . ?e ex:salary ?s
+    } GROUP BY ?d ?w ?s"""
+    dev, host = run_both(db, q)
+    assert len(dev) > 10
+    assert sorted(dev) == sorted(host)
+
+
+def test_device_aggregation_sample():
+    """SAMPLE returns the group's first value in plan order on both paths;
+    agreement is on the (group, decoded term) pairs being a valid sample
+    (host picks its own first row, so compare against group membership)."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?d (SAMPLE(?w) AS ?any) WHERE {
+        ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w
+    } GROUP BY ?d"""
+    dev, host = run_both(db, q)
+    assert len(dev) == len(host) == 5
+    # membership check: each sampled value must belong to the group
+    members = {}
+    for row in execute_query_volcano(
+        PREFIXES
+        + "SELECT ?d ?w WHERE { ?e ex:dept ?d . ?e foaf:workplaceHomepage ?w }",
+        db,
+    ):
+        members.setdefault(row[0], set()).add(row[1])
+    for d, w in dev:
+        assert w in members[d], (d, w)
 
 
 def test_device_aggregation_infinite_literal():
@@ -349,3 +386,74 @@ def test_device_aggregation_infinite_literal():
     qmin = q.replace("MAX", "MIN")
     dev, host = run_both(db, qmin)
     assert sorted(dev) == sorted(host)
+
+
+def test_pallas_join_path_agreement(monkeypatch):
+    """Forced Pallas merge-join tile kernel (interpret mode off-TPU) must
+    agree with the host engine AND with the XLA join formulation on the
+    identical plan — the engine's production join on real TPU hardware."""
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    db = employee_db(200)
+    q = PREFIXES + """
+    SELECT ?e ?w ?s WHERE {
+        ?e foaf:workplaceHomepage ?w .
+        ?e ex:salary ?s
+    }"""
+    dev, host = run_both(db, q)
+    assert len(dev) == 200
+    assert sorted(dev) == sorted(host)
+    # filtered variant: left side arrives with validity holes
+    qf = PREFIXES + """
+    SELECT ?e ?w ?s WHERE {
+        ?e foaf:workplaceHomepage ?w .
+        ?e ex:salary ?s .
+        FILTER(?s > 45000)
+    }"""
+    dev, host = run_both(db, qf)
+    assert sorted(dev) == sorted(host)
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "0")
+    xla_rows = execute_query_volcano(qf, db)
+    assert sorted(xla_rows) == sorted(dev)
+
+
+def test_device_order_by_limit():
+    """ORDER BY numeric key + LIMIT runs the device top-k path (O(limit)
+    readback); rows agree with the host sort.  Unique keys make the
+    ordering total, so agreement is exact row-for-row."""
+    db = employee_db(97)
+    # unique salaries: i * 1000
+    db2 = SparqlDatabase()
+    lines = []
+    for i in range(97):
+        e = f"<http://example.org/e{i}>"
+        lines.append(f'{e} <http://example.org/salary> "{1000 * i}" .')
+        lines.append(f'{e} <http://example.org/dept> "dept{i % 5}" .')
+    db2.parse_ntriples("\n".join(lines))
+    db2.execution_mode = "device"
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s . ?e ex:dept ?d
+    } ORDER BY DESC(?s) LIMIT 7"""
+    dev, host = run_both(db2, q)
+    assert len(dev) == 7
+    assert dev == host
+    # ascending + offset
+    q2 = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s . ?e ex:dept ?d
+    } ORDER BY ?s LIMIT 5 OFFSET 3"""
+    dev2, host2 = run_both(db2, q2)
+    assert dev2 == host2
+    assert len(dev2) == 5
+
+
+def test_device_order_by_string_key_falls_back():
+    """A non-numeric sort key must take the host string-rank path and stay
+    exact."""
+    db = employee_db(60)
+    q = PREFIXES + """
+    SELECT ?e ?d WHERE {
+        ?e ex:dept ?d . ?e ex:salary ?s
+    } ORDER BY ?d ?e LIMIT 9"""
+    dev, host = run_both(db, q)
+    assert dev == host
