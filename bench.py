@@ -205,7 +205,8 @@ def main():
 # forced-CPU so ONE parseable JSON line is always printed.
 # ---------------------------------------------------------------------------
 
-ATTEMPT_TIMEOUT_S = 900
+ATTEMPT_TIMEOUT_S = 1500  # one TPU attempt ≈ 10-15 min (4 compiled
+#                           variants + 3 timed dispatch loops with gaps)
 BACKOFFS_S = (5, 20, 45)  # sleeps between the TPU attempts
 
 

@@ -122,6 +122,41 @@ class MLHandler:
             self.metadata[meta.name] = meta
         return [best.name]
 
+    def generate_ml_models(
+        self, directory: str, timeout: float = 300.0
+    ) -> List[str]:
+        """Run the directory's predictor scripts so they (re)generate their
+        pickled models and MLSchema TTL sidecars.
+
+        Parity: ``ml/src/lib.rs:415-489`` (``generate_ml_models`` runs
+        ``predictor.py`` through the embedded Python interpreter).  Here
+        each ``*predictor*.py`` script runs as a subprocess with the
+        directory as cwd, so artifacts land beside their generator.
+        Returns the model names available afterwards (``*_predictor.pkl``
+        stems); raises on a failing script.
+        """
+        import subprocess
+        import sys
+
+        scripts = sorted(glob.glob(os.path.join(directory, "*predictor*.py")))
+        for script in scripts:
+            proc = subprocess.run(
+                [sys.executable, script],
+                cwd=directory,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"predictor script {script} failed "
+                    f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+                )
+        return sorted(
+            os.path.basename(p)[: -len("_predictor.pkl")]
+            for p in glob.glob(os.path.join(directory, "*_predictor.pkl"))
+        )
+
     def load_model(self, name: str, path: str) -> None:
         with open(path, "rb") as f:
             self.models[name] = pickle.load(f)

@@ -36,6 +36,14 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kn_sdd_set_weight": ([ptr, i64, f64, f64], None),
         "kn_sdd_literal": ([ptr, i64, c.c_int], i64),
         "kn_sdd_apply": ([ptr, i64, i64, c.c_int], i64),
+        "kn_sdd_apply_batch": (
+            [ptr, c.POINTER(i64), c.POINTER(i64), i64, c.c_int, c.POINTER(i64)],
+            None,
+        ),
+        "kn_sdd_reduce_groups": (
+            [ptr, c.POINTER(i64), c.POINTER(i64), i64, c.c_int, c.POINTER(i64)],
+            None,
+        ),
         "kn_sdd_negate": ([ptr, i64], i64),
         "kn_sdd_exactly_one": ([ptr, c.POINTER(i64), i64], i64),
         "kn_sdd_wmc": ([ptr, i64], f64),
@@ -53,6 +61,17 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kn_nt_ids": ([ptr, c.POINTER(c.c_uint32)], None),
         "kn_nt_terms": ([ptr, c.c_char_p, c.POINTER(i64)], None),
         "kn_nt_free": ([ptr], None),
+        "kn_ttl_parse_mt": (
+            [c.c_char_p, i64, c.c_int, c.c_char_p, i64, c.POINTER(ptr)],
+            i64,
+        ),
+        "kn_ttl_nterms": ([ptr], i64),
+        "kn_ttl_term_bytes": ([ptr], i64),
+        "kn_ttl_ids": ([ptr, c.POINTER(c.c_uint32)], None),
+        "kn_ttl_terms": ([ptr, c.c_char_p, c.POINTER(i64)], None),
+        "kn_ttl_prefixes_len": ([ptr], i64),
+        "kn_ttl_prefixes": ([ptr, c.c_char_p], None),
+        "kn_ttl_free": ([ptr], None),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

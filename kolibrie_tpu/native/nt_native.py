@@ -26,6 +26,62 @@ _utf8_and_size.argtypes = [ctypes.py_object, ctypes.POINTER(ctypes.c_ssize_t)]
 _utf8_and_size.restype = ctypes.c_void_p
 
 
+def input_view(data: str):
+    """``(raw, raw_len)`` UTF-8 view of ``data`` for a tokenizer call.
+
+    ASCII strings hand the tokenizer the str's OWN cached UTF-8 buffer
+    (zero copy; see module doc); non-ASCII pays one encode (AsUTF8 would
+    set a pending exception on lone surrogates, which a ctypes call cannot
+    surface safely).  The caller must keep ``data`` alive for the call.
+    """
+    if data.isascii():  # O(1) flag check; zero-copy path cannot fail
+        size = ctypes.c_ssize_t()
+        addr = _utf8_and_size(data, ctypes.byref(size))  # borrowed from data
+        return ctypes.cast(addr, ctypes.c_char_p), size.value
+    buf = data.encode("utf-8")
+    return buf, len(buf)
+
+
+def read_session_terms(lib, session, n: int, fns: tuple):
+    """Read back a parse session's ``(ids, terms)``; None on an
+    undecodable term blob (out-of-range escape — Python parser decides).
+
+    ``fns``: the session's accessor names ``(ids, nterms, term_bytes,
+    terms)`` — shared by the N-Triples and Turtle sessions, whose layouts
+    are identical.
+    """
+    f_ids, f_nterms, f_bytes, f_terms = (getattr(lib, f) for f in fns)
+    ids = np.empty(n * 3, dtype=np.uint32)
+    if n:
+        f_ids(session, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    n_terms = int(f_nterms(session))
+    nbytes = int(f_bytes(session))
+    buf = ctypes.create_string_buffer(nbytes)
+    offsets = (ctypes.c_int64 * (n_terms + 1))()
+    f_terms(session, buf, offsets)
+    blob = buf.raw
+    try:
+        if blob.isascii():
+            # one whole-blob decode, then per-term str slicing — byte
+            # offsets equal codepoint offsets for pure-ASCII data, which
+            # is the common case for dictionary-encoded RDF terms
+            text = blob.decode("ascii")
+            offs = offsets[:]
+            terms = [text[offs[i]: offs[i + 1]] for i in range(n_terms)]
+        else:
+            # surrogatepass: lone-surrogate \uXXXX escapes decode to the
+            # same string the Python parser's chr() produces
+            terms = [
+                blob[offsets[i]: offsets[i + 1]].decode(
+                    "utf-8", "surrogatepass"
+                )
+                for i in range(n_terms)
+            ]
+    except UnicodeDecodeError:
+        return None
+    return ids.reshape(n, 3), terms
+
+
 def bulk_parse_ntriples(data: str, nthreads: int = 0) -> Optional[tuple]:
     """Parse a plain N-Triples document natively.
 
@@ -38,52 +94,17 @@ def bulk_parse_ntriples(data: str, nthreads: int = 0) -> Optional[tuple]:
     lib = load()
     if lib is None:
         return None
-    if data.isascii():  # O(1) flag check; zero-copy path cannot fail
-        size = ctypes.c_ssize_t()
-        addr = _utf8_and_size(data, ctypes.byref(size))  # borrowed from data
-        raw, raw_len = ctypes.cast(addr, ctypes.c_char_p), size.value
-    else:
-        # non-ASCII: pay the copy (AsUTF8 would set a pending exception on
-        # lone surrogates, which a ctypes call cannot surface safely)
-        buf = data.encode("utf-8")
-        raw, raw_len = buf, len(buf)
+    raw, raw_len = input_view(data)
     session = ctypes.c_void_p()
     n = int(lib.kn_nt_parse_mt(raw, raw_len, nthreads, ctypes.byref(session)))
     if n < 0:
         return None  # -1 syntax error / -2 unsupported: Python decides
     try:
-        ids = np.empty(n * 3, dtype=np.uint32)
-        if n:
-            lib.kn_nt_ids(
-                session, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
-            )
-        n_terms = int(lib.kn_nt_nterms(session))
-        nbytes = int(lib.kn_nt_term_bytes(session))
-        buf = ctypes.create_string_buffer(nbytes)
-        offsets = (ctypes.c_int64 * (n_terms + 1))()
-        lib.kn_nt_terms(session, buf, offsets)
-        blob = buf.raw
-        try:
-            if blob.isascii():
-                # one whole-blob decode, then per-term str slicing — byte
-                # offsets equal codepoint offsets for pure-ASCII data, which
-                # is the common case for dictionary-encoded RDF terms
-                text = blob.decode("ascii")
-                offs = offsets[:]
-                terms = [
-                    text[offs[i]: offs[i + 1]] for i in range(n_terms)
-                ]
-            else:
-                # surrogatepass: lone-surrogate \uXXXX escapes decode to the
-                # same string the Python parser's chr() produces
-                terms = [
-                    blob[offsets[i]: offsets[i + 1]].decode(
-                        "utf-8", "surrogatepass"
-                    )
-                    for i in range(n_terms)
-                ]
-        except UnicodeDecodeError:
-            return None  # out-of-range escape: let the Python parser decide
+        return read_session_terms(
+            lib,
+            session,
+            n,
+            ("kn_nt_ids", "kn_nt_nterms", "kn_nt_term_bytes", "kn_nt_terms"),
+        )
     finally:
         lib.kn_nt_free(session)
-    return ids.reshape(n, 3), terms

@@ -82,6 +82,48 @@ class NativeSddManager:
     def negate(self, a: int) -> int:
         return int(self._lib.kn_sdd_negate(self._h, a))
 
+    # ------------------------------------------------------- batched algebra
+
+    def apply_batch(self, a, b, op: str):
+        """Element-wise ``apply`` over two int64 node-id arrays — ONE
+        library crossing for a whole derivation column (the reasoner's
+        batched SDD round; per-call ctypes overhead otherwise dominates)."""
+        import numpy as np
+
+        a = np.ascontiguousarray(a, dtype=np.int64)
+        b = np.ascontiguousarray(b, dtype=np.int64)
+        out = np.empty(len(a), dtype=np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        self._lib.kn_sdd_apply_batch(
+            self._h,
+            a.ctypes.data_as(i64p),
+            b.ctypes.data_as(i64p),
+            len(a),
+            _OPS[op],
+            out.ctypes.data_as(i64p),
+        )
+        return out
+
+    def reduce_groups(self, tags, group_ids, n_groups: int, op: str):
+        """Segmented fold of node ids per group id (row order), starting
+        from the fold identity.  Returns int64 array of length n_groups."""
+        import numpy as np
+
+        tags = np.ascontiguousarray(tags, dtype=np.int64)
+        gids = np.ascontiguousarray(group_ids, dtype=np.int64)
+        identity = 1 if op == "and" else 0  # TRUE / FALSE node ids
+        out = np.full(n_groups, identity, dtype=np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        self._lib.kn_sdd_reduce_groups(
+            self._h,
+            tags.ctypes.data_as(i64p),
+            gids.ctypes.data_as(i64p),
+            len(tags),
+            _OPS[op],
+            out.ctypes.data_as(i64p),
+        )
+        return out
+
     def exactly_one(self, var_indices: List[int]) -> int:
         n = len(var_indices)
         arr = (ctypes.c_int64 * n)(*var_indices)

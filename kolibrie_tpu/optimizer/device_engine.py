@@ -579,21 +579,7 @@ class LoweredPlan:
     # ---------------------------------------------------------- filter lowering
 
     def _compute_mask(self, op: str, const: float) -> np.ndarray:
-        vals = self.db.numeric_values()
-        with np.errstate(invalid="ignore"):
-            if op == "=":
-                m = vals == const
-            elif op == "!=":
-                m = vals != const
-            elif op == "<":
-                m = vals < const
-            elif op == "<=":
-                m = vals <= const
-            elif op == ">":
-                m = vals > const
-            else:
-                m = vals >= const
-        return m & ~np.isnan(vals)
+        return numeric_filter_mask(self.db.numeric_values(), op, const)
 
     def _numeric_mask(self, op: str, const: float, flip: bool) -> MaskRef:
         """Host-precomputed per-ID mask for ``var op const`` (exact f64)."""
@@ -959,6 +945,27 @@ class LoweredPlan:
         return self.to_table(*self.converge(self.run()))
 
 
+def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:
+    """Per-ID boolean mask for ``term op const`` over the database's
+    numeric-literal table (NaN = non-numeric, always excluded).  The ONE
+    definition of numeric-filter semantics shared by the single-chip plan
+    lowering and the distributed query executor."""
+    with np.errstate(invalid="ignore"):
+        if op == "=":
+            m = vals == const
+        elif op == "!=":
+            m = vals != const
+        elif op == "<":
+            m = vals < const
+        elif op == "<=":
+            m = vals <= const
+        elif op == ">":
+            m = vals > const
+        else:
+            m = vals >= const
+    return m & ~np.isnan(vals)
+
+
 def lower_plan(db, plan) -> LoweredPlan:
     return LoweredPlan(db, plan)
 
@@ -1257,6 +1264,30 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
         or not w.patterns
     ):
         return None
+    # cheap shape checks BEFORE any planning (a rejected query would
+    # otherwise pay the optimizer + lowering twice: here and again on the
+    # host fallback).  Host parity: eval_select_to_table projects to the
+    # SELECT variables BEFORE ordering, so a sort key outside the
+    # projection is a no-op there — leave those to the host path.
+    pattern_vars = {
+        t.value
+        for p in w.patterns
+        for t in (p.subject, p.predicate, p.object)
+        if t.kind == "var"
+    }
+    sel_vars = (
+        pattern_vars
+        if q.select_all()
+        else {i.var for i in q.select if i.kind == "var"}
+    )
+    for cond in q.order_by:
+        if (
+            not isinstance(cond.expr, Var)
+            or cond.expr.name not in pattern_vars
+            or cond.expr.name not in sel_vars
+        ):
+            return None
+
     from kolibrie_tpu.optimizer.engine import resolve_pattern
     from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
 
@@ -1268,21 +1299,9 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
     except Unsupported:
         return None
     out_vars = lowered.out_vars
-    # host parity: eval_select_to_table projects to the SELECT variables
-    # BEFORE ordering, so a sort key outside the projection is a no-op
-    # there — leave those to the host path rather than diverge
-    sel_vars = (
-        set(out_vars)
-        if q.select_all()
-        else {i.var for i in q.select if i.kind == "var"}
-    )
     opos, descs = [], []
     for cond in q.order_by:
-        if (
-            not isinstance(cond.expr, Var)
-            or cond.expr.name not in out_vars
-            or cond.expr.name not in sel_vars
-        ):
+        if cond.expr.name not in out_vars:
             return None
         opos.append(out_vars.index(cond.expr.name))
         descs.append(bool(cond.descending))

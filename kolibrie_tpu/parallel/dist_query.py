@@ -148,28 +148,14 @@ def _lower_query_filters(
 
 
 def _materialize_masks(db, exprs: Tuple[tuple, ...]) -> List[np.ndarray]:
-    """Per-ID boolean masks from the db's numeric-literal table (the same
-    VPU gather-and-compare design as the engine's mask bank)."""
+    """Per-ID boolean masks from the db's numeric-literal table — the SAME
+    semantics as the single-chip engine (one shared definition)."""
     if not exprs:
         return []
+    from kolibrie_tpu.optimizer.device_engine import numeric_filter_mask
+
     vals = db.numeric_values()
-    out = []
-    with np.errstate(invalid="ignore"):
-        for op, const in exprs:
-            if op == "=":
-                m = vals == const
-            elif op == "!=":
-                m = vals != const
-            elif op == "<":
-                m = vals < const
-            elif op == "<=":
-                m = vals <= const
-            elif op == ">":
-                m = vals > const
-            else:
-                m = vals >= const
-            out.append(m & ~np.isnan(vals))
-    return out
+    return [numeric_filter_mask(vals, op, const) for op, const in exprs]
 
 
 # ---------------------------------------------------------------------------

@@ -137,9 +137,32 @@ class SparqlDatabase:
         return n
 
     def parse_turtle(self, data: str) -> int:
+        native = self._parse_turtle_native(data)
+        if native is not None:
+            return native
         triples, prefixes = rdf_parsers.parse_turtle(data, self.prefixes)
         self.prefixes.update(prefixes)
         return self._ingest(triples)
+
+    def _parse_turtle_native(self, data: str) -> Optional[int]:
+        """Bulk fast path: chunk-parallel C++ Turtle tokenizer + unique-term
+        interning (see :mod:`kolibrie_tpu.native.ttl_native`).  Returns None
+        (fall back) for Turtle-star / ``[]`` / ``()`` / multiline strings /
+        ``@base`` or if native is off."""
+        try:
+            from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+        except ImportError:
+            return None
+        result = bulk_parse_turtle(data, self.prefixes)
+        if result is None:
+            return None
+        ids, terms, prefixes_out = result
+        self.prefixes.update(prefixes_out)
+        remap = np.empty(len(terms) + 1, dtype=np.uint32)
+        remap[1:] = self.dictionary.encode_batch(terms)
+        cols = remap[ids]
+        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
+        return int(ids.shape[0])
 
     def parse_n3(self, data: str) -> int:
         triples, prefixes = rdf_parsers.parse_n3(data, self.prefixes)
@@ -169,6 +192,78 @@ class SparqlDatabase:
         cols = remap[ids]
         self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
         return int(ids.shape[0])
+
+    # ------------------------------------------------- preemption/restart
+
+    def checkpoint(self, path: str) -> None:
+        """One-file durable snapshot of the DATA state (docs/PREEMPTION.md):
+        triple columns, dictionary, quoted-triple table, prefixes, and
+        probability seeds.  Rules, UDFs, neural registries, and device
+        residency are CONFIGURATION/derived state — re-registered by the
+        application and lazily rebuilt from the restored columns.  The
+        reference keeps everything in memory with no snapshot at all
+        (SURVEY §5 "checkpoint/resume: none")."""
+        import pickle
+
+        s, p, o = self.store.columns()
+        seeds = self.probability_seeds
+        # write through a file object: np.savez_compressed appends ".npz"
+        # to bare string paths, which would break same-path restore
+        with open(path, "wb") as fh:
+            self._checkpoint_to(fh, s, p, o, seeds)
+
+    def _checkpoint_to(self, fh, s, p, o, seeds) -> None:
+        import pickle
+
+        np.savez_compressed(
+            fh,
+            s=s,
+            p=p,
+            o=o,
+            terms=np.frombuffer(
+                pickle.dumps(self.dictionary.id_to_str), dtype=np.uint8
+            ),
+            quoted=np.asarray(
+                [
+                    (qid, t[0], t[1], t[2])
+                    for qid, t in sorted(self.quoted.items())
+                ],
+                dtype=np.uint64,
+            ).reshape(-1, 4),
+            prefixes=np.frombuffer(pickle.dumps(self.prefixes), dtype=np.uint8),
+            seeds=np.asarray(
+                [(k[0], k[1], k[2], v) for k, v in sorted(seeds.items())],
+                dtype=np.float64,
+            ).reshape(-1, 4),
+        )
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "SparqlDatabase":
+        """Rebuild a database from :meth:`checkpoint` output; indexes and
+        device copies are rebuilt lazily on first use."""
+        import pickle
+
+        data = np.load(path, allow_pickle=False)
+        db = cls()
+        db.store.add_batch(
+            data["s"].astype(np.uint32),
+            data["p"].astype(np.uint32),
+            data["o"].astype(np.uint32),
+        )
+        id_to_str = pickle.loads(data["terms"].tobytes())
+        db.dictionary.id_to_str = id_to_str
+        db.dictionary.str_to_id = {
+            t: i for i, t in enumerate(id_to_str) if t is not None
+        }
+        db.dictionary._next_id = len(id_to_str)
+        for qid, s_, p_, o_ in data["quoted"].astype(np.uint64).tolist():
+            key = (int(s_), int(p_), int(o_))
+            db.quoted.triple_to_id[key] = int(qid)
+            db.quoted.id_to_triple[int(qid)] = key
+        db.prefixes = pickle.loads(data["prefixes"].tobytes())
+        for s_, p_, o_, prob in data["seeds"].tolist():
+            db.probability_seeds[(int(s_), int(p_), int(o_))] = float(prob)
+        return db
 
     # --------------------------------------------------- whole-database ops
 

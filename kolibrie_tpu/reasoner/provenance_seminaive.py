@@ -187,6 +187,52 @@ def infer_with_provenance(
     return tag_store
 
 
+def _sdd_batched_derive(
+    mgr, tag_store, prem_rows, concl_rows, n: int
+) -> Dict[TripleKey, object]:
+    """One rule's derivations through the native SDD manager in BATCH:
+    per-premise tag columns folded with one ``apply_batch`` per premise
+    position (⊗ chain), zero-tag pruning as a mask, and one
+    ``reduce_groups`` per conclusion pattern (⊕ per unique conclusion key,
+    in row order — identical fold order to the per-row loop).
+
+    SURVEY §7 "hard parts": the SDD boundary design — batch tags per
+    derivation round between the device/columnar join side and the host
+    SDD manager; replaces the per-row ctypes crossings that dominated
+    structural-semiring closures (reasoner as of round 2:
+    provenance_seminaive.py:190-326).
+    """
+    from kolibrie_tpu.reasoner.sdd import FALSE, TRUE
+
+    tags = tag_store.tags
+    tag_col = None
+    for pr in prem_rows:
+        col = np.fromiter(
+            (tags.get(k, TRUE) for k in pr), dtype=np.int64, count=n
+        )
+        tag_col = (
+            col if tag_col is None else mgr.apply_batch(tag_col, col, "and")
+        )
+    if tag_col is None:  # no premises: cannot happen (rules require ≥1)
+        return {}
+    keep = tag_col != FALSE  # zero-tag pruning (:171)
+    acc: Dict[TripleKey, object] = {}
+    if not keep.any():
+        return acc
+    kept_tags = tag_col[keep]
+    for cr in concl_rows:
+        if cr is None:
+            continue
+        arr = np.asarray(cr, dtype=np.uint32)[keep]
+        uniq, inv = np.unique(arr, axis=0, return_inverse=True)
+        red = mgr.reduce_groups(kept_tags, inv, len(uniq), "or")
+        for row, tag in zip(uniq.tolist(), red.tolist()):
+            ckey = tuple(row)
+            prev = acc.get(ckey)
+            acc[ckey] = int(tag) if prev is None else mgr.disjoin(prev, int(tag))
+    return acc
+
+
 def _positive_fixpoint(
     reasoner,
     provenance,
@@ -280,21 +326,35 @@ def _positive_fixpoint(
             # Pre-aggregate this round's derivations per conclusion key
             # (⊕ is associative and saturate() is the identity for every
             # semiring, so one final update_disjunction per key is exact).
-            acc: Dict[TripleKey, object] = {}
-            for i in range(n):
-                tag = one
-                for pr in prem_rows:
-                    ptag = tags_get(pr[i])
-                    if ptag is not None:
-                        tag = conj(tag, ptag)
-                if is_zero(tag):
-                    continue  # zero-tag pruning (:171)
-                for cr in concl_rows:
-                    if cr is None:
-                        continue
-                    ckey = cr[i]
-                    prev = acc.get(ckey)
-                    acc[ckey] = tag if prev is None else disj(prev, tag)
+            mgr = getattr(provenance, "manager", None)
+            if (
+                getattr(provenance, "name", "") == "sdd"
+                and mgr is not None
+                and hasattr(mgr, "apply_batch")
+                and n >= 32
+            ):
+                # batched SDD round: whole derivation columns cross into the
+                # native manager ONCE per premise (chained ⊗) and once per
+                # conclusion (segment ⊕) instead of one ctypes call per row
+                acc = _sdd_batched_derive(
+                    mgr, tag_store, prem_rows, concl_rows, n
+                )
+            else:
+                acc: Dict[TripleKey, object] = {}
+                for i in range(n):
+                    tag = one
+                    for pr in prem_rows:
+                        ptag = tags_get(pr[i])
+                        if ptag is not None:
+                            tag = conj(tag, ptag)
+                    if is_zero(tag):
+                        continue  # zero-tag pruning (:171)
+                    for cr in concl_rows:
+                        if cr is None:
+                            continue
+                        ckey = cr[i]
+                        prev = acc.get(ckey)
+                        acc[ckey] = tag if prev is None else disj(prev, tag)
             for ckey, tag in acc.items():
                 if base_keys is None:
                     # committed facts (base + prior rounds) live in the store

@@ -521,6 +521,90 @@ class RSPEngine:
             materialized[cfg.window_iri] = [dict(zip(header, row)) for row in rows]
         self._emit(materialized, ts)
 
+    # -------------------------------------------------- preemption/restart
+
+    def checkpoint_state(self) -> bytes:
+        """Serialize the engine's RESUMABLE state (docs/PREEMPTION.md).
+
+        Captured: per-window S2R operator state (t_0, app_time, open-window
+        contents), the R2S stream-operator memory (``last_result`` — what
+        ISTREAM/DSTREAM diff against), the cross-window SDS+ expiry state,
+        and the coordinator's latest raw window contents.  NOT captured
+        (configuration, re-supplied when the engine is rebuilt from its
+        RSPBuilder/config): queries, rules, static data, sync policy, and
+        the R2R store — window materializations are recomputed at the next
+        firing from the restored window contents.
+
+        The reference has no checkpoint story at all (SURVEY §5 "none");
+        this is the rebuild's decision: host-side state is the single
+        source of truth, device/state derived from it is reconstructible,
+        and delivery across a preemption boundary is at-least-once (a
+        firing in flight at snapshot time is re-emitted after restore —
+        RSTREAM re-emission is idempotent for consumers keyed on window
+        close time; ISTREAM/DSTREAM diffs stay exact because
+        ``last_result`` is part of the snapshot).
+        """
+        import pickle
+
+        with self._cw_lock:
+            state = {
+                "version": 1,
+                "windows": [
+                    {
+                        "t_0": r.window.t_0,
+                        "app_time": r.window.app_time,
+                        "active": [
+                            (
+                                w.open,
+                                w.close,
+                                dict(c.elements),
+                                c.last_timestamp_changed,
+                                c.origin,
+                            )
+                            for w, c in r.window.active_windows.items()
+                        ],
+                    }
+                    for r in self.windows
+                ],
+                "r2s_last": set(self.r2s.last_result),
+                "sds_plus": dict(self._sds_plus_state),
+                "latest_contents": {
+                    k: list(v) for k, v in self._latest_contents.items()
+                },
+            }
+        return pickle.dumps(state)
+
+    def restore_state(self, blob: bytes) -> None:
+        """Restore a :meth:`checkpoint_state` snapshot into THIS engine
+        (built with the same window configs / queries / rules).  Events
+        added afterwards continue the stream exactly where the snapshot
+        left off."""
+        import pickle
+
+        from kolibrie_tpu.rsp.s2r import Window
+
+        state = pickle.loads(blob)
+        if state.get("version") != 1:
+            raise ValueError(f"unknown checkpoint version {state.get('version')!r}")
+        if len(state["windows"]) != len(self.windows):
+            raise ValueError("checkpoint window count != engine window count")
+        with self._cw_lock:
+            for r, ws in zip(self.windows, state["windows"]):
+                win = r.window
+                win.t_0 = ws["t_0"]
+                win.app_time = ws["app_time"]
+                win.active_windows = {}
+                for open_, close, elements, last_ts, origin in ws["active"]:
+                    c = ContentContainer(origin)
+                    c.elements = dict(elements)
+                    c.last_timestamp_changed = last_ts
+                    win.active_windows[Window(open_, close)] = c
+            self.r2s.last_result = set(state["r2s_last"])
+            self._sds_plus_state = dict(state["sds_plus"])
+            self._latest_contents = {
+                k: list(v) for k, v in state["latest_contents"].items()
+            }
+
     # ----------------------------------------------------------------- misc
 
     def stop(self) -> None:

@@ -557,6 +557,458 @@ int nt_parse_mt_impl(const char *data, int64_t len, int nthreads,
   return 0;
 }
 
+// ───────────────────────── Turtle fast path ─────────────────────────────
+//
+// Native tokenizer for the common bulk-load subset of Turtle: @prefix /
+// PREFIX directives, IRIs, prefixed names, 'a', literals (escapes, @lang,
+// ^^<iri> and ^^pname datatypes), numeric/boolean shorthand, blank-node
+// labels, and ';' / ',' predicate/object lists.  Stored term forms match
+// kolibrie_tpu/query/rdf_parsers.py exactly (IRIs expanded and
+// unbracketed; literals keep quotes + suffix with the datatype IRI
+// expanded; numbers/booleans become "<text>"^^xsd:<type>).
+//
+// Returns -2 (Python fallback) for everything else: RDF-star '<<',
+// anonymous/blank property lists '[', collections '(', single-quoted and
+// multiline strings, @base/BASE.  Mirrors the reference's streamed chunked
+// Turtle ingestion (sparql_database.rs:729 + the crossbeam pipeline at
+// :401-571) as a thread-chunked parse with dictionary merge.
+
+struct TtlPrefixEnv {
+  std::unordered_map<std::string, std::string> map;
+  bool frozen = false;  // MT chunk mode: directives may not ADD or CHANGE
+};
+
+inline bool ttl_is_ws(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\n';
+}
+
+inline bool ttl_pname_prefix_char(char c) {
+  return isalnum((unsigned char)c) || c == '_' || c == '.' || c == '-';
+}
+
+inline bool ttl_pname_local_char(char c) {
+  return isalnum((unsigned char)c) || c == '_' || c == '.' || c == '%' ||
+         c == '-';
+}
+
+// Skip whitespace and comments; returns index of next significant byte.
+inline int64_t ttl_skip(const char *data, int64_t len, int64_t i) {
+  while (i < len) {
+    char c = data[i];
+    if (ttl_is_ws(c)) { i++; continue; }
+    if (c == '#') {
+      while (i < len && data[i] != '\n') i++;
+      continue;
+    }
+    break;
+  }
+  return i;
+}
+
+// Parse one term starting at data[i]; interns the stored form into `out`
+// and advances i.  `pos` 0/1/2 = subject/predicate/object.  Returns 0 ok,
+// -1 syntax error, -2 unsupported construct.
+int ttl_term(const char *data, int64_t len, int64_t &i, int pos,
+             const TtlPrefixEnv &env, NtSession &out, std::string &scratch,
+             uint32_t &id_out) {
+  char c = data[i];
+  if (c == '<') {
+    if (i + 1 < len && data[i + 1] == '<') return -2;  // Turtle-star
+    int64_t j = i + 1;
+    while (j < len && data[j] != '>') {
+      if (data[j] == '\n') return -1;
+      j++;
+    }
+    if (j >= len) return -1;
+    id_out = out.intern_view(std::string_view(data + i + 1, (size_t)(j - i - 1)));
+    i = j + 1;
+    return 0;
+  }
+  if (c == '_') {
+    if (i + 1 >= len || data[i + 1] != ':') return -1;
+    int64_t j = i + 2;
+    while (j < len && ttl_pname_prefix_char(data[j])) j++;
+    while (j > i + 2 && data[j - 1] == '.') j--;  // trailing '.' = terminator
+    id_out = out.intern_view(std::string_view(data + i, (size_t)(j - i)));
+    i = j;
+    return 0;
+  }
+  if (c == '"') {
+    if (i + 2 < len && data[i + 1] == '"' && data[i + 2] == '"') {
+      return -2;  // multiline string: Python handles
+    }
+    int64_t j = i + 1;
+    bool escaped = false;
+    while (j < len) {
+      if (data[j] == '\\') { escaped = true; j += 2; continue; }
+      if (data[j] == '"') break;
+      if (data[j] == '\n') return -1;  // raw newline illegal in '"' string
+      j++;
+    }
+    if (j >= len) return -1;
+    int64_t body_start = i, body_end = j + 1;
+    i = j + 1;
+    if (i + 1 < len && data[i] == '^' && data[i + 1] == '^') {
+      i += 2;
+      scratch.clear();
+      scratch.push_back('"');
+      if (!append_unescaped(data + body_start + 1, body_end - body_start - 2,
+                            scratch)) {
+        return -1;
+      }
+      scratch.push_back('"');
+      scratch.append("^^");
+      if (i < len && data[i] == '<') {
+        int64_t k = i + 1;
+        while (k < len && data[k] != '>') k++;
+        if (k >= len) return -1;
+        scratch.append(data + i + 1, (size_t)(k - i - 1));
+        i = k + 1;
+      } else {
+        // prefixed datatype
+        int64_t k = i;
+        while (k < len && data[k] != ':' && ttl_pname_prefix_char(data[k])) k++;
+        if (k >= len || data[k] != ':') return -1;
+        std::string pfx(data + i, (size_t)(k - i));
+        auto it = env.map.find(pfx);
+        if (it == env.map.end()) return -1;
+        int64_t m = k + 1;
+        while (m < len && ttl_pname_local_char(data[m])) m++;
+        while (m > k + 1 && data[m - 1] == '.') m--;
+        scratch.append(it->second);
+        scratch.append(data + k + 1, (size_t)(m - k - 1));
+        i = m;
+      }
+      id_out = out.intern_view(std::string_view(scratch));
+      return 0;
+    }
+    int64_t end = body_end;
+    if (i < len && data[i] == '@') {
+      int64_t k = i + 1;
+      while (k < len && (isalnum((unsigned char)data[k]) || data[k] == '-')) k++;
+      end = k;
+      i = k;
+    }
+    if (!escaped) {
+      id_out = out.intern_view(
+          std::string_view(data + body_start, (size_t)(end - body_start)));
+    } else {
+      scratch.clear();
+      scratch.push_back('"');
+      if (!append_unescaped(data + body_start + 1, body_end - body_start - 2,
+                            scratch)) {
+        return -1;
+      }
+      scratch.push_back('"');
+      scratch.append(data + body_end, (size_t)(end - body_end));
+      id_out = out.intern_view(std::string_view(scratch));
+    }
+    return 0;
+  }
+  if (c == '\'') return -2;  // single-quoted string: Python handles
+  if (c == '[' || c == '(') return -2;  // bnode property list / collection
+  if (c == '+' || c == '-' || isdigit((unsigned char)c)) {
+    int64_t j = i;
+    if (data[j] == '+' || data[j] == '-') j++;
+    int64_t digits_start = j;
+    while (j < len && isdigit((unsigned char)data[j])) j++;
+    if (j == digits_start) return -1;
+    bool is_decimal = false, is_double = false;
+    if (j + 1 < len && data[j] == '.' && isdigit((unsigned char)data[j + 1])) {
+      is_decimal = true;
+      j++;
+      while (j < len && isdigit((unsigned char)data[j])) j++;
+    }
+    if (j < len && (data[j] == 'e' || data[j] == 'E')) {
+      int64_t k = j + 1;
+      if (k < len && (data[k] == '+' || data[k] == '-')) k++;
+      if (k < len && isdigit((unsigned char)data[k])) {
+        is_double = true;
+        j = k;
+        while (j < len && isdigit((unsigned char)data[j])) j++;
+      }
+    }
+    scratch.clear();
+    scratch.push_back('"');
+    scratch.append(data + i, (size_t)(j - i));
+    scratch.append("\"^^http://www.w3.org/2001/XMLSchema#");
+    scratch.append(is_double ? "double" : is_decimal ? "decimal" : "integer");
+    id_out = out.intern_view(std::string_view(scratch));
+    i = j;
+    return 0;
+  }
+  if (isalpha((unsigned char)c) || c == ':') {
+    // pname, 'a', true/false — scan prefix part up to ':'
+    int64_t j = i;
+    while (j < len && data[j] != ':' && ttl_pname_prefix_char(data[j])) j++;
+    if (j < len && data[j] == ':') {
+      std::string pfx(data + i, (size_t)(j - i));
+      auto it = env.map.find(pfx);
+      if (it == env.map.end()) return -1;  // undefined / not-yet-seen prefix
+      int64_t m = j + 1;
+      while (m < len && ttl_pname_local_char(data[m])) m++;
+      while (m > j + 1 && data[m - 1] == '.') m--;
+      scratch.clear();
+      scratch.append(it->second);
+      scratch.append(data + j + 1, (size_t)(m - j - 1));
+      id_out = out.intern_view(std::string_view(scratch));
+      i = m;
+      return 0;
+    }
+    std::string_view word(data + i, (size_t)(j - i));
+    if (pos == 1 && word == "a") {
+      id_out = out.intern_view(
+          "http://www.w3.org/1999/02/22-rdf-syntax-ns#type");
+      i = j;
+      return 0;
+    }
+    if (pos == 2 && (word == "true" || word == "false")) {
+      scratch.clear();
+      scratch.push_back('"');
+      scratch.append(word);
+      scratch.append("\"^^http://www.w3.org/2001/XMLSchema#boolean");
+      id_out = out.intern_view(std::string_view(scratch));
+      i = j;
+      return 0;
+    }
+    return -2;  // bare keyword (BASE, GRAPH, ...) — Python decides
+  }
+  return -1;
+}
+
+// Parse an @prefix / PREFIX directive starting at data[i] (i is at the
+// keyword).  Applies it to env (or verifies consistency when frozen).
+// Returns 0 ok, -1 error or frozen-mode mismatch, 1 = not a directive.
+int ttl_directive(const char *data, int64_t len, int64_t &i,
+                  TtlPrefixEnv &env) {
+  auto starts = [&](const char *kw, int64_t n) {
+    if (i + n >= len) return false;
+    for (int64_t k = 0; k < n; k++) {
+      char a = data[i + k], b = kw[k];
+      if (a != b && a != (char)toupper((unsigned char)b)) return false;
+    }
+    // keyword must be followed by whitespace — 'prefix:x' is a pname
+    return ttl_is_ws(data[i + n]);
+  };
+  auto at_kw = [&](const char *kw, int64_t n) {
+    if (i + n >= len) return false;
+    if (std::memcmp(data + i, kw, (size_t)n) != 0) return false;
+    return ttl_is_ws(data[i + n]);
+  };
+  bool at_prefix = false, sparql_style = false;
+  if (data[i] == '@') {
+    if (at_kw("@prefix", 7)) {
+      at_prefix = true;
+      i += 7;
+    } else {
+      return (i + 1 < len && data[i + 1] == 'b') ? -2 : -1;  // @base
+    }
+  } else if (starts("prefix", 6)) {
+    sparql_style = true;
+    i += 6;
+  } else if (starts("base", 4)) {
+    return -2;
+  } else {
+    return 1;
+  }
+  i = ttl_skip(data, len, i);
+  int64_t j = i;
+  while (j < len && data[j] != ':' && ttl_pname_prefix_char(data[j])) j++;
+  if (j >= len || data[j] != ':') return -1;
+  std::string pfx(data + i, (size_t)(j - i));
+  i = ttl_skip(data, len, j + 1);
+  if (i >= len || data[i] != '<') return -1;
+  int64_t k = i + 1;
+  while (k < len && data[k] != '>') k++;
+  if (k >= len) return -1;
+  std::string iri(data + i + 1, (size_t)(k - i - 1));
+  i = k + 1;
+  if (at_prefix) {  // '@prefix' requires the terminating '.'
+    i = ttl_skip(data, len, i);
+    if (i >= len || data[i] != '.') return -1;
+    i++;
+  } else if (!sparql_style) {
+    return -1;
+  }
+  auto it = env.map.find(pfx);
+  if (env.frozen) {
+    // MT chunk: the sequential pre-pass already registered every
+    // line-leading directive; anything new or conflicting forces the
+    // single-threaded re-parse
+    if (it == env.map.end() || it->second != iri) return -1;
+  } else {
+    env.map[pfx] = std::move(iri);
+  }
+  return 0;
+}
+
+int ttl_parse_impl(const char *data, int64_t len, TtlPrefixEnv &env,
+                   NtSession &out) {
+  int64_t i = 0;
+  std::string scratch;
+  while (true) {
+    i = ttl_skip(data, len, i);
+    if (i >= len) return 0;
+    int drc = ttl_directive(data, len, i, env);
+    if (drc == 0) continue;
+    if (drc < 0) return drc;
+    uint32_t s_id, p_id, o_id;
+    int rc = ttl_term(data, len, i, 0, env, out, scratch, s_id);
+    if (rc != 0) return rc;
+    while (true) {  // predicate list
+      i = ttl_skip(data, len, i);
+      if (i >= len) return -1;
+      rc = ttl_term(data, len, i, 1, env, out, scratch, p_id);
+      if (rc != 0) return rc;
+      while (true) {  // object list
+        i = ttl_skip(data, len, i);
+        if (i >= len) return -1;
+        rc = ttl_term(data, len, i, 2, env, out, scratch, o_id);
+        if (rc != 0) return rc;
+        out.ids.push_back(s_id);
+        out.ids.push_back(p_id);
+        out.ids.push_back(o_id);
+        i = ttl_skip(data, len, i);
+        if (i < len && data[i] == ',') { i++; continue; }
+        break;
+      }
+      if (i < len && data[i] == ';') {
+        i++;
+        i = ttl_skip(data, len, i);
+        if (i < len && (data[i] == '.' || data[i] == ';')) {
+          // trailing ';' before '.' (legal); empty ';;' also tolerated
+          while (i < len && data[i] == ';') i = ttl_skip(data, len, i + 1);
+        }
+        if (i < len && data[i] == '.') break;
+        continue;
+      }
+      break;
+    }
+    if (i >= len || data[i] != '.') return -1;
+    i++;
+  }
+}
+
+// Sequential pre-pass over line-leading directives (MT mode): applies them
+// in document order.  Returns false if a prefix is REDEFINED to a
+// different IRI (order-dependent semantics → single-threaded parse).
+bool ttl_collect_directives(const char *data, int64_t len, TtlPrefixEnv &env) {
+  int64_t i = 0;
+  while (i < len) {
+    int64_t ls = i;
+    while (ls < len && (data[ls] == ' ' || data[ls] == '\t')) ls++;
+    if (ls < len && (data[ls] == '@' || data[ls] == 'P' || data[ls] == 'p')) {
+      int64_t j = ls;
+      TtlPrefixEnv probe;  // reuse parser; apply manually to detect conflicts
+      int rc = ttl_directive(data, len, j, probe);
+      if (rc == 0 && !probe.map.empty()) {
+        auto &kv = *probe.map.begin();
+        auto it = env.map.find(kv.first);
+        if (it != env.map.end() && it->second != kv.second) return false;
+        env.map[kv.first] = kv.second;
+      }
+    }
+    while (i < len && data[i] != '\n') i++;
+    i++;
+  }
+  return true;
+}
+
+// Chunked multithreaded Turtle parse.  Chunks split after '.' + newline
+// (the statement terminator; '.' inside IRIs/literals never precedes a raw
+// newline, and multiline strings return -2 from whichever chunk holds the
+// opener before any merge).  Any chunk failure falls back to the exact
+// sequential parse.
+int ttl_parse_mt_impl(const char *data, int64_t len, int nthreads,
+                      TtlPrefixEnv &env, NtSession &out) {
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int)hc : 1;
+    const int64_t kMinChunk = 1 << 20;
+    if ((int64_t)nthreads > len / kMinChunk) {
+      nthreads = (int)(len / kMinChunk);
+      if (nthreads < 1) nthreads = 1;
+    }
+  }
+  if (nthreads > 16) nthreads = 16;
+  if (len > 0 && (int64_t)nthreads > len) nthreads = (int)len;
+  if (nthreads <= 1) return ttl_parse_impl(data, len, env, out);
+
+  TtlPrefixEnv shared = env;
+  if (!ttl_collect_directives(data, len, shared)) {
+    return ttl_parse_impl(data, len, env, out);  // redefinition: sequential
+  }
+  shared.frozen = true;
+
+  std::vector<int64_t> starts(nthreads + 1);
+  starts[0] = 0;
+  starts[nthreads] = len;
+  for (int t = 1; t < nthreads; t++) {
+    int64_t pos = len * t / nthreads;
+    if (pos < starts[t - 1]) pos = starts[t - 1];
+    // advance to the first newline whose preceding significant byte is '.'
+    while (pos < len) {
+      if (data[pos] == '\n') {
+        int64_t b = pos - 1;
+        while (b >= starts[t - 1] && (data[b] == ' ' || data[b] == '\t' ||
+                                      data[b] == '\r')) {
+          b--;
+        }
+        if (b >= starts[t - 1] && data[b] == '.') break;
+      }
+      pos++;
+    }
+    starts[t] = pos < len ? pos + 1 : len;
+  }
+  std::vector<NtSession> locals(nthreads);
+  std::vector<int> rcs(nthreads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    try {
+      workers.emplace_back([&, t] {
+        try {
+          TtlPrefixEnv chunk_env = shared;  // const-used; cheap map copy
+          rcs[t] = ttl_parse_impl(data + starts[t], starts[t + 1] - starts[t],
+                                  chunk_env, locals[t]);
+        } catch (...) {
+          rcs[t] = -3;
+        }
+      });
+    } catch (const std::system_error &) {
+      for (int u = t; u < nthreads; u++) rcs[u] = -3;
+      break;
+    }
+  }
+  for (auto &w : workers) w.join();
+  for (int t = 0; t < nthreads; t++) {
+    if (rcs[t] == -2) return -2;
+    if (rcs[t] != 0) return ttl_parse_impl(data, len, env, out);
+  }
+  out = std::move(locals[0]);
+  for (int t = 1; t < nthreads; t++) {
+    NtSession &loc = locals[t];
+    std::vector<uint32_t> remap(loc.terms.size() + 1);
+    for (size_t k = 0; k < loc.terms.size(); k++) {
+      remap[k + 1] = out.intern_view(
+          std::string_view(loc.terms[k].first, loc.terms[k].second));
+    }
+    size_t base = out.ids.size();
+    out.ids.resize(base + loc.ids.size());
+    for (size_t k = 0; k < loc.ids.size(); k++) {
+      out.ids[base + k] = remap[loc.ids[k]];
+    }
+  }
+  env = std::move(shared);
+  env.frozen = false;
+  return 0;
+}
+
+struct TtlSession {
+  NtSession nt;  // FIRST member: kn_nt_* accessors work on the same layout
+  std::string prefix_blob;  // final prefixes: pfx \x1F iri \x1E ...
+};
+
 }  // namespace
 
 // ────────────────────────────── C ABI ────────────────────────────────────
@@ -601,6 +1053,27 @@ int64_t kn_sdd_exactly_one(void *h, const int64_t *vars, int64_t n) {
     result = m->apply(result, term, 1);
   }
   return result;
+}
+
+// Vectorized apply: one library crossing for a whole derivation column
+// (the per-call ctypes overhead dominates the reasoner's tag algebra
+// otherwise — see provenance_seminaive's batched SDD round).
+void kn_sdd_apply_batch(void *h, const int64_t *a, const int64_t *b,
+                        int64_t n, int op, int64_t *out) {
+  auto *m = (SddManager *)h;
+  for (int64_t i = 0; i < n; i++) out[i] = m->apply(a[i], b[i], op);
+}
+
+// Segmented fold: out[gid[i]] = apply(out[gid[i]], tags[i]) in row order.
+// Caller pre-initializes ``out`` to the fold identity (TRUE for 'and',
+// FALSE for 'or').  Group ids need not be sorted.
+void kn_sdd_reduce_groups(void *h, const int64_t *tags, const int64_t *gids,
+                          int64_t n, int op, int64_t *out) {
+  auto *m = (SddManager *)h;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t g = gids[i];
+    out[g] = m->apply(out[g], tags[i], op);
+  }
 }
 
 double kn_sdd_wmc(void *h, int64_t nid) { return ((SddManager *)h)->wmc(nid); }
@@ -745,5 +1218,82 @@ void kn_nt_terms(void *session, char *out, int64_t *offsets) {
 }
 
 void kn_nt_free(void *session) { delete (NtSession *)session; }
+
+// Turtle bulk parse.  prefix_blob: initial prefixes serialized as
+// "pfx \x1F iri \x1E ..." (may be empty).  The returned session supports
+// the kn_ttl_* accessors; term/id layout matches the NT session.
+int64_t kn_ttl_parse_mt(const char *data, int64_t len, int nthreads,
+                        const char *prefix_blob, int64_t prefix_len,
+                        void **out_session) {
+  auto *s = new TtlSession();
+  TtlPrefixEnv env;
+  int64_t p = 0;
+  while (p < prefix_len) {
+    int64_t sep = p;
+    while (sep < prefix_len && prefix_blob[sep] != '\x1F') sep++;
+    int64_t end = sep;
+    while (end < prefix_len && prefix_blob[end] != '\x1E') end++;
+    if (sep < end) {
+      env.map[std::string(prefix_blob + p, (size_t)(sep - p))] =
+          std::string(prefix_blob + sep + 1, (size_t)(end - sep - 1));
+    }
+    p = end + 1;
+  }
+  int rc;
+  try {
+    rc = ttl_parse_mt_impl(data, len, nthreads, env, s->nt);
+  } catch (...) {
+    rc = -3;
+  }
+  if (rc != 0) {
+    delete s;
+    *out_session = nullptr;
+    return rc;
+  }
+  for (auto &kv : env.map) {
+    s->prefix_blob.append(kv.first);
+    s->prefix_blob.push_back('\x1F');
+    s->prefix_blob.append(kv.second);
+    s->prefix_blob.push_back('\x1E');
+  }
+  *out_session = s;
+  return (int64_t)(s->nt.ids.size() / 3);
+}
+
+int64_t kn_ttl_nterms(void *session) {
+  return (int64_t)((TtlSession *)session)->nt.terms.size();
+}
+
+int64_t kn_ttl_term_bytes(void *session) {
+  return ((TtlSession *)session)->nt.term_bytes;
+}
+
+void kn_ttl_ids(void *session, uint32_t *out) {
+  auto &s = ((TtlSession *)session)->nt;
+  std::memcpy(out, s.ids.data(), s.ids.size() * sizeof(uint32_t));
+}
+
+void kn_ttl_terms(void *session, char *out, int64_t *offsets) {
+  auto &s = ((TtlSession *)session)->nt;
+  int64_t pos = 0;
+  int64_t i = 0;
+  for (auto &t : s.terms) {
+    offsets[i++] = pos;
+    std::memcpy(out + pos, t.first, t.second);
+    pos += (int64_t)t.second;
+  }
+  offsets[i] = pos;
+}
+
+int64_t kn_ttl_prefixes_len(void *session) {
+  return (int64_t)((TtlSession *)session)->prefix_blob.size();
+}
+
+void kn_ttl_prefixes(void *session, char *out) {
+  auto &b = ((TtlSession *)session)->prefix_blob;
+  std::memcpy(out, b.data(), b.size());
+}
+
+void kn_ttl_free(void *session) { delete (TtlSession *)session; }
 
 }  // extern "C"

@@ -442,3 +442,39 @@ class TestMLSchemaAndHandler:
         assert res.timing.total_ms >= 0
         ranked = h.compare_models()
         assert ranked[0].name == "fast"
+
+
+class TestGenerateMlModels:
+    def test_generate_then_discover(self, tmp_path):
+        """generate_ml_models runs predictor scripts (lib.rs:415-489
+        parity) which drop the pkl + TTL artifacts discovery then loads."""
+        script = tmp_path / "temp_predictor.py"
+        script.write_text(
+            "import pickle\n"
+            "class M:\n"
+            "    def predict(self, X):\n"
+            "        return [7.0 for _ in X]\n"
+            "import pickletools\n"
+            "# stdlib-only model: a callable-free namespace pickled by value\n"
+            "import types, sys\n"
+            "sys.path.insert(0, '.')\n"
+            "with open('temp_predictor.pkl', 'wb') as f:\n"
+            "    pickle.dump({'const': 7.0}, f)\n"
+            "with open('temp_schema.ttl', 'w') as f:\n"
+            "    f.write('@prefix mls: <http://www.w3.org/ns/mls#> .\\n'\n"
+            "            '<http://m/e> mls:specifiedBy mls:cpuUsage ;\\n'\n"
+            "            '  mls:hasValue 3.5 .\\n')\n"
+        )
+        h = MLHandler()
+        names = h.generate_ml_models(str(tmp_path))
+        assert names == ["temp"]
+        assert (tmp_path / "temp_predictor.pkl").exists()
+        assert (tmp_path / "temp_schema.ttl").exists()
+
+    def test_generate_failing_script_raises(self, tmp_path):
+        (tmp_path / "bad_predictor.py").write_text("raise SystemExit(3)\n")
+        h = MLHandler()
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="bad_predictor"):
+            h.generate_ml_models(str(tmp_path))

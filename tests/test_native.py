@@ -290,3 +290,162 @@ def test_nt_bulk_parse_empty_first_term():
     assert ids.shape == (1, 3)
     assert terms[ids[0, 0] - 1] == ""
     assert terms[ids[0, 1] - 1] == "http://p"
+
+
+TTL_DOC = """@prefix ex: <http://example.org/> .
+@prefix foaf: <http://xmlns.com/foaf/0.1/> .
+PREFIX ds: <https://data.example/ontology#>
+# comment line
+ex:alice a foaf:Person ;
+    foaf:knows ex:bob, ex:carol ;
+    ds:salary 42000 ;
+    ds:score 3.5 ;
+    ds:big 1.5e3 ;
+    ds:active true .
+ex:bob foaf:name "Bob \\"quoted\\""@en .
+ex:carol ds:note "w"^^<http://www.w3.org/2001/XMLSchema#string> ;
+    ds:typed "7"^^ds:custom .
+_:b1 ex:linked ex:alice .
+"""
+
+
+def _turtle_both_paths(doc, nthreads=0):
+    """(native triples, python triples) as decoded string sets."""
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    def load(native):
+        db = SparqlDatabase()
+        if not native:
+            db._parse_turtle_native = lambda data: None
+        n = db.parse_turtle(doc)
+        trips = {
+            tuple(db.dictionary.decode(x) for x in t)
+            for t in db.store.triples_set()
+        }
+        return n, trips, dict(db.prefixes)
+
+    return load(True), load(False)
+
+
+def test_ttl_bulk_parse_agreement():
+    (n1, t1, p1), (n0, t0, p0) = _turtle_both_paths(TTL_DOC)
+    assert n1 == n0
+    assert t1 == t0
+    assert p1 == p0
+
+
+def test_ttl_multithreaded_merge_agreement():
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    doc = TTL_DOC + "\n".join(
+        f"ex:n{i} ds:salary {1000 + i} ." for i in range(997)
+    )
+    r_mt = bulk_parse_turtle(doc, {}, nthreads=4)
+    r_st = bulk_parse_turtle(doc, {}, nthreads=1)
+    assert r_mt is not None and r_st is not None
+    ids_mt, terms_mt, pf_mt = r_mt
+    ids_st, terms_st, pf_st = r_st
+    set_mt = {tuple(terms_mt[j - 1] for j in row) for row in ids_mt}
+    set_st = {tuple(terms_st[j - 1] for j in row) for row in ids_st}
+    assert set_mt == set_st
+    assert len(ids_mt) == len(ids_st)
+    assert pf_mt == pf_st
+
+
+def test_ttl_bulk_parse_falls_back_on_unsupported():
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    head = "@prefix ex: <http://e/> .\n"
+    for bad in (
+        "ex:a ex:p [ ex:q ex:r ] .",
+        "ex:a ex:p ( 1 2 ) .",
+        'ex:a ex:p """multi\nline""" .',
+        "ex:a ex:p 'single' .",
+        "@base <http://b/> .",
+        "<< ex:a ex:p ex:o >> ex:q ex:r .",
+    ):
+        assert bulk_parse_turtle(head + bad, {}) is None, bad
+
+
+def test_ttl_initial_prefixes_and_undefined_prefix():
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    # prefixes handed in by the caller (db.prefixes) apply without
+    # document directives
+    r = bulk_parse_turtle(
+        "ex:a ex:p ex:o .", {"ex": "http://init.example/"}
+    )
+    assert r is not None
+    ids, terms, _ = r
+    assert terms[ids[0][0] - 1] == "http://init.example/a"
+    # an undefined prefix is a hard error -> Python fallback decides
+    assert bulk_parse_turtle("nope:a nope:b nope:c .", {}) is None
+
+
+def test_ttl_statement_spanning_chunk_boundary():
+    """';'-continued statements span lines; the chunk splitter must cut at
+    statement terminators only (or fall back), never mis-parse."""
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    doc = "@prefix ex: <http://e/> .\n" + "\n".join(
+        f'ex:s{i} ex:p ex:a{i} ;\n    ex:q ex:b{i} ;\n    ex:r "v{i}" .'
+        for i in range(400)
+    )
+    r_mt = bulk_parse_turtle(doc, {}, nthreads=6)
+    r_st = bulk_parse_turtle(doc, {}, nthreads=1)
+    assert r_st is not None and r_mt is not None
+    ids_mt, terms_mt, _ = r_mt
+    ids_st, terms_st, _ = r_st
+    set_mt = {tuple(terms_mt[j - 1] for j in row) for row in ids_mt}
+    set_st = {tuple(terms_st[j - 1] for j in row) for row in ids_st}
+    assert set_mt == set_st
+    assert len(ids_mt) == 1200
+
+
+def test_sdd_batched_round_matches_per_row():
+    """The batched SDD derivation round (apply_batch + reduce_groups) must
+    produce the same facts and WMC values as the per-row tag loop."""
+    from kolibrie_tpu.reasoner.provenance_seminaive import (
+        infer_with_provenance,
+        seed_tag_store,
+    )
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+    from kolibrie_tpu.reasoner.sdd import SddProvenance
+
+    def build():
+        r = Reasoner()
+        for i in range(60):  # n >= 32 rows so the batched path engages
+            r.add_tagged_triple(f"x{i}", "p", f"y{i % 6}", 0.2 + 0.1 * (i % 7))
+            r.add_tagged_triple(f"y{i % 6}", "q", f"z{i % 3}", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?a", "p", "?b"), ("?b", "q", "?c")], [("?a", "pq", "?c")]
+            )
+        )
+        return r
+
+    r1 = build()
+    prov1 = SddProvenance()
+    st1 = seed_tag_store(r1, prov1)
+    infer_with_provenance(r1, prov1, st1)
+
+    r2 = build()
+    prov2 = SddProvenance()
+    st2 = seed_tag_store(r2, prov2)
+    real = prov2.manager
+
+    class NoBatch:
+        def __getattr__(self, k):
+            if k == "apply_batch":
+                raise AttributeError(k)
+            return getattr(real, k)
+
+    prov2.manager = NoBatch()
+    infer_with_provenance(r2, prov2, st2)
+
+    assert r1.facts.triples_set() == r2.facts.triples_set()
+    assert set(st1.tags) == set(st2.tags)
+    for k in sorted(st1.tags):
+        w1 = prov1.manager.wmc(st1.tags[k])
+        w2 = real.wmc(st2.tags[k])
+        assert abs(w1 - w2) < 1e-12, (k, w1, w2)
