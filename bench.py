@@ -90,8 +90,14 @@ def main():
     from kolibrie_tpu.optimizer.device_engine import PreparedQuery
     from kolibrie_tpu.query.executor import execute_query_volcano
 
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    note("building db")
     db, t_load = build_db()
+    note(f"db built in {t_load:.1f}s; querying backend")
     platform = jax.devices()[0].platform
+    note(f"platform={platform}")
     # Off-TPU (CPU fallback attempt) the full dispatch protocol takes >15
     # minutes; a reduced protocol keeps the attempt inside the supervisor's
     # per-attempt timeout while still measuring the same pipeline.
@@ -108,8 +114,10 @@ def main():
         host_rows = execute_query_volcano(JOIN_QUERY, db)
         host_e2e = min(host_e2e, time.perf_counter() - t0)
 
+    note(f"host e2e done ({host_e2e:.2f}s best)")
     prep = PreparedQuery(db, JOIN_QUERY)
     prep.calibrate()  # host-side exact capacities; no device I/O
+    note("calibrated")
     host_exec = float("inf")
     for _ in range(5):
         t0 = time.perf_counter()
@@ -119,6 +127,7 @@ def main():
     # ---- device: warm, then timed dispatches (NO readback in the loop) ---
     out = prep.run()
     jax.block_until_ready(out)
+    note("first device dispatch (compile) done")
     out = prep.run()
     jax.block_until_ready(out)
     times = []
@@ -133,9 +142,12 @@ def main():
     # ---- amortized: K plan executions per dispatch (tunnel latency is
     # ~1ms/dispatch and swamps a sub-ms plan; the scan carries a dependency
     # so XLA cannot hoist the body) -----------------------------------------
+    note(f"single-dispatch loop done (best {min(times)*1e3:.2f} ms)")
+
     def time_amortized(n_samples):
         ok = prep.run_amortized(scan_k)
         jax.block_until_ready(ok)
+        note("amortized variant compiled")
         ts = []
         for _ in range(n_samples):
             t0 = time.perf_counter()
@@ -207,7 +219,29 @@ def main():
 
 ATTEMPT_TIMEOUT_S = 1500  # one TPU attempt ≈ 10-15 min (4 compiled
 #                           variants + 3 timed dispatch loops with gaps)
+PROBE_TIMEOUT_S = 150  # backend init through a healthy tunnel takes seconds
 BACKOFFS_S = (5, 20, 45)  # sleeps between the TPU attempts
+
+
+def _probe_backend() -> bool:
+    """Quick dead-tunnel detector: backend init HANGS (no exception) when
+    the axon tunnel is wedged, which would otherwise burn a full attempt
+    timeout discovering nothing.  A tiny child with a short timeout tells
+    us cheaply whether a real attempt is worth starting."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); print(d[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def _run_child(env_extra):
@@ -243,6 +277,14 @@ def _run_child(env_extra):
 def supervise():
     failures = []
     for i, backoff in enumerate((*BACKOFFS_S, None)):
+        if not _probe_backend():
+            failures.append(
+                f"attempt {i + 1}: device backend init hung/failed within "
+                f"{PROBE_TIMEOUT_S}s (tunnel down) — attempt skipped"
+            )
+            if backoff is not None:
+                time.sleep(backoff)
+            continue
         line, fail = _run_child({})
         if line is not None:
             print(line)

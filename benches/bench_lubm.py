@@ -32,6 +32,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import os  # noqa: E402
 
+# KOLIBRIE_BENCH_CPU=1: force the CPU backend (with however many virtual
+# devices XLA_FLAGS grants).  The env preloads jax on the axon TPU platform
+# via sitecustomize, so JAX_PLATFORMS is too late — jax.config is the
+# reliable override (same dance as tests/conftest.py / bench.py).
+if os.environ.get("KOLIBRIE_BENCH_CPU"):
+    import jax as _jax  # noqa: E402
+
+    _jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 from lubm import LUBM_Q2, LUBM_Q9, UB, generate_fast, predicate_ids  # noqa: E402
@@ -39,7 +48,15 @@ from lubm import LUBM_Q2, LUBM_Q9, UB, generate_fast, predicate_ids  # noqa: E40
 # LUBM scale knob: LUBM_UNIVERSITIES=1000 runs the BASELINE.md LUBM-1000
 # configuration (~3.79M triples, generated vectorized in ~1s)
 N_UNIVERSITIES = int(os.environ.get("LUBM_UNIVERSITIES", "40"))
-SECTIONS = ("load", "queries_host", "queries_device", "closure", "sharded", "load10m")
+SECTIONS = (
+    "load",
+    "queries_host",
+    "queries_device",
+    "closure",
+    "sharded",
+    "dist_query",
+    "load10m",
+)
 
 
 def build_db():
@@ -308,6 +325,59 @@ def section_sharded():
             }
         )
     )
+
+
+def section_dist_query():
+    """FULL distributed SPARQL plans (BASELINE config 5): Q2/Q9 lowered
+    onto the mesh — sharded scans, all_to_all repartition between join
+    stages, local joins, filters, projection — timed as the un-read device
+    dispatch; rows verified equal to the host engine afterwards."""
+    import jax
+
+    from kolibrie_tpu.parallel.dist_query import DistQueryExecutor
+    from kolibrie_tpu.parallel.mesh import make_mesh
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    db, _cols, _ = build_db()
+    n = len(db.store)
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    execs = {}
+    for name, query in (("lubm_q2", LUBM_Q2), ("lubm_q9", LUBM_Q9)):
+        ex = DistQueryExecutor(mesh, db, query)
+        outs = ex.run_device()  # builds store, converges capacities
+        jax.block_until_ready(outs[0])
+        execs[name] = (ex, query, outs)
+    results = {}
+    for name, (ex, _q, outs) in execs.items():
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            outs = ex.run_device()
+            jax.block_until_ready(outs[0])
+            best = min(best, time.perf_counter() - t0)
+        results[name] = (best, outs)
+    # verification AFTER all timing (tunnel readback discipline)
+    db.execution_mode = "host"
+    for name, (ex, query, _outs) in execs.items():
+        best, _ = results[name]
+        rows = ex.run()
+        host_rows = execute_query_volcano(query, db)
+        assert rows == host_rows, f"{name}: dist/host row mismatch"
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name}_dist_plan_wall_clock",
+                    "devices": n_dev,
+                    "platform": jax.devices()[0].platform,
+                    "rows": len(rows),
+                    "ms": round(1000 * best, 3),
+                    "triples_per_sec_per_chip": round(
+                        n / best / max(n_dev, 1), 1
+                    ),
+                }
+            )
+        )
 
 
 def section_load10m():

@@ -255,6 +255,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self._handle_rsp_register()
         elif self.path == "/rsp/push":
             self._handle_rsp_push()
+        elif self.path == "/rsp/checkpoint":
+            self._handle_rsp_checkpoint()
+        elif self.path == "/rsp/restore":
+            self._handle_rsp_restore()
         else:
             self._send_error_json("not found", 404)
 
@@ -374,13 +378,11 @@ class KolibrieHandler(BaseHTTPRequestHandler):
 
     # --------------------------------------------------------- /rsp sessions
 
-    def _handle_rsp_register(self):
-        req = self._read_json()
-        if req is None:
-            return
-        if not req.get("query"):
-            self._send_error_json("No query provided")
-            return
+    def _create_session(self, reg: dict, restore_blob: Optional[bytes] = None):
+        """Shared register/restore core: build the engine from its
+        CONFIGURATION, optionally restore checkpointed state, register the
+        session, and answer with its id.  (docs/PREEMPTION.md: a restore is
+        a re-register plus state.)"""
         holder: List[EngineSession] = []
 
         def consumer(row):
@@ -389,24 +391,91 @@ class KolibrieHandler(BaseHTTPRequestHandler):
 
         try:
             engine = _build_rsp_engine(
-                req["query"],
-                req.get("static_rdf"),
-                req.get("static_format", "rdfxml"),
-                req.get("n3logic"),
-                req.get("sparql_rules"),
+                reg["query"],
+                reg.get("static_rdf"),
+                reg.get("static_format") or "rdfxml",
+                reg.get("n3logic"),
+                reg.get("sparql_rules"),
                 consumer,
             )
+            if restore_blob is not None:
+                engine.restore_state(restore_blob)
         except Exception as e:
-            self._send_error_json(f"Failed to build RSP engine: {e}")
+            verb = "restore" if restore_blob is not None else "build"
+            self._send_error_json(f"Failed to {verb} RSP engine: {e}")
             return
         streams = [cfg.stream_iri for cfg in engine.window_configs]
         session = EngineSession(engine, streams)
+        # keep the CONFIGURATION so /rsp/checkpoint blobs are restorable
+        session.register_request = {
+            k: reg.get(k)
+            for k in (
+                "query",
+                "static_rdf",
+                "static_format",
+                "n3logic",
+                "sparql_rules",
+            )
+        }
         holder.append(session)
         state = self.state
         with state.lock:
             session_id = str(next(state.counter))
             state.sessions[session_id] = session
         self._send_json({"session_id": session_id, "streams": streams})
+
+    def _handle_rsp_register(self):
+        req = self._read_json()
+        if req is None:
+            return
+        if not req.get("query"):
+            self._send_error_json("No query provided")
+            return
+        self._create_session(req)
+
+    def _handle_rsp_checkpoint(self):
+        """Snapshot a live session: configuration (the original register
+        request) + resumable engine state (base64 pickle blob).  POST the
+        SAME payload to /rsp/restore to resume after a restart
+        (docs/PREEMPTION.md)."""
+        import base64
+
+        req = self._read_json()
+        if req is None:
+            return
+        state = self.state
+        with state.lock:
+            session = state.sessions.get(str(req.get("session_id")))
+        if session is None:
+            self._send_error_json("session not found", 404)
+            return
+        with session.push_lock:
+            blob = session.engine.checkpoint_state()
+        self._send_json(
+            {
+                "register": getattr(session, "register_request", {}),
+                "state": base64.b64encode(blob).decode("ascii"),
+            }
+        )
+
+    def _handle_rsp_restore(self):
+        """Rebuild a session from a /rsp/checkpoint payload: re-register
+        the configuration, then restore the engine state; returns a fresh
+        session_id continuing the stream exactly where the snapshot was.
+        The state blob is JSON (safe on untrusted input — see
+        RSPEngine.checkpoint_state), never pickle."""
+        import base64
+
+        req = self._read_json()
+        if req is None:
+            return
+        reg = req.get("register") or {}
+        if not reg.get("query"):
+            self._send_error_json("No query in register payload")
+            return
+        self._create_session(
+            reg, restore_blob=base64.b64decode(req.get("state", ""))
+        )
 
     def _handle_rsp_push(self):
         req = self._read_json()

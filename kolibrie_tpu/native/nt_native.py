@@ -82,6 +82,31 @@ def read_session_terms(lib, session, n: int, fns: tuple):
     return ids.reshape(n, 3), terms
 
 
+def bulk_parse_rdf_xml(data: str) -> Optional[tuple]:
+    """Parse an RDF/XML document natively (streaming byte parser for the
+    common bulk shape; see ``RxParser`` in the C++ runtime).  Returns
+    ``(ids, terms)`` like :func:`bulk_parse_ntriples`, or None to request
+    the Python ElementTree fallback (default xmlns, nested node elements,
+    fresh blank nodes, parseType, CDATA, DOCTYPE...)."""
+    lib = load()
+    if lib is None:
+        return None
+    raw, raw_len = input_view(data)
+    session = ctypes.c_void_p()
+    n = int(lib.kn_rx_parse(raw, raw_len, ctypes.byref(session)))
+    if n < 0:
+        return None
+    try:
+        return read_session_terms(
+            lib,
+            session,
+            n,
+            ("kn_nt_ids", "kn_nt_nterms", "kn_nt_term_bytes", "kn_nt_terms"),
+        )
+    finally:
+        lib.kn_nt_free(session)
+
+
 def bulk_parse_ntriples(data: str, nthreads: int = 0) -> Optional[tuple]:
     """Parse a plain N-Triples document natively.
 

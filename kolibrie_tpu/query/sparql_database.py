@@ -371,7 +371,28 @@ class SparqlDatabase:
 
     def parse_rdf(self, data: str) -> int:
         """RDF/XML. Parity: ``sparql_database.rs:401`` ``parse_rdf``."""
+        native = self._parse_rdf_native(data)
+        if native is not None:
+            return native
         return self._ingest(rdf_parsers.parse_rdf_xml(data))
+
+    def _parse_rdf_native(self, data: str) -> Optional[int]:
+        """Bulk fast path: streaming C++ RDF/XML parser + unique-term
+        interning.  None (fall back to ElementTree) for shapes outside the
+        common bulk subset — see ``bulk_parse_rdf_xml``."""
+        try:
+            from kolibrie_tpu.native.nt_native import bulk_parse_rdf_xml
+        except ImportError:
+            return None
+        result = bulk_parse_rdf_xml(data)
+        if result is None:
+            return None
+        ids, terms = result
+        remap = np.empty(len(terms) + 1, dtype=np.uint32)
+        remap[1:] = self.dictionary.encode_batch(terms)
+        cols = remap[ids]
+        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
+        return int(ids.shape[0])
 
     def parse_rdf_from_file(self, path: str) -> int:
         with open(path, "r", encoding="utf-8") as f:

@@ -109,6 +109,46 @@ def join_window_results(
     return joined
 
 
+def _ckpt_encode(x):
+    """Checkpoint-blob value encoding: JSON-safe tagged forms for the
+    types that flow through window/R2S/SDS+ state.  Fails LOUD on anything
+    else — a silently lossy checkpoint is worse than no checkpoint."""
+    if isinstance(x, WindowTriple):
+        return ["wt", x.s, x.p, x.o]
+    if isinstance(x, Triple):
+        return ["tr", x.subject, x.predicate, x.object]
+    if isinstance(x, tuple):
+        return ["u", [_ckpt_encode(v) for v in x]]
+    if isinstance(x, list):
+        return ["l", [_ckpt_encode(v) for v in x]]
+    if isinstance(x, (set, frozenset)):
+        return ["set", [_ckpt_encode(v) for v in x]]
+    if isinstance(x, dict):
+        return ["d", [[_ckpt_encode(k), _ckpt_encode(v)] for k, v in x.items()]]
+    if x is None or isinstance(x, (str, int, float, bool)):
+        return ["v", x]
+    raise TypeError(f"unsupported checkpoint value type {type(x).__name__}")
+
+
+def _ckpt_decode(x):
+    tag, *rest = x
+    if tag == "wt":
+        return WindowTriple(*rest)
+    if tag == "tr":
+        return Triple(*rest)
+    if tag == "u":
+        return tuple(_ckpt_decode(v) for v in rest[0])
+    if tag == "l":
+        return [_ckpt_decode(v) for v in rest[0]]
+    if tag == "set":
+        return {_ckpt_decode(v) for v in rest[0]}
+    if tag == "d":
+        return {_ckpt_decode(k): _ckpt_decode(v) for k, v in rest[0]}
+    if tag == "v":
+        return rest[0]
+    raise ValueError(f"unknown checkpoint tag {tag!r}")
+
+
 class RSPEngine:
     def __init__(
         self,
@@ -543,48 +583,59 @@ class RSPEngine:
         RSTREAM re-emission is idempotent for consumers keyed on window
         close time; ISTREAM/DSTREAM diffs stay exact because
         ``last_result`` is part of the snapshot).
+
+        The blob is JSON (``_ckpt_encode``), NOT pickle: checkpoint blobs
+        travel over the HTTP API (``/rsp/checkpoint`` → ``/rsp/restore``),
+        and unpickling network-supplied bytes is arbitrary code execution.
         """
-        import pickle
+        import json
 
         with self._cw_lock:
             state = {
-                "version": 1,
+                "version": 2,
                 "windows": [
                     {
                         "t_0": r.window.t_0,
                         "app_time": r.window.app_time,
                         "active": [
-                            (
+                            [
                                 w.open,
                                 w.close,
-                                dict(c.elements),
+                                [
+                                    [_ckpt_encode(item), ts]
+                                    for item, ts in c.elements.items()
+                                ],
                                 c.last_timestamp_changed,
                                 c.origin,
-                            )
+                            ]
                             for w, c in r.window.active_windows.items()
                         ],
                     }
                     for r in self.windows
                 ],
-                "r2s_last": set(self.r2s.last_result),
-                "sds_plus": dict(self._sds_plus_state),
+                "r2s_last": [_ckpt_encode(x) for x in self.r2s.last_result],
+                "sds_plus": [
+                    [_ckpt_encode(k), _ckpt_encode(v)]
+                    for k, v in self._sds_plus_state.items()
+                ],
                 "latest_contents": {
-                    k: list(v) for k, v in self._latest_contents.items()
+                    k: [[_ckpt_encode(t), ts] for t, ts in v]
+                    for k, v in self._latest_contents.items()
                 },
             }
-        return pickle.dumps(state)
+        return json.dumps(state).encode("utf-8")
 
     def restore_state(self, blob: bytes) -> None:
         """Restore a :meth:`checkpoint_state` snapshot into THIS engine
         (built with the same window configs / queries / rules).  Events
         added afterwards continue the stream exactly where the snapshot
-        left off."""
-        import pickle
+        left off.  Safe on untrusted input (pure JSON, no pickle)."""
+        import json
 
         from kolibrie_tpu.rsp.s2r import Window
 
-        state = pickle.loads(blob)
-        if state.get("version") != 1:
+        state = json.loads(blob.decode("utf-8"))
+        if state.get("version") != 2:
             raise ValueError(f"unknown checkpoint version {state.get('version')!r}")
         if len(state["windows"]) != len(self.windows):
             raise ValueError("checkpoint window count != engine window count")
@@ -596,13 +647,21 @@ class RSPEngine:
                 win.active_windows = {}
                 for open_, close, elements, last_ts, origin in ws["active"]:
                     c = ContentContainer(origin)
-                    c.elements = dict(elements)
+                    c.elements = {
+                        _ckpt_decode(item): ts for item, ts in elements
+                    }
                     c.last_timestamp_changed = last_ts
                     win.active_windows[Window(open_, close)] = c
-            self.r2s.last_result = set(state["r2s_last"])
-            self._sds_plus_state = dict(state["sds_plus"])
+            self.r2s.last_result = {
+                _ckpt_decode(x) for x in state["r2s_last"]
+            }
+            self._sds_plus_state = {
+                _ckpt_decode(k): _ckpt_decode(v)
+                for k, v in state["sds_plus"]
+            }
             self._latest_contents = {
-                k: list(v) for k, v in state["latest_contents"].items()
+                k: [(_ckpt_decode(t), ts) for t, ts in v]
+                for k, v in state["latest_contents"].items()
             }
 
     # ----------------------------------------------------------------- misc

@@ -627,8 +627,12 @@ int ttl_term(const char *data, int64_t len, int64_t &i, int pos,
   if (c == '_') {
     if (i + 1 >= len || data[i + 1] != ':') return -1;
     int64_t j = i + 2;
-    while (j < len && ttl_pname_prefix_char(data[j])) j++;
-    while (j > i + 2 && data[j - 1] == '.') j--;  // trailing '.' = terminator
+    // label charset matches the Python tokenizer's blank regex [\w-]+
+    // exactly (NO dots) so both paths store identical labels
+    while (j < len && (isalnum((unsigned char)data[j]) || data[j] == '_' ||
+                       data[j] == '-')) {
+      j++;
+    }
     id_out = out.intern_view(std::string_view(data + i, (size_t)(j - i)));
     i = j;
     return 0;
@@ -674,7 +678,7 @@ int ttl_term(const char *data, int64_t len, int64_t &i, int pos,
         if (it == env.map.end()) return -1;
         int64_t m = k + 1;
         while (m < len && ttl_pname_local_char(data[m])) m++;
-        while (m > k + 1 && data[m - 1] == '.') m--;
+        if (m > k + 1 && data[m - 1] == '.') return -2;  // see ttl_term pname
         scratch.append(it->second);
         scratch.append(data + k + 1, (size_t)(m - k - 1));
         i = m;
@@ -747,7 +751,13 @@ int ttl_term(const char *data, int64_t len, int64_t &i, int pos,
       if (it == env.map.end()) return -1;  // undefined / not-yet-seen prefix
       int64_t m = j + 1;
       while (m < len && ttl_pname_local_char(data[m])) m++;
-      while (m > j + 1 && data[m - 1] == '.') m--;
+      if (m > j + 1 && data[m - 1] == '.') {
+        // 'ex:foo.' — dot-terminated pname.  Turtle grammar says the dot
+        // is the statement terminator, but the Python tokenizer keeps it
+        // in the local name; native MUST NOT silently store different
+        // triples than the fallback, so let Python decide.
+        return -2;
+      }
       scratch.clear();
       scratch.append(it->second);
       scratch.append(data + j + 1, (size_t)(m - j - 1));
@@ -890,23 +900,35 @@ int ttl_parse_impl(const char *data, int64_t len, TtlPrefixEnv &env,
 }
 
 // Sequential pre-pass over line-leading directives (MT mode): applies them
-// in document order.  Returns false if a prefix is REDEFINED to a
-// different IRI (order-dependent semantics → single-threaded parse).
+// in document order.  Returns false (→ exact sequential parse) if a
+// prefix is REDEFINED to a different IRI, or if any directive appears
+// AFTER the first statement — pre-applying such a directive to every
+// chunk would let a statement use a prefix declared later in the
+// document, which the sequential (and Python) parse correctly rejects.
 bool ttl_collect_directives(const char *data, int64_t len, TtlPrefixEnv &env) {
   int64_t i = 0;
+  bool statements_started = false;
   while (i < len) {
     int64_t ls = i;
     while (ls < len && (data[ls] == ' ' || data[ls] == '\t')) ls++;
-    if (ls < len && (data[ls] == '@' || data[ls] == 'P' || data[ls] == 'p')) {
+    bool blank_or_comment =
+        ls >= len || data[ls] == '\n' || data[ls] == '\r' || data[ls] == '#';
+    if (!blank_or_comment &&
+        (data[ls] == '@' || data[ls] == 'P' || data[ls] == 'p')) {
       int64_t j = ls;
       TtlPrefixEnv probe;  // reuse parser; apply manually to detect conflicts
       int rc = ttl_directive(data, len, j, probe);
       if (rc == 0 && !probe.map.empty()) {
+        if (statements_started) return false;  // forward-reference hazard
         auto &kv = *probe.map.begin();
         auto it = env.map.find(kv.first);
         if (it != env.map.end() && it->second != kv.second) return false;
         env.map[kv.first] = kv.second;
+      } else if (rc == 1) {
+        statements_started = true;  // a pname like 'prefix:x' = a statement
       }
+    } else if (!blank_or_comment) {
+      statements_started = true;
     }
     while (i < len && data[i] != '\n') i++;
     i++;
@@ -1008,6 +1030,384 @@ struct TtlSession {
   NtSession nt;  // FIRST member: kn_nt_* accessors work on the same layout
   std::string prefix_blob;  // final prefixes: pfx \x1F iri \x1E ...
 };
+
+// ───────────────────────── RDF/XML fast path ────────────────────────────
+//
+// Streaming byte-level parser for the common bulk shape of RDF/XML — the
+// reference's primary load format (its quick-xml streamed ingestion,
+// sparql_database.rs:401-571): a root <rdf:RDF> with xmlns declarations,
+// node elements <rdf:Description rdf:about="..."> (or typed node elements
+// → rdf:type), non-rdf attributes as literal properties, and property
+// elements carrying rdf:resource / rdf:nodeID / rdf:datatype / xml:lang /
+// text content.  Stored term forms match rdf_parsers.parse_rdf_xml
+// exactly.  Returns -2 (Python ElementTree fallback) for: default xmlns,
+// nested node elements, fresh blank nodes (no about/ID/nodeID),
+// parseType, CDATA, DOCTYPE, processing instructions beyond the XML decl,
+// or any rdf:-namespace construct outside the supported set.
+
+static const char *kRdfNs = "http://www.w3.org/1999/02/22-rdf-syntax-ns#";
+static const char *kXmlNs = "http://www.w3.org/XML/1998/namespace";
+
+struct RxParser {
+  const char *d;
+  int64_t n;
+  int64_t i = 0;
+  NtSession *out;
+  std::unordered_map<std::string, std::string> ns;  // prefix -> iri
+  std::string scratch, scratch2;
+
+  bool ws(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n';
+  }
+  void skip_ws() {
+    while (i < n && ws(d[i])) i++;
+  }
+  // Skip <?...?> and <!-- ... -->; returns -2 on DOCTYPE/CDATA, 0 else.
+  int skip_misc() {
+    while (true) {
+      skip_ws();
+      if (i + 1 >= n || d[i] != '<') return 0;
+      if (d[i + 1] == '?') {
+        i += 2;
+        while (i + 1 < n && !(d[i] == '?' && d[i + 1] == '>')) i++;
+        if (i + 1 >= n) return -1;
+        i += 2;
+        continue;
+      }
+      if (i + 3 < n && d[i + 1] == '!' && d[i + 2] == '-' && d[i + 3] == '-') {
+        i += 4;
+        while (i + 2 < n &&
+               !(d[i] == '-' && d[i + 1] == '-' && d[i + 2] == '>')) {
+          i++;
+        }
+        if (i + 2 >= n) return -1;
+        i += 3;
+        continue;
+      }
+      if (d[i + 1] == '!') return -2;  // DOCTYPE / CDATA
+      return 0;
+    }
+  }
+  // XML entity unescape of [s, s+len) into dst (appends).  ``attr`` turns
+  // on XML attribute-value normalization (literal tab/newline/CR → space);
+  // text content gets line-ending normalization (\r\n and \r → \n) — both
+  // are what ElementTree produces, and the native path must store
+  // byte-identical terms to the Python fallback.
+  bool unescape(const char *s, int64_t len, std::string &dst,
+                bool attr = false) {
+    for (int64_t k = 0; k < len; k++) {
+      char c = s[k];
+      if (c != '&') {
+        if (attr && (c == '\t' || c == '\n' || c == '\r')) {
+          dst.push_back(' ');
+        } else if (!attr && c == '\r') {
+          dst.push_back('\n');
+          if (k + 1 < len && s[k + 1] == '\n') k++;  // \r\n → \n
+        } else {
+          dst.push_back(c);
+        }
+        continue;
+      }
+      int64_t semi = k + 1;
+      while (semi < len && s[semi] != ';' && semi - k < 12) semi++;
+      if (semi >= len || s[semi] != ';') return false;
+      std::string_view ent(s + k + 1, (size_t)(semi - k - 1));
+      if (ent == "amp") dst.push_back('&');
+      else if (ent == "lt") dst.push_back('<');
+      else if (ent == "gt") dst.push_back('>');
+      else if (ent == "quot") dst.push_back('"');
+      else if (ent == "apos") dst.push_back('\'');
+      else if (!ent.empty() && ent[0] == '#') {
+        uint32_t cp = 0;
+        bool hex = ent.size() > 1 && (ent[1] == 'x' || ent[1] == 'X');
+        for (size_t t = hex ? 2 : 1; t < ent.size(); t++) {
+          char h = ent[t];
+          int v = h >= '0' && h <= '9' ? h - '0'
+                  : h >= 'a' && h <= 'f' ? h - 'a' + 10
+                  : h >= 'A' && h <= 'F' ? h - 'A' + 10
+                  : -1;
+          if (v < 0 || (!hex && v > 9)) return false;
+          cp = cp * (hex ? 16 : 10) + (uint32_t)v;
+        }
+        // UTF-8 append (shares logic shape with append_unescaped)
+        if (cp < 0x80) dst.push_back((char)cp);
+        else if (cp < 0x800) {
+          dst.push_back((char)(0xC0 | (cp >> 6)));
+          dst.push_back((char)(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+          dst.push_back((char)(0xE0 | (cp >> 12)));
+          dst.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          dst.push_back((char)(0x80 | (cp & 0x3F)));
+        } else {
+          dst.push_back((char)(0xF0 | (cp >> 18)));
+          dst.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+          dst.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          dst.push_back((char)(0x80 | (cp & 0x3F)));
+        }
+      } else {
+        return false;
+      }
+      k = semi;
+    }
+    return true;
+  }
+
+  struct Attr {
+    std::string_view name;  // raw qname, e.g. "rdf:about"
+    std::string value;      // unescaped
+  };
+
+  // Parse a start tag at d[i]=='<'; fills qname + attrs, sets self_close.
+  int tag(std::string_view &qname, std::vector<Attr> &attrs,
+          bool &self_close, bool &is_close) {
+    attrs.clear();
+    if (d[i] != '<') return -1;
+    i++;
+    is_close = i < n && d[i] == '/';
+    if (is_close) i++;
+    int64_t s0 = i;
+    while (i < n && !ws(d[i]) && d[i] != '>' && d[i] != '/') i++;
+    qname = std::string_view(d + s0, (size_t)(i - s0));
+    if (qname.empty()) return -1;
+    self_close = false;
+    while (true) {
+      skip_ws();
+      if (i >= n) return -1;
+      if (d[i] == '>') {
+        i++;
+        return 0;
+      }
+      if (d[i] == '/' && i + 1 < n && d[i + 1] == '>') {
+        self_close = true;
+        i += 2;
+        return 0;
+      }
+      int64_t a0 = i;
+      while (i < n && d[i] != '=' && !ws(d[i])) i++;
+      std::string_view aname(d + a0, (size_t)(i - a0));
+      skip_ws();
+      if (i >= n || d[i] != '=') return -1;
+      i++;
+      skip_ws();
+      if (i >= n || (d[i] != '"' && d[i] != '\'')) return -1;
+      char q = d[i++];
+      int64_t v0 = i;
+      while (i < n && d[i] != q) i++;
+      if (i >= n) return -1;
+      Attr a;
+      a.name = aname;
+      if (!unescape(d + v0, i - v0, a.value, /*attr=*/true)) return -1;
+      i++;  // closing quote
+      attrs.push_back(std::move(a));
+    }
+  }
+
+  // Resolve "pfx:local" via the ns map into scratch2; nullptr prefix → -2.
+  int expand(std::string_view qname, std::string &dst) {
+    size_t colon = qname.find(':');
+    if (colon == std::string_view::npos) return -2;  // default-ns element
+    auto it = ns.find(std::string(qname.substr(0, colon)));
+    if (it == ns.end()) return -2;
+    dst.clear();
+    dst.append(it->second);
+    dst.append(qname.substr(colon + 1));
+    return 0;
+  }
+
+  bool is_rdf(std::string_view qname, const char *local) {
+    size_t colon = qname.find(':');
+    if (colon == std::string_view::npos) return false;
+    auto it = ns.find(std::string(qname.substr(0, colon)));
+    return it != ns.end() && it->second == kRdfNs &&
+           qname.substr(colon + 1) == std::string_view(local);
+  }
+
+  int parse() {
+    int rc = skip_misc();
+    if (rc != 0) return rc;
+    std::string_view qname;
+    std::vector<Attr> attrs;
+    bool self_close, is_close;
+    rc = tag(qname, attrs, self_close, is_close);
+    if (rc != 0 || is_close) return rc != 0 ? rc : -1;
+    // root: collect xmlns declarations FIRST (needed to recognize rdf:RDF)
+    for (auto &a : attrs) {
+      if (a.name.substr(0, 6) == std::string_view("xmlns:")) {
+        ns[std::string(a.name.substr(6))] = a.value;
+      } else if (a.name == std::string_view("xmlns")) {
+        return -2;  // default namespace: ElementTree fallback
+      }
+    }
+    ns["xml"] = kXmlNs;  // implicit per XML spec
+    if (!is_rdf(qname, "RDF")) return -2;  // single-node docs: fallback
+    if (self_close) return 0;
+    while (true) {
+      rc = skip_misc();
+      if (rc != 0) return rc;
+      if (i >= n) return -1;
+      int64_t save = i;
+      rc = tag(qname, attrs, self_close, is_close);
+      if (rc != 0) return rc;
+      if (is_close) {
+        return is_rdf(qname, "RDF") ? 0 : -1;
+      }
+      i = save;
+      rc = node_element();
+      if (rc != 0) return rc;
+    }
+  }
+
+  int node_element() {
+    std::string_view qname;
+    std::vector<Attr> attrs;
+    bool self_close, is_close;
+    int rc = tag(qname, attrs, self_close, is_close);
+    if (rc != 0 || is_close) return -1;
+    // subject from rdf:about / rdf:ID / rdf:nodeID
+    std::string subj;
+    bool have_subj = false;
+    for (auto &a : attrs) {
+      if (is_rdf(a.name, "about")) {
+        subj = a.value;
+        have_subj = true;
+      } else if (is_rdf(a.name, "ID")) {
+        subj = "#" + a.value;
+        have_subj = true;
+      } else if (is_rdf(a.name, "nodeID")) {
+        subj = "_:" + a.value;
+        have_subj = true;
+      }
+    }
+    if (!have_subj) return -2;  // fresh bnode numbering: Python fallback
+    uint32_t subj_id = out->intern_view(subj);
+    if (!is_rdf(qname, "Description")) {
+      rc = expand(qname, scratch2);
+      if (rc != 0) return rc;
+      emit(subj_id, out->intern_view(kRdfNs + std::string("type")),
+           out->intern_view(scratch2));
+    }
+    // non-rdf, non-xml attributes are literal properties
+    for (auto &a : attrs) {
+      size_t colon = a.name.find(':');
+      if (colon == std::string_view::npos) continue;
+      auto it = ns.find(std::string(a.name.substr(0, colon)));
+      if (it == ns.end()) return -2;
+      if (it->second == kRdfNs || it->second == kXmlNs) continue;
+      scratch2.clear();
+      scratch2.append(it->second);
+      scratch2.append(a.name.substr(colon + 1));
+      uint32_t p_id = out->intern_view(scratch2);
+      scratch.clear();
+      scratch.push_back('"');
+      scratch.append(a.value);
+      scratch.push_back('"');
+      emit(subj_id, p_id, out->intern_view(scratch));
+    }
+    if (self_close) return 0;
+    // property elements until the matching close tag
+    std::string open_name(qname);
+    while (true) {
+      rc = skip_misc();
+      if (rc != 0) return rc;
+      int64_t save = i;
+      std::string_view pq;
+      std::vector<Attr> pattrs;
+      bool psc, pclose;
+      rc = tag(pq, pattrs, psc, pclose);
+      if (rc != 0) return rc;
+      if (pclose) {
+        return pq == std::string_view(open_name) ? 0 : -1;
+      }
+      (void)save;
+      rc = property_element(subj_id, pq, pattrs, psc);
+      if (rc != 0) return rc;
+    }
+  }
+
+  void emit(uint32_t s, uint32_t p, uint32_t o) {
+    out->ids.push_back(s);
+    out->ids.push_back(p);
+    out->ids.push_back(o);
+  }
+
+  int property_element(uint32_t subj_id, std::string_view pq,
+                       std::vector<Attr> &attrs, bool self_close) {
+    int rc = expand(pq, scratch2);
+    if (rc != 0) return rc;
+    uint32_t p_id = out->intern_view(scratch2);
+    const std::string *res = nullptr, *nid = nullptr, *dt = nullptr,
+                      *lang = nullptr;
+    for (auto &a : attrs) {
+      if (is_rdf(a.name, "resource")) res = &a.value;
+      else if (is_rdf(a.name, "nodeID")) nid = &a.value;
+      else if (is_rdf(a.name, "datatype")) dt = &a.value;
+      else if (a.name == std::string_view("xml:lang")) lang = &a.value;
+      else return -2;  // parseType / reification / unknown: fallback
+    }
+    if (res != nullptr) {
+      emit(subj_id, p_id, out->intern_view(*res));
+      if (!self_close) {  // <p rdf:resource="..."></p> — empty content
+        if (!close_empty(pq)) return -1;
+      }
+      return 0;
+    }
+    if (nid != nullptr) {
+      scratch.clear();
+      scratch.append("_:");
+      scratch.append(*nid);
+      emit(subj_id, p_id, out->intern_view(scratch));
+      if (!self_close && !close_empty(pq)) return -1;
+      return 0;
+    }
+    std::string text;
+    if (!self_close) {
+      int64_t t0 = i;
+      while (i < n && d[i] != '<') i++;
+      if (i >= n) return -1;
+      if (i + 1 < n && d[i + 1] != '/') return -2;  // nested node element
+      if (!unescape(d + t0, i - t0, text)) return -1;
+      std::string_view cq;
+      std::vector<Attr> ca;
+      bool csc, cclose;
+      if (tag(cq, ca, csc, cclose) != 0 || !cclose || cq != pq) return -1;
+    }
+    // strip (Python .strip()) the text content
+    size_t b = 0, e = text.size();
+    while (b < e && ws(text[b])) b++;
+    while (e > b && ws(text[e - 1])) e--;
+    scratch.clear();
+    scratch.push_back('"');
+    scratch.append(text, b, e - b);
+    scratch.push_back('"');
+    if (dt != nullptr && !dt->empty()) {
+      scratch.append("^^");
+      scratch.append(*dt);
+    } else if (lang != nullptr && !lang->empty()) {
+      scratch.push_back('@');
+      scratch.append(*lang);
+    }
+    emit(subj_id, p_id, out->intern_view(scratch));
+    return 0;
+  }
+
+  bool close_empty(std::string_view pq) {
+    // expects optional whitespace then </pq>
+    skip_ws();
+    std::string_view cq;
+    std::vector<Attr> ca;
+    bool csc, cclose;
+    if (tag(cq, ca, csc, cclose) != 0) return false;
+    return cclose && cq == pq;
+  }
+};
+
+int rx_parse_impl(const char *data, int64_t len, NtSession &out) {
+  RxParser p;
+  p.d = data;
+  p.n = len;
+  p.out = &out;
+  return p.parse();
+}
 
 }  // namespace
 
@@ -1283,6 +1683,25 @@ void kn_ttl_terms(void *session, char *out, int64_t *offsets) {
     pos += (int64_t)t.second;
   }
   offsets[i] = pos;
+}
+
+// RDF/XML bulk parse (single-threaded streaming; see RxParser).  The
+// session supports the kn_nt_* accessors (same NtSession layout).
+int64_t kn_rx_parse(const char *data, int64_t len, void **out_session) {
+  auto *s = new NtSession();
+  int rc;
+  try {
+    rc = rx_parse_impl(data, len, *s);
+  } catch (...) {
+    rc = -3;
+  }
+  if (rc != 0) {
+    delete s;
+    *out_session = nullptr;
+    return rc;
+  }
+  *out_session = s;
+  return (int64_t)(s->ids.size() / 3);
 }
 
 int64_t kn_ttl_prefixes_len(void *session) {

@@ -21,3 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (preloaded by sitecustomize anyway)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite's wall-clock is dominated by
+# 8-device shard_map compiles that are identical run-to-run (VERDICT r2:
+# full suite >10 min, dist_* files ~5 min each).  Cache survives across
+# pytest invocations; harmless if the backend ignores it.
+_cache_dir = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "all")

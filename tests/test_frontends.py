@@ -331,3 +331,54 @@ def test_http_playground_served(server):
     with urllib.request.urlopen(server + "/") as resp:
         html = resp.read().decode()
     assert "kolibrie-tpu playground" in html
+
+
+def test_http_rsp_checkpoint_restore(server):
+    """docs/PREEMPTION.md serving-layer flow: register → push → checkpoint
+    → restore into a NEW session → continue pushing; the restored session
+    keeps window state (events pushed before the snapshot still join)."""
+    reg = post(server, "/rsp/register", {"query": RSP_QUERY})
+    sid = reg["session_id"]
+    for ts in (1, 2):
+        post(
+            server,
+            "/rsp/push",
+            {
+                "session_id": sid,
+                "stream": "stream1",
+                "timestamp": ts,
+                "ntriples": f"<http://e/a{ts}> <http://e/p> <http://e/o> .",
+            },
+        )
+    snap = post(server, "/rsp/checkpoint", {"session_id": sid})
+    assert snap["register"]["query"] == RSP_QUERY
+    assert snap["state"]
+
+    res = post(server, "/rsp/restore", snap)
+    sid2 = res["session_id"]
+    assert sid2 != sid
+    assert res["streams"] == ["stream1"]
+    # events continue on the restored session; window closes fire with the
+    # pre-snapshot contents present
+    for ts in (3, 4, 5, 6):
+        body = post(
+            server,
+            "/rsp/push",
+            {
+                "session_id": sid2,
+                "stream": "stream1",
+                "timestamp": ts,
+                "ntriples": f"<http://e/b{ts}> <http://e/p> <http://e/o> .",
+            },
+        )
+        assert body["ok"]
+    req = urllib.request.Request(server + f"/rsp/events/{sid2}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        line = resp.readline().decode()
+        table = json.loads(line[len("data: "):])["results"]
+        header, rows = table[0], table[1:]
+        s_idx = header.index("s")
+        subjects = {r[s_idx] for r in rows}
+        # a window covering ts<=2 content only exists if restored state
+        # carried the pre-snapshot events
+        assert any("/a" in s for s in subjects), subjects

@@ -449,3 +449,114 @@ def test_sdd_batched_round_matches_per_row():
         w1 = prov1.manager.wmc(st1.tags[k])
         w2 = real.wmc(st2.tags[k])
         assert abs(w1 - w2) < 1e-12, (k, w1, w2)
+
+
+RX_DOC = """<?xml version="1.0"?>
+<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#" xmlns:ex="http://e/">
+<ex:Person rdf:about="http://e/a" ex:nick="al">
+  <ex:knows rdf:resource="http://e/b"/>
+  <ex:age rdf:datatype="http://www.w3.org/2001/XMLSchema#int">30</ex:age>
+  <ex:note xml:lang="fr">salut &amp; bye</ex:note>
+  <ex:friend rdf:nodeID="bn1"/>
+  <ex:empty></ex:empty>
+</ex:Person>
+<rdf:Description rdf:nodeID="bn1"><ex:age>7</ex:age></rdf:Description>
+<rdf:Description rdf:ID="frag"><ex:p>v</ex:p></rdf:Description>
+</rdf:RDF>"""
+
+
+def test_rdfxml_bulk_parse_agreement():
+    """Native streaming RDF/XML parser vs the ElementTree path: typed
+    nodes, attribute properties, resource/nodeID/datatype/lang, entity
+    escapes, rdf:ID."""
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    def load(native):
+        db = SparqlDatabase()
+        if not native:
+            db._parse_rdf_native = lambda d: None
+        n = db.parse_rdf(RX_DOC)
+        return n, {
+            tuple(db.dictionary.decode(x) for x in t)
+            for t in db.store.triples_set()
+        }
+
+    n1, t1 = load(True)
+    n0, t0 = load(False)
+    assert n1 == n0
+    assert t1 == t0
+
+
+def test_rdfxml_bulk_parse_falls_back_on_unsupported():
+    from kolibrie_tpu.native.nt_native import bulk_parse_rdf_xml
+
+    rdfns = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+    for bad in (
+        # nested node element in property position
+        f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">'
+        '<rdf:Description rdf:about="http://e/a">'
+        '<e:p><rdf:Description rdf:about="http://e/b"/></e:p>'
+        "</rdf:Description></rdf:RDF>",
+        # default namespace
+        '<r xmlns="http://d/"/>',
+        # DOCTYPE
+        f'<!DOCTYPE x><rdf:RDF xmlns:rdf="{rdfns}"/>',
+        # fresh blank node (no about/ID/nodeID)
+        f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">'
+        "<rdf:Description><e:p>v</e:p></rdf:Description></rdf:RDF>",
+        # parseType
+        f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">'
+        '<rdf:Description rdf:about="http://e/a">'
+        '<e:p rdf:parseType="Literal">x</e:p>'
+        "</rdf:Description></rdf:RDF>",
+    ):
+        assert bulk_parse_rdf_xml(bad) is None
+
+
+def test_ttl_dot_terminated_pname_falls_back():
+    """'ex:c.' (no space before the statement dot) parses differently in
+    the Python tokenizer; the native path must fall back, never diverge."""
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    assert (
+        bulk_parse_turtle("@prefix ex: <http://e/> .\nex:a ex:p ex:c.", {})
+        is None
+    )
+    # interior dots stay native
+    r = bulk_parse_turtle("@prefix ex: <http://e/> .\nex:a ex:p ex:c.d .", {})
+    assert r is not None
+    ids, terms, _ = r
+    assert terms[ids[0][2] - 1] == "http://e/c.d"
+
+
+def test_ttl_forward_referenced_prefix_rejected_in_mt():
+    """A prefix used before its directive must fail in BOTH thread modes
+    (the chunked pre-pass may not legalize forward references)."""
+    from kolibrie_tpu.native.ttl_native import bulk_parse_turtle
+
+    fwd = "ex:a ex:p ex:o .\n@prefix ex: <http://e/> .\n" + "\n".join(
+        f"ex:n{i} ex:p ex:o ." for i in range(50)
+    )
+    assert bulk_parse_turtle(fwd, {}, nthreads=4) is None
+    assert bulk_parse_turtle(fwd, {}, nthreads=1) is None
+
+
+def test_rdfxml_whitespace_normalization_parity():
+    """CRLF text content and raw-newline attribute values must normalize
+    exactly like ElementTree (XML attribute-value + line-ending rules)."""
+    from kolibrie_tpu.native.nt_native import bulk_parse_rdf_xml
+    from kolibrie_tpu.query.rdf_parsers import parse_rdf_xml
+
+    rdfns = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+    doc = (
+        f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">\r\n'
+        '<rdf:Description rdf:about="http://e/a" e:attr="a\nb">\r\n'
+        "<e:txt>line1\r\nline2</e:txt>\r\n"
+        "</rdf:Description>\r\n</rdf:RDF>"
+    )
+    r = bulk_parse_rdf_xml(doc)
+    assert r is not None
+    ids, terms = r
+    objs = {terms[row[2] - 1] for row in ids}
+    assert objs == {t[2] for t in parse_rdf_xml(doc)}
+    assert '"a b"' in objs and '"line1\nline2"' in objs
