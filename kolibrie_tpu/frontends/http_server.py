@@ -473,9 +473,12 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         if not reg.get("query"):
             self._send_error_json("No query in register payload")
             return
-        self._create_session(
-            reg, restore_blob=base64.b64decode(req.get("state", ""))
-        )
+        try:
+            blob = base64.b64decode(req.get("state", ""), validate=False)
+        except Exception:
+            self._send_error_json("Invalid base64 state")
+            return
+        self._create_session(reg, restore_blob=blob)
 
     def _handle_rsp_push(self):
         req = self._read_json()

@@ -185,10 +185,14 @@ def _query_body(
     table, valid = _scan_premise(premises[seed], fcols, fv)
     for (j, kv, kpos, extra) in steps:
         prem = premises[j]
-        table, valid, dropped = _exchange_table(
-            table, valid, kv, n, axis, bucket_cap
-        )
-        overflow = overflow + dropped.astype(jnp.int32)
+        if n > 1:
+            table, valid, dropped = _exchange_table(
+                table, valid, kv, n, axis, bucket_cap
+            )
+            overflow = overflow + dropped.astype(jnp.int32)
+        # n == 1 (single-chip mesh): every key hashes to shard 0 — the
+        # exchange is an identity that would still pay a full bucketize
+        # sort per join step; skip it
         if kpos == 0:
             side_cols, side_valid, side_key = fcols, fv, fs
         else:
@@ -332,9 +336,79 @@ class DistQueryExecutor:
         self.steps = dict(plans)[self.seed]
         self.query = q
         self.store = store
-        n_local = max(1, -(-len(db.store) // self.n))
-        self.join_cap = join_cap or round_cap(4 * n_local, 256)
-        self.bucket_cap = bucket_cap or round_cap(4 * n_local, 256)
+        if join_cap is None or bucket_cap is None:
+            est = self._calibrate_caps()
+            if join_cap is None:
+                join_cap = est[0]
+            if bucket_cap is None:
+                bucket_cap = est[1]
+        self.join_cap = join_cap
+        self.bucket_cap = bucket_cap
+
+    # Calibration bails to the store-size heuristic past this many
+    # intermediate rows: materializing bigger host joins just to size the
+    # device buffers would cost the host memory the static-capacity design
+    # exists to avoid.
+    _CALIBRATE_ROW_LIMIT = 8_000_000
+
+    def _calibrate_caps(self) -> Tuple[int, int]:
+        """Size the per-shard join/bucket capacities from a HOST pass over
+        the actual premise chain instead of a blind multiple of the store
+        size — the static shapes the mesh program sorts and exchanges are
+        then proportional to the query's true intermediate cardinalities.
+        Each step's join size is COUNTED first (searchsorted, no index
+        materialization); a blow-up past ``_CALIBRATE_ROW_LIMIT`` falls
+        back to the heuristic.  Skew headroom 4x; the overflow/retry
+        protocol still backstops underestimates."""
+        from kolibrie_tpu.ops.join import join_indices as host_join
+
+        s, p, o = self.db.store.columns()
+        cols = (s, p, o)
+        heuristic = round_cap(
+            4 * max(1, -(-len(self.db.store) // self.n)), 256
+        )
+
+        def match(prem):
+            m = np.ones(len(s), dtype=bool)
+            for c, col in zip(prem.consts, cols):
+                if c is not None:
+                    m &= col == np.uint32(c)
+            for a, b in prem.eq_pairs:
+                m &= cols[a] == cols[b]
+            return m
+
+        def table_of(prem):
+            m = match(prem)
+            return {v: cols[pos][m] for v, pos in prem.vars}
+
+        table = table_of(self.premises[self.seed])
+        n_rows = len(next(iter(table.values()))) if table else 0
+        max_rows = n_rows
+        for j, kv, kpos, extra in self.steps:
+            ptab = table_of(self.premises[j])
+            lk, rk = table[kv], ptab[kv]
+            rs = np.sort(rk)
+            counts = np.searchsorted(rs, lk, side="right") - np.searchsorted(
+                rs, lk, side="left"
+            )
+            total = int(counts.sum())
+            if total > self._CALIBRATE_ROW_LIMIT:
+                return heuristic, heuristic
+            li, ri = host_join(lk, rk)
+            new_table = {v: c[li] for v, c in table.items()}
+            keep = np.ones(len(li), dtype=bool)
+            for v, c in ptab.items():
+                if v not in new_table:
+                    new_table[v] = c[ri]
+                elif v in extra:
+                    keep &= new_table[v] == c[ri]
+            # pre-mask size is what the static join output must hold;
+            # masked rows stay in the buffer as invalid
+            max_rows = max(max_rows, total)
+            table = {v: c[keep] for v, c in new_table.items()}
+        per_shard = -(-max(max_rows, 1) // self.n)
+        cap = round_cap(4 * per_shard, 256)
+        return cap, cap
 
     def _ensure_store(self) -> ShardedTripleStore:
         if self.store is None:

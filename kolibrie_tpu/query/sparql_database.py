@@ -158,11 +158,7 @@ class SparqlDatabase:
             return None
         ids, terms, prefixes_out = result
         self.prefixes.update(prefixes_out)
-        remap = np.empty(len(terms) + 1, dtype=np.uint32)
-        remap[1:] = self.dictionary.encode_batch(terms)
-        cols = remap[ids]
-        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
-        return int(ids.shape[0])
+        return self._ingest_native_session(ids, terms)
 
     def parse_n3(self, data: str) -> int:
         triples, prefixes = rdf_parsers.parse_n3(data, self.prefixes)
@@ -175,6 +171,17 @@ class SparqlDatabase:
             return native
         return self._ingest(rdf_parsers.parse_ntriples(data))
 
+    def _ingest_native_session(self, ids: np.ndarray, terms) -> int:
+        """Shared tail of every native bulk parse: intern the session's
+        UNIQUE terms once (``encode_batch``), then remap the (n, 3)
+        1-based id matrix with one vectorized gather into the store.
+        ``remap[0]`` is intentionally never read (ids are 1-based)."""
+        remap = np.empty(len(terms) + 1, dtype=np.uint32)
+        remap[1:] = self.dictionary.encode_batch(terms)
+        cols = remap[ids]
+        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
+        return int(ids.shape[0])
+
     def _parse_ntriples_native(self, data: str) -> Optional[int]:
         """Bulk fast path: C++ tokenizer + unique-term interning; Python
         interns only unique terms, then one vectorized remap.  Returns None
@@ -186,12 +193,7 @@ class SparqlDatabase:
         result = bulk_parse_ntriples(data)
         if result is None:
             return None
-        ids, terms = result
-        remap = np.empty(len(terms) + 1, dtype=np.uint32)
-        remap[1:] = self.dictionary.encode_batch(terms)
-        cols = remap[ids]
-        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
-        return int(ids.shape[0])
+        return self._ingest_native_session(*result)
 
     # ------------------------------------------------- preemption/restart
 
@@ -387,12 +389,7 @@ class SparqlDatabase:
         result = bulk_parse_rdf_xml(data)
         if result is None:
             return None
-        ids, terms = result
-        remap = np.empty(len(terms) + 1, dtype=np.uint32)
-        remap[1:] = self.dictionary.encode_batch(terms)
-        cols = remap[ids]
-        self.store.add_batch(cols[:, 0], cols[:, 1], cols[:, 2])
-        return int(ids.shape[0])
+        return self._ingest_native_session(*result)
 
     def parse_rdf_from_file(self, path: str) -> int:
         with open(path, "r", encoding="utf-8") as f:

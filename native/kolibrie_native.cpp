@@ -1099,7 +1099,11 @@ struct RxParser {
       char c = s[k];
       if (c != '&') {
         if (attr && (c == '\t' || c == '\n' || c == '\r')) {
+          // XML line-ending normalization runs BEFORE attribute-value
+          // normalization, so a literal \r\n is ONE space (ElementTree
+          // parity), not two
           dst.push_back(' ');
+          if (c == '\r' && k + 1 < len && s[k + 1] == '\n') k++;
         } else if (!attr && c == '\r') {
           dst.push_back('\n');
           if (k + 1 < len && s[k + 1] == '\n') k++;  // \r\n → \n
