@@ -474,7 +474,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self._send_error_json("No query in register payload")
             return
         try:
-            blob = base64.b64decode(req.get("state", ""), validate=False)
+            blob = base64.b64decode(req.get("state", ""), validate=True)
         except Exception:
             self._send_error_json("Invalid base64 state")
             return

@@ -337,7 +337,7 @@ class DistQueryExecutor:
         self.query = q
         self.store = store
         if join_cap is None or bucket_cap is None:
-            est = self._calibrate_caps()
+            est = self._calibrated_caps_cached()
             if join_cap is None:
                 join_cap = est[0]
             if bucket_cap is None:
@@ -351,35 +351,48 @@ class DistQueryExecutor:
     # exists to avoid.
     _CALIBRATE_ROW_LIMIT = 8_000_000
 
+    def _calibrated_caps_cached(self) -> Tuple[int, int]:
+        """Per-database memo of :meth:`_calibrate_caps` keyed on (query
+        shape, mesh size, store version): one-shot
+        ``execute_query_distributed`` calls of a repeated query must not
+        pay the host chain pass every time."""
+        key = (
+            self.premises,
+            self.seed,
+            self.steps,
+            self.n,
+            self.db.store.version,
+        )
+        cache = self.db.__dict__.setdefault("_dist_cap_cache", {})
+        caps = cache.get(key)
+        if caps is None:
+            caps = self._calibrate_caps()
+            cache[key] = caps
+        return caps
+
     def _calibrate_caps(self) -> Tuple[int, int]:
         """Size the per-shard join/bucket capacities from a HOST pass over
         the actual premise chain instead of a blind multiple of the store
         size — the static shapes the mesh program sorts and exchanges are
         then proportional to the query's true intermediate cardinalities.
-        Each step's join size is COUNTED first (searchsorted, no index
-        materialization); a blow-up past ``_CALIBRATE_ROW_LIMIT`` falls
-        back to the heuristic.  Skew headroom 4x; the overflow/retry
-        protocol still backstops underestimates."""
-        from kolibrie_tpu.ops.join import join_indices as host_join
-
-        s, p, o = self.db.store.columns()
-        cols = (s, p, o)
+        Premise scans go through the store's sorted orders
+        (``store.match``), each step's join size is COUNTED before any
+        index materialization, and the indices reuse the same
+        searchsorted bounds; a blow-up past ``_CALIBRATE_ROW_LIMIT``
+        falls back to the heuristic.  Skew headroom 4x; the
+        overflow/retry protocol still backstops underestimates."""
         heuristic = round_cap(
             4 * max(1, -(-len(self.db.store) // self.n)), 256
         )
 
-        def match(prem):
-            m = np.ones(len(s), dtype=bool)
-            for c, col in zip(prem.consts, cols):
-                if c is not None:
-                    m &= col == np.uint32(c)
-            for a, b in prem.eq_pairs:
-                m &= cols[a] == cols[b]
-            return m
-
         def table_of(prem):
-            m = match(prem)
-            return {v: cols[pos][m] for v, pos in prem.vars}
+            scan = self.db.store.match(
+                s=prem.consts[0], p=prem.consts[1], o=prem.consts[2]
+            )
+            m = np.ones(len(scan[0]), dtype=bool)
+            for a, b in prem.eq_pairs:
+                m &= scan[a] == scan[b]
+            return {v: scan[pos][m] for v, pos in prem.vars}
 
         table = table_of(self.premises[self.seed])
         n_rows = len(next(iter(table.values()))) if table else 0
@@ -387,16 +400,24 @@ class DistQueryExecutor:
         for j, kv, kpos, extra in self.steps:
             ptab = table_of(self.premises[j])
             lk, rk = table[kv], ptab[kv]
-            rs = np.sort(rk)
-            counts = np.searchsorted(rs, lk, side="right") - np.searchsorted(
-                rs, lk, side="left"
-            )
+            order = np.argsort(rk, kind="stable")
+            rs = rk[order]
+            lo = np.searchsorted(rs, lk, side="left")
+            counts = np.searchsorted(rs, lk, side="right") - lo
             total = int(counts.sum())
             if total > self._CALIBRATE_ROW_LIMIT:
                 return heuristic, heuristic
-            li, ri = host_join(lk, rk)
+            # expand (li, ri) straight from the bounds already in hand
+            li = np.repeat(np.arange(len(lk)), counts)
+            offs = np.concatenate(([0], np.cumsum(counts[:-1]))) if len(
+                counts
+            ) else np.zeros(0, dtype=np.int64)
+            pos = np.arange(total) - np.repeat(offs, counts) + np.repeat(
+                lo, counts
+            )
+            ri = order[pos]
             new_table = {v: c[li] for v, c in table.items()}
-            keep = np.ones(len(li), dtype=bool)
+            keep = np.ones(total, dtype=bool)
             for v, c in ptab.items():
                 if v not in new_table:
                     new_table[v] = c[ri]

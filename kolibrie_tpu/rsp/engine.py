@@ -84,16 +84,40 @@ class WindowResult:
 def natural_join_maps(
     left: List[Dict[str, str]], right: List[Dict[str, str]]
 ) -> List[Dict[str, str]]:
-    """Natural join of binding-map sets (rsp_engine.rs:900-934)."""
+    """Natural join of binding-map sets (rsp_engine.rs:900-934).
+
+    Window result rows share uniform headers, so the join keys are fixed
+    per call and the pairing is a HASH join (build on right, probe left) —
+    this is the multi-window coordinator's hot loop; the naive pairwise
+    scan made it O(|left|·|right|) per firing.  Heterogeneous rows (not
+    produced by the engine, but allowed by the signature) keep the exact
+    pairwise semantics via the fallback."""
     if not left or not right:
         return []
+    lkeys, rkeys = left[0].keys(), right[0].keys()
+    if any(b.keys() != lkeys for b in left) or any(
+        b.keys() != rkeys for b in right
+    ):
+        out = []
+        for lb in left:
+            for rb in right:
+                if all(rb.get(k, v) == v for k, v in lb.items()):
+                    merged = dict(lb)
+                    merged.update(rb)
+                    out.append(merged)
+        return out
+    shared = tuple(k for k in lkeys if k in rkeys)
+    if not shared:
+        return [{**lb, **rb} for lb in left for rb in right]
+    index: Dict[tuple, List[Dict[str, str]]] = {}
+    for rb in right:
+        index.setdefault(tuple(rb[k] for k in shared), []).append(rb)
     out = []
     for lb in left:
-        for rb in right:
-            if all(rb.get(k, v) == v for k, v in lb.items()):
-                merged = dict(lb)
-                merged.update(rb)
-                out.append(merged)
+        for rb in index.get(tuple(lb[k] for k in shared), ()):
+            merged = dict(lb)
+            merged.update(rb)
+            out.append(merged)
     return out
 
 
