@@ -61,7 +61,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "kn_nt_ids": ([ptr, c.POINTER(c.c_uint32)], None),
         "kn_nt_terms": ([ptr, c.c_char_p, c.POINTER(i64)], None),
         "kn_nt_free": ([ptr], None),
-        "kn_rx_parse": ([c.c_char_p, i64, c.POINTER(ptr)], i64),
+        "kn_rx_parse_mt": ([c.c_char_p, i64, c.c_int, c.POINTER(ptr)], i64),
         "kn_ttl_parse_mt": (
             [c.c_char_p, i64, c.c_int, c.c_char_p, i64, c.POINTER(ptr)],
             i64,

@@ -82,9 +82,10 @@ def read_session_terms(lib, session, n: int, fns: tuple):
     return ids.reshape(n, 3), terms
 
 
-def bulk_parse_rdf_xml(data: str) -> Optional[tuple]:
+def bulk_parse_rdf_xml(data: str, nthreads: int = 0) -> Optional[tuple]:
     """Parse an RDF/XML document natively (streaming byte parser for the
-    common bulk shape; see ``RxParser`` in the C++ runtime).  Returns
+    common bulk shape, chunk-parallel past ~1MB with splits after
+    ``</rdf:Description>``; see ``RxParser`` in the C++ runtime).  Returns
     ``(ids, terms)`` like :func:`bulk_parse_ntriples`, or None to request
     the Python ElementTree fallback (default xmlns, nested node elements,
     fresh blank nodes, parseType, CDATA, DOCTYPE...)."""
@@ -93,7 +94,7 @@ def bulk_parse_rdf_xml(data: str) -> Optional[tuple]:
         return None
     raw, raw_len = input_view(data)
     session = ctypes.c_void_p()
-    n = int(lib.kn_rx_parse(raw, raw_len, ctypes.byref(session)))
+    n = int(lib.kn_rx_parse_mt(raw, raw_len, nthreads, ctypes.byref(session)))
     if n < 0:
         return None
     try:

@@ -353,21 +353,21 @@ class DistQueryExecutor:
 
     def _calibrated_caps_cached(self) -> Tuple[int, int]:
         """Per-database memo of :meth:`_calibrate_caps` keyed on (query
-        shape, mesh size, store version): one-shot
+        shape, mesh size), valid for ONE store version: one-shot
         ``execute_query_distributed`` calls of a repeated query must not
-        pay the host chain pass every time."""
-        key = (
-            self.premises,
-            self.seed,
-            self.steps,
-            self.n,
-            self.db.store.version,
-        )
-        cache = self.db.__dict__.setdefault("_dist_cap_cache", {})
-        caps = cache.get(key)
+        pay the host chain pass every time.  A store mutation drops the
+        whole memo (stale-version entries must not accumulate for the
+        life of a long-running database)."""
+        version = self.db.store.version
+        cache = self.db.__dict__.get("_dist_cap_cache")
+        if cache is None or cache["version"] != version:
+            cache = {"version": version, "caps": {}}
+            self.db.__dict__["_dist_cap_cache"] = cache
+        key = (self.premises, self.seed, self.steps, self.n)
+        caps = cache["caps"].get(key)
         if caps is None:
             caps = self._calibrate_caps()
-            cache[key] = caps
+            cache["caps"][key] = caps
         return caps
 
     def _calibrate_caps(self) -> Tuple[int, int]:

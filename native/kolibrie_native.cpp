@@ -1226,7 +1226,10 @@ struct RxParser {
            qname.substr(colon + 1) == std::string_view(local);
   }
 
-  int parse() {
+  // Parse the XML decl/comments + root <rdf:RDF ...> open tag; fills the
+  // ns map and leaves ``i`` at the first body byte.  ``root_closed`` set
+  // when the root self-closes (empty document).
+  int parse_root(bool &root_closed) {
     int rc = skip_misc();
     if (rc != 0) return rc;
     std::string_view qname;
@@ -1244,11 +1247,24 @@ struct RxParser {
     }
     ns["xml"] = kXmlNs;  // implicit per XML spec
     if (!is_rdf(qname, "RDF")) return -2;  // single-node docs: fallback
-    if (self_close) return 0;
+    root_closed = self_close;
+    return 0;
+  }
+
+  // Parse top-level node elements until ``end`` or the root close tag.
+  // ``require_close``: reaching ``end`` without having seen </rdf:RDF> is
+  // TRUNCATION (-1) — set for the whole-body parse and the final MT
+  // chunk; interior chunks end at statement-aligned split points where
+  // no close tag is expected.  (ElementTree raises on truncated docs;
+  // silently loading a partial dataset would be worse than no fast path.)
+  int parse_nodes(int64_t end, bool require_close) {
     while (true) {
-      rc = skip_misc();
+      int rc = skip_misc();
       if (rc != 0) return rc;
-      if (i >= n) return -1;
+      if (i >= end) return require_close ? -1 : 0;
+      std::string_view qname;
+      std::vector<Attr> attrs;
+      bool self_close, is_close;
       int64_t save = i;
       rc = tag(qname, attrs, self_close, is_close);
       if (rc != 0) return rc;
@@ -1259,6 +1275,14 @@ struct RxParser {
       rc = node_element();
       if (rc != 0) return rc;
     }
+  }
+
+  int parse() {
+    bool root_closed = false;
+    int rc = parse_root(root_closed);
+    if (rc != 0) return rc;
+    if (root_closed) return 0;
+    return parse_nodes(n, /*require_close=*/true);
   }
 
   int node_element() {
@@ -1411,6 +1435,113 @@ int rx_parse_impl(const char *data, int64_t len, NtSession &out) {
   p.n = len;
   p.out = &out;
   return p.parse();
+}
+
+// Chunked multithreaded RDF/XML parse.  Within the supported subset (no
+// nested node elements — those return -2 everywhere) a "</rdf:Description>"
+// close can only occur at top level, so boundaries after it are
+// statement-aligned; a split landing inside a comment or a typed-node
+// body makes that chunk's parse FAIL, and ANY chunk failure falls back to
+// the exact sequential parse (never to silently different triples).
+int rx_parse_mt_impl(const char *data, int64_t len, int nthreads,
+                     NtSession &out) {
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int)hc : 1;
+    const int64_t kMinChunk = 1 << 20;
+    if ((int64_t)nthreads > len / kMinChunk) {
+      nthreads = (int)(len / kMinChunk);
+      if (nthreads < 1) nthreads = 1;
+    }
+  }
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads <= 1) return rx_parse_impl(data, len, out);
+
+  // Root prologue parsed once; chunks inherit the ns map.
+  RxParser head;
+  head.d = data;
+  head.n = len;
+  head.out = &out;
+  bool root_closed = false;
+  int rc = head.parse_root(root_closed);
+  if (rc != 0) return rc;
+  if (root_closed) return 0;
+  int64_t body_start = head.i;
+
+  static const char *kSplit = "</rdf:Description>";
+  const size_t kSplitLen = 18;
+  std::vector<int64_t> starts(nthreads + 1);
+  starts[0] = body_start;
+  starts[nthreads] = len;
+  for (int t = 1; t < nthreads; t++) {
+    int64_t target = body_start + (len - body_start) * t / nthreads;
+    if (target < starts[t - 1]) target = starts[t - 1];
+    const char *hit = (const char *)memmem(
+        data + target, (size_t)(len - target), kSplit, kSplitLen);
+    if (hit == nullptr) {
+      // no further split points exist (typed-node-only documents have no
+      // rdf:Description closes): don't rescan to EOF nthreads more times
+      for (int u = t; u < nthreads; u++) starts[u] = len;
+      break;
+    }
+    starts[t] = (hit - data) + (int64_t)kSplitLen;
+  }
+  if (starts[1] >= len) {
+    return rx_parse_impl(data, len, out);  // < 2 real chunks: ST is faster
+  }
+  std::vector<NtSession> locals(nthreads);
+  std::vector<int> rcs(nthreads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    if (starts[t] >= starts[t + 1]) continue;  // empty trailing chunk
+    try {
+      workers.emplace_back([&, t] {
+        try {
+          RxParser p;
+          p.d = data;
+          p.n = len;
+          p.i = starts[t];
+          p.out = &locals[t];
+          p.ns = head.ns;
+          // whichever chunk ends at EOF must witness </rdf:RDF>
+          // (truncation guard); interior chunks end at split points
+          rcs[t] = p.parse_nodes(starts[t + 1], starts[t + 1] == len);
+        } catch (...) {
+          rcs[t] = -3;
+        }
+      });
+    } catch (const std::system_error &) {
+      for (int u = t; u < nthreads; u++) rcs[u] = -3;
+      break;
+    }
+  }
+  for (auto &w : workers) w.join();
+  for (int t = 0; t < nthreads; t++) {
+    if (rcs[t] != 0) {
+      // ANY chunk failure (mid-comment split, typed-node fragment,
+      // unsupported construct) → exact sequential parse decides
+      NtSession fresh;
+      int rc2 = rx_parse_impl(data, len, fresh);
+      if (rc2 == 0) out = std::move(fresh);
+      return rc2;
+    }
+  }
+  out = std::move(locals[0]);
+  for (int t = 1; t < nthreads; t++) {
+    NtSession &loc = locals[t];
+    std::vector<uint32_t> remap(loc.terms.size() + 1);
+    for (size_t k = 0; k < loc.terms.size(); k++) {
+      remap[k + 1] = out.intern_view(
+          std::string_view(loc.terms[k].first, loc.terms[k].second));
+    }
+    size_t base = out.ids.size();
+    out.ids.resize(base + loc.ids.size());
+    for (size_t k = 0; k < loc.ids.size(); k++) {
+      out.ids[base + k] = remap[loc.ids[k]];
+    }
+  }
+  return 0;
 }
 
 }  // namespace
@@ -1689,13 +1820,15 @@ void kn_ttl_terms(void *session, char *out, int64_t *offsets) {
   offsets[i] = pos;
 }
 
-// RDF/XML bulk parse (single-threaded streaming; see RxParser).  The
-// session supports the kn_nt_* accessors (same NtSession layout).
-int64_t kn_rx_parse(const char *data, int64_t len, void **out_session) {
+// RDF/XML bulk parse (streaming; chunk-parallel past ~1MB — see RxParser
+// and rx_parse_mt_impl).  The session supports the kn_nt_* accessors
+// (same NtSession layout).  nthreads <= 0 = auto.
+int64_t kn_rx_parse_mt(const char *data, int64_t len, int nthreads,
+                       void **out_session) {
   auto *s = new NtSession();
   int rc;
   try {
-    rc = rx_parse_impl(data, len, *s);
+    rc = rx_parse_mt_impl(data, len, nthreads, *s);
   } catch (...) {
     rc = -3;
   }

@@ -560,3 +560,62 @@ def test_rdfxml_whitespace_normalization_parity():
     objs = {terms[row[2] - 1] for row in ids}
     assert objs == {t[2] for t in parse_rdf_xml(doc)}
     assert '"a b"' in objs and '"line1\nline2"' in objs
+
+
+def test_rdfxml_multithreaded_chunk_agreement():
+    """Chunked RDF/XML parse (splits after </rdf:Description>) must agree
+    with sequential native AND ElementTree on a doc mixing Description
+    nodes, typed nodes, and comments; a typed-node-fragment chunk falls
+    back to the sequential parse rather than mis-parsing."""
+    from kolibrie_tpu.native.nt_native import bulk_parse_rdf_xml
+    from kolibrie_tpu.query.rdf_parsers import parse_rdf_xml
+
+    rdfns = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+    parts = [f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">']
+    for i in range(400):
+        if i % 7 == 0:
+            parts.append(
+                f'<e:Person rdf:about="http://e/p{i}">'
+                f'<e:knows rdf:resource="http://e/p{i + 1}"/></e:Person>'
+            )
+        else:
+            parts.append(
+                f'<rdf:Description rdf:about="http://e/d{i}">'
+                f"<e:v>{i}</e:v><!-- c{i} --></rdf:Description>"
+            )
+    parts.append("</rdf:RDF>")
+    doc = "\n".join(parts)
+
+    def tset(r):
+        ids, terms = r
+        return {tuple(terms[j - 1] for j in row) for row in ids}
+
+    r_mt = bulk_parse_rdf_xml(doc, nthreads=6)
+    r_st = bulk_parse_rdf_xml(doc, nthreads=1)
+    assert r_mt is not None and r_st is not None
+    assert tset(r_mt) == tset(r_st) == {
+        (s, p, o) for s, p, o in parse_rdf_xml(doc)
+    }
+    assert len(r_mt[0]) == len(r_st[0])
+
+
+def test_rdfxml_truncated_document_rejected():
+    """A document missing </rdf:RDF> (partial download) must NOT silently
+    load partial triples in either thread mode — ElementTree raises, so
+    the native path falls back rather than diverge."""
+    from kolibrie_tpu.native.nt_native import bulk_parse_rdf_xml
+
+    rdfns = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+    trunc = (
+        f'<rdf:RDF xmlns:rdf="{rdfns}" xmlns:e="http://e/">'
+        + "".join(
+            f'<rdf:Description rdf:about="http://e/a{i}">'
+            f"<e:v>{i}</e:v></rdf:Description>"
+            for i in range(500)
+        )
+    )
+    assert bulk_parse_rdf_xml(trunc, nthreads=1) is None
+    assert bulk_parse_rdf_xml(trunc, nthreads=4) is None
+    ok = trunc + "</rdf:RDF>"
+    r = bulk_parse_rdf_xml(ok, nthreads=4)
+    assert r is not None and len(r[0]) == 500
