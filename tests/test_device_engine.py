@@ -457,3 +457,33 @@ def test_device_order_by_string_key_falls_back():
     } ORDER BY ?d ?e LIMIT 9"""
     dev, host = run_both(db, q)
     assert dev == host
+
+
+def test_pallas_join_two_var_key_agreement(monkeypatch):
+    """Two-variable join keys ride the Pallas kernel via a dense-rank
+    prepass (u64 pack -> union rank -> u32 kernel); rows must equal the
+    host engine and the XLA formulation.  The data makes the triangle
+    genuinely match (same-org knows edges) AND contain non-matches
+    (cross-org edges) so the agreement is non-vacuous both ways."""
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    db = SparqlDatabase()
+    lines = []
+    for i in range(150):
+        e = f"<http://e/p{i}>"
+        # same-org edge (orgs repeat every 9): matches unless the mod-150
+        # wrap crosses an org boundary
+        lines.append(f"{e} <http://e/knows> <http://e/p{(i + 9) % 150}> .")
+        lines.append(f"{e} <http://e/org> <http://e/org{i % 9}> .")
+        if i % 5 == 0:  # cross-org edge: must be filtered by the join
+            lines.append(f"{e} <http://e/knows> <http://e/p{(i + 1) % 150}> .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+    q = (
+        "SELECT ?a ?b ?w WHERE { ?a <http://e/knows> ?b . "
+        "?a <http://e/org> ?w . ?b <http://e/org> ?w }"
+    )
+    dev, host = run_both(db, q)
+    assert len(dev) == 141  # 150 same-org edges minus 9 org-crossing wraps
+    assert sorted(dev) == sorted(host)
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "0")
+    assert sorted(execute_query_volcano(q, db)) == sorted(dev)
