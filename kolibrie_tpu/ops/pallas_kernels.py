@@ -370,6 +370,28 @@ def merge_join_indices(
     return li, ri, valid_o, total
 
 
+@partial(jax.jit, static_argnames=("cap",))
+def ranked_merge_join_indices(
+    lkey: jnp.ndarray, rkey: jnp.ndarray, cap: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pallas merge join for ARBITRARY (u64-packed, unsorted) key columns:
+    dense-rank both sides over their sorted union into u32 (equal keys ⇔
+    equal ranks; distinct sentinels stay distinct), sort the right ranks,
+    run the tile kernel, and map ``ri`` back through the sort permutation.
+    Same ``(li, ri, valid, total)`` contract as
+    :func:`kolibrie_tpu.ops.device_join.join_indices`, with outputs sliced
+    to exactly ``cap``.  Shared by the device query engine's non-presorted
+    joins and the device fixpoint's premise joins."""
+    union_sorted = jnp.sort(jnp.concatenate([lkey, rkey]))
+    lrank = jnp.searchsorted(union_sorted, lkey).astype(jnp.uint32)
+    rrank = jnp.searchsorted(union_sorted, rkey).astype(jnp.uint32)
+    rorder = jnp.argsort(rrank)
+    li, rpos, valid, total = merge_join_indices(lrank, rrank[rorder], cap)
+    li, rpos, valid = li[:cap], rpos[:cap], valid[:cap]
+    ri = jnp.where(valid, rorder[rpos], 0)
+    return li, ri, valid, total
+
+
 def _xla_merge_join(lkey, lval, rkey, rval, cap):
     """Pure-XLA fallback for inputs too large for whole-array VMEM residency
     (same contract as :func:`merge_join`)."""

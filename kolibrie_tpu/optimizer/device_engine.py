@@ -257,28 +257,17 @@ def _plan_body(
                     lkey, rkey, node.cap
                 )
             elif use_pallas:
-                # unsorted / two-variable keys still ride the tile kernel:
-                # dense-rank the packed u64 keys over their union into u32
-                # (sentinel ranks stay distinct, so padding never joins
-                # padding), sort the right ranks, then the same Pallas
-                # merge join; ri maps back through the sort permutation
-                from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
+                # unsorted / two-variable keys still ride the tile kernel
+                # via the dense-rank prepass (see ranked_merge_join_indices)
+                from kolibrie_tpu.ops.pallas_kernels import (
+                    ranked_merge_join_indices,
+                )
 
                 lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
                 rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
-                union_sorted = jnp.sort(jnp.concatenate([lkey, rkey]))
-                lrank = jnp.searchsorted(union_sorted, lkey).astype(jnp.uint32)
-                rrank = jnp.searchsorted(union_sorted, rkey).astype(jnp.uint32)
-                rorder = jnp.argsort(rrank)
-                li, rpos, valid, total = merge_join_indices(
-                    lrank, rrank[rorder], node.cap
+                li, ri, valid, total = ranked_merge_join_indices(
+                    lkey, rkey, node.cap
                 )
-                li, rpos, valid = (
-                    li[: node.cap],
-                    rpos[: node.cap],
-                    valid[: node.cap],
-                )
-                ri = jnp.where(valid, rorder[rpos], 0)
             else:
                 lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
                 rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)

@@ -332,14 +332,24 @@ def _eval_negs(rule, table, valid, facts):
     return valid
 
 
-def _gen_candidates(rules, fcols, fvalid, dcols, dvalid, masks, J):
+def _gen_candidates(
+    rules, fcols, fvalid, dcols, dvalid, masks, J, use_pallas=False
+):
     """Candidate conclusions of one semi-naive round: delta-seeded premise
     joins + filters + NAF over a FROZEN fact snapshot, as static-cap column
     blocks.  Shared by the one-dispatch fixpoint (inside its ``while_loop``)
-    and the per-round chunk program (:func:`_device_round_chunk`)."""
+    and the per-round chunk program (:func:`_device_round_chunk`).
+
+    ``use_pallas``: premise joins ride the Pallas tile kernel through the
+    dense-rank prepass (the engine's production join on TPU) instead of
+    the XLA searchsorted expansion.
+    """
     import jax.numpy as jnp
 
     from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices
+
+    if use_pallas:
+        from kolibrie_tpu.ops.pallas_kernels import ranked_merge_join_indices
 
     facts = (*fcols, fvalid)
     overflow = np.int32(0)
@@ -355,7 +365,12 @@ def _gen_candidates(rules, fcols, fvalid, dcols, dvalid, masks, J):
                 kv = keys[step]
                 lkey = _pack([table[v] for v in kv], valid, _LPAD)
                 rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
-                li, ri, jvalid, total = join_indices(lkey, rkey, J)
+                if use_pallas:
+                    li, ri, jvalid, total = ranked_merge_join_indices(
+                        lkey, rkey, J
+                    )
+                else:
+                    li, ri, jvalid, total = join_indices(lkey, rkey, J)
                 overflow = overflow | jnp.where(total > J, np.int32(1), 0)
                 new_table = {}
                 for v, c in table.items():
@@ -383,7 +398,7 @@ def _gen_candidates(rules, fcols, fvalid, dcols, dvalid, masks, J):
     return cs, cp, co, cv, overflow
 
 
-@partial(jax.jit, static_argnames=("rules", "caps"))
+@partial(jax.jit, static_argnames=("rules", "caps", "use_pallas"))
 def _device_fixpoint(
     rules: tuple,
     caps: _Caps,
@@ -392,6 +407,7 @@ def _device_fixpoint(
     fo,
     n_facts,
     masks,
+    use_pallas: bool = False,
 ):
     """Run semi-naive rounds to fixpoint (or capacity overflow) on device.
 
@@ -425,7 +441,8 @@ def _device_fixpoint(
         fs, fp, fo, fvalid, n_facts, ds, dp, do, dvalid, n_new, rounds, _ovf = carry
 
         cs, cp, co, cv, overflow = _gen_candidates(
-            rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J
+            rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J,
+            use_pallas,
         )
 
         # dedup + subtract known facts (fused membership: rank (s,p), pack o)
@@ -507,7 +524,7 @@ def _device_fixpoint(
     return out[0], out[1], out[2], out[4], out[10], code
 
 
-@partial(jax.jit, static_argnames=("rules", "caps"))
+@partial(jax.jit, static_argnames=("rules", "caps", "use_pallas"))
 def _device_round_chunk(
     rules: tuple,
     caps: _Caps,
@@ -524,6 +541,7 @@ def _device_round_chunk(
     acco,
     n_acc,
     masks,
+    use_pallas: bool = False,
 ):
     """One delta CHUNK of one semi-naive round as its own XLA program.
 
@@ -550,7 +568,8 @@ def _device_round_chunk(
     dvalid = jnp.arange(ds.shape[0], dtype=jnp.int32) < n_delta
 
     cs, cp, co, cv, overflow = _gen_candidates(
-        rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J
+        rules, (fs, fp, fo), fvalid, (ds, dp, do), dvalid, masks, J,
+        use_pallas,
     )
 
     # subtract known facts AND rows already accumulated by earlier chunks
@@ -639,9 +658,12 @@ class DeviceFixpoint:
                 ]
             )
 
+        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
         with jax.enable_x64(True):
             return _device_fixpoint(
-                self.rules, caps, pad(s), pad(p), pad(o), jnp.int32(n0), masks
+                self.rules, caps, pad(s), pad(p), pad(o), jnp.int32(n0), masks,
+                pallas_join_enabled(),
             )
 
     def infer(self, max_attempts: int = 12, initial_caps: Optional[_Caps] = None) -> int:
@@ -670,10 +692,13 @@ class DeviceFixpoint:
                     )
                 return x.astype(jnp.uint32)
 
+            from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
             fs, fp, fo = pad(fs), pad(fp), pad(fo)
             with jax.enable_x64(True):
                 ofs, ofp, ofo, on, rounds, code = _device_fixpoint(
-                    self.rules, caps, fs, fp, fo, n_facts, masks
+                    self.rules, caps, fs, fp, fo, n_facts, masks,
+                    pallas_join_enabled(),
                 )
             code = int(code)
             if code == 0:
@@ -747,7 +772,11 @@ class DeviceFixpoint:
             # parameter on warm retraces, which the dispatch fast path
             # fails to feed once two capacity keys coexist (observed on
             # jax 0.9: "Executable expected parameter 0 of size 4...").
-            return _device_round_chunk(self.rules, caps, *dyn)
+            from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
+            return _device_round_chunk(
+                self.rules, caps, *dyn, use_pallas=pallas_join_enabled()
+            )
 
         on_tpu = jax.default_backend() == "tpu"
         # all powers of two (user values rounded up), so chunk offsets stay

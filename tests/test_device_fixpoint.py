@@ -298,3 +298,31 @@ def test_idempotent_on_closed_set():
         )
     )
     assert infer_semi_naive_device(r) == 0
+
+
+def test_fixpoint_pallas_join_route(monkeypatch):
+    """Forced Pallas premise joins (dense-rank + tile kernel, interpret
+    mode off-TPU) must reach the same closure as the XLA formulation and
+    the host reasoner."""
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    def build():
+        r = Reasoner()
+        for i in range(40):
+            r.add_abox_triple(f"n{i}", "edge", f"n{(i + 1) % 40}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "edge", "?y"), ("?y", "edge", "?z")],
+                [("?x", "hop2", "?z")],
+            )
+        )
+        return r
+
+    r_dev = build()
+    derived = DeviceFixpoint(r_dev).infer()
+    r_host = build()
+    r_host.infer_new_facts_semi_naive()
+    assert derived == 40
+    assert r_dev.facts.triples_set() == r_host.facts.triples_set()
