@@ -161,12 +161,16 @@ def main():
 
     # ---- Pallas vs XLA join formulation on the SAME engine plan ----------
     # (the default path picked above is Pallas on TPU / XLA elsewhere; the
-    # toggle is a static jit arg, so each setting compiles separately)
-    os.environ["KOLIBRIE_PALLAS_JOIN"] = "0"
-    _, xla_tk = time_amortized(max(5, n_dispatch // 3))
-    os.environ["KOLIBRIE_PALLAS_JOIN"] = "1"
-    _, pallas_tk = time_amortized(max(5, n_dispatch // 3))
-    del os.environ["KOLIBRIE_PALLAS_JOIN"]
+    # toggle is a static jit arg, so each setting compiles separately.)
+    # TPU-only: off-TPU "Pallas" is the interpreter — meaninglessly slow.
+    if platform == "tpu":
+        os.environ["KOLIBRIE_PALLAS_JOIN"] = "0"
+        _, xla_tk = time_amortized(max(5, n_dispatch // 3))
+        os.environ["KOLIBRIE_PALLAS_JOIN"] = "1"
+        _, pallas_tk = time_amortized(max(5, n_dispatch // 3))
+        del os.environ["KOLIBRIE_PALLAS_JOIN"]
+    else:
+        xla_tk = pallas_tk = float("nan")
 
     # ---- correctness AFTER timing (readback poisons later dispatches) ----
     rows = prep.fetch(out)
@@ -191,9 +195,15 @@ def main():
                     "single_dispatch_triples_per_sec": round(N_TRIPLES / dev_t, 1),
                     "host_engine_exec_ms": round(1000 * host_exec, 3),
                     "host_e2e_ms": round(1000 * host_e2e, 2),
-                    "pallas_join_exec_ms": round(1000 * pallas_tk, 4),
-                    "xla_join_exec_ms": round(1000 * xla_tk, 4),
-                    "pallas_vs_xla_join": round(xla_tk / pallas_tk, 3),
+                    "pallas_join_exec_ms": (
+                        round(1000 * pallas_tk, 4) if platform == "tpu" else None
+                    ),
+                    "xla_join_exec_ms": (
+                        round(1000 * xla_tk, 4) if platform == "tpu" else None
+                    ),
+                    "pallas_vs_xla_join": (
+                        round(xla_tk / pallas_tk, 3) if platform == "tpu" else None
+                    ),
                     "rows": len(rows),
                     "bulk_load_s": round(t_load, 3),
                     "note": "public-API prepared query: SPARQL parse + "

@@ -619,3 +619,118 @@ def test_rdfxml_truncated_document_rejected():
     ok = trunc + "</rdf:RDF>"
     r = bulk_parse_rdf_xml(ok, nthreads=4)
     assert r is not None and len(r[0]) == 500
+
+
+def test_parser_parity_fuzz():
+    """Randomized documents through native AND Python parsers must agree
+    triple-for-triple (or the native path must decline).  Seeded RNG keeps
+    failures reproducible."""
+    import random
+
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    rng = random.Random(20260730)
+    iri_pool = [f"http://fz.example/r{i}" for i in range(30)]
+    pfx_pool = ["a", "zz", "p-x", "d.t"]
+
+    def rnd_literal():
+        kind = rng.randrange(5)
+        body = "".join(
+            rng.choice(["x", "y", " ", "\\t", "\\n", "\\\"", "é", "&", "7"])
+            for _ in range(rng.randrange(0, 6))
+        )
+        if kind == 0:
+            return f'"{body}"'
+        if kind == 1:
+            return f'"{body}"@en-GB'
+        if kind == 2:
+            return f'"{body}"^^<http://www.w3.org/2001/XMLSchema#string>'
+        if kind == 3:
+            return str(rng.randrange(-50, 5000))
+        return rng.choice(["3.25", "1.5e2", "true", "false"])
+
+    def turtle_doc():
+        lines = [f"@prefix {p}: <http://fz.example/{p}#> ." for p in pfx_pool]
+        for _ in range(rng.randrange(1, 25)):
+            s = (
+                f"<{rng.choice(iri_pool)}>"
+                if rng.random() < 0.5
+                else f"{rng.choice(pfx_pool)}:l{rng.randrange(9)}"
+            )
+            parts = []
+            for _ in range(rng.randrange(1, 4)):
+                pred = (
+                    "a"
+                    if rng.random() < 0.15
+                    else f"{rng.choice(pfx_pool)}:p{rng.randrange(6)}"
+                )
+                objs = ", ".join(
+                    (
+                        f"<{rng.choice(iri_pool)}>"
+                        if rng.random() < 0.4
+                        else (rnd_literal() if pred != "a" else f"{rng.choice(pfx_pool)}:C")
+                    )
+                    for _ in range(rng.randrange(1, 3))
+                )
+                parts.append(f"{pred} {objs}")
+            lines.append(f"{s} " + " ;\n    ".join(parts) + " .")
+        return "\n".join(lines)
+
+    def load_both(doc, parse_name, native_attr):
+        def one(native):
+            db = SparqlDatabase()
+            if not native:
+                setattr(db, native_attr, lambda d: None)
+            try:
+                getattr(db, parse_name)(doc)
+            except Exception as e:
+                return ("error", type(e).__name__)
+            return (
+                "ok",
+                frozenset(
+                    tuple(db.dictionary.decode(x) for x in t)
+                    for t in db.store.triples_set()
+                ),
+            )
+
+        return one(True), one(False)
+
+    for trial in range(40):
+        doc = turtle_doc()
+        got, want = load_both(doc, "parse_turtle", "_parse_turtle_native")
+        assert got == want, (trial, doc[:400], got[0], want[0])
+
+    rdfns = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+
+    def xml_doc():
+        parts = [
+            f'<rdf:RDF xmlns:rdf="{rdfns}" '
+            + " ".join(
+                f'xmlns:{p}="http://fz.example/{p}#"'
+                for p in ("a", "zz")
+            )
+            + ">"
+        ]
+        for i in range(rng.randrange(1, 15)):
+            tagpfx = rng.choice(["rdf:Description", "a:T", "zz:Node"])
+            attrs = f' rdf:about="{rng.choice(iri_pool)}"'
+            if rng.random() < 0.3:
+                attrs += f' a:lit="v&amp;{i}"'
+            props = []
+            for _ in range(rng.randrange(0, 3)):
+                p = f"{rng.choice(['a', 'zz'])}:p{rng.randrange(5)}"
+                r = rng.random()
+                if r < 0.4:
+                    props.append(f'<{p} rdf:resource="{rng.choice(iri_pool)}"/>')
+                elif r < 0.6:
+                    props.append(f'<{p} xml:lang="fr">txt {i}</{p}>')
+                else:
+                    props.append(f"<{p}>v&lt;{i}&gt;</{p}>")
+            parts.append(f"<{tagpfx}{attrs}>" + "".join(props) + f"</{tagpfx.split()[0]}>")
+        parts.append("</rdf:RDF>")
+        return "\n".join(parts)
+
+    for trial in range(40):
+        doc = xml_doc()
+        got, want = load_both(doc, "parse_rdf", "_parse_rdf_native")
+        assert got == want, (trial, doc[:400], got[0], want[0])
