@@ -60,6 +60,21 @@ class OperationMode:
 class CrossWindowReasoningMode:
     INCREMENTAL = "incremental"
     NAIVE = "naive"
+    # AUTO picks per cycle: incremental maintenance when the fraction of
+    # window content not seen last cycle is small, full recomputation
+    # otherwise.  The reference offers only a static choice
+    # (rsp_engine.rs CrossWindowReasoningMode); the measured crossover
+    # makes the per-cycle decision automatic here.
+    AUTO = "auto"
+
+
+# AUTO threshold.  Measured sweep (benches/bench_cross_window.py +
+# bench_family_tree.py, recorded in PERF_r03.md): incremental wins
+# 1.4-2x at 1-2% updates and is break-even at the 10% points (speedup
+# 0.89-1.05), losing badly by 50%.  0.08 sits just under the measured
+# break-even; points between 10% and 50% were not measured, so the
+# threshold is conservative rather than interpolated.
+_AUTO_MAX_CHURN = 0.08
 
 
 @dataclass
@@ -556,11 +571,45 @@ class RSPEngine:
             sds.static_graphs["urn:kolibrie:static:"] = static_triples
         return sds
 
+    def _auto_mode(self, sds) -> str:
+        """Per-cycle mode choice for AUTO: measure churn (window content
+        unseen last cycle) against the crossover threshold.  A naive cycle
+        clears the incremental state; re-entering incremental from empty
+        state pays one full provenance recompute (semantically identical
+        to naive — the agreement tests start incremental from empty) and
+        then resumes cheap maintenance.
+
+        Cost note: the snapshot walk is O(window contents) per cycle —
+        the same order as ``_build_sds``'s unconditional SDS rebuild that
+        every mode already pays; incremental's savings are in the
+        REASONING, which dominates both."""
+        # identity EXCLUDES event_time: a re-observed triple with a newer
+        # timestamp is an expiry improvement, which incremental maintenance
+        # handles cheaply — only genuinely new content counts as churn
+        cur = frozenset(
+            (iri, wt.subject, wt.predicate, wt.object)
+            for iri, wd in sds.windows.items()
+            for wt in wd.triples
+        )
+        prev = getattr(self, "_auto_prev_alive", None)
+        self._auto_prev_alive = cur
+        if prev is None or not cur:
+            return CrossWindowReasoningMode.INCREMENTAL
+        churn = len(cur - prev) / len(cur)
+        return (
+            CrossWindowReasoningMode.INCREMENTAL
+            if churn <= _AUTO_MAX_CHURN
+            else CrossWindowReasoningMode.NAIVE
+        )
+
     def _emit_cross_window(self, ts: int) -> None:
         """SDS+ cycle + per-window plans over derived buckets
         (emit_cross_window_results, rsp_engine.rs:1059-1112)."""
         sds = self._build_sds()
-        if self.cross_window_mode == CrossWindowReasoningMode.INCREMENTAL:
+        mode = self.cross_window_mode
+        if mode == CrossWindowReasoningMode.AUTO:
+            mode = self._auto_mode(sds)
+        if mode == CrossWindowReasoningMode.INCREMENTAL:
             new_state = incremental_sds_plus(
                 self.cross_window_rules, sds, self._sds_plus_state, self.dictionary, ts
             )
@@ -569,6 +618,7 @@ class RSPEngine:
                 new_state, self.dictionary, all_component_iris(sds)
             )
         else:
+            self._sds_plus_state = {}  # stale for any later incremental cycle
             buckets = naive_sds_plus(
                 self.cross_window_rules, sds, self.dictionary, ts
             )

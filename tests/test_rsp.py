@@ -575,3 +575,59 @@ WHERE {
         # the restored engine derives the same alert rows going forward
         vals = lambda rows: [dict(r).get("v") for r in rows]  # noqa: E731
         assert vals(part1 + part2) == vals(ref)
+
+
+class TestAutoCrossWindowMode:
+    """AUTO picks incremental/naive per cycle from observed churn; its
+    emitted rows must equal the pure-NAIVE engine on the same stream."""
+
+    def _engine(self, mode, sink):
+        return (
+            RSPBuilder(TestCrossWindowCheckpoint.QUERY)
+            .set_cross_window_rules(TestCrossWindowCheckpoint.RULES)
+            .set_cross_window_reasoning_mode(mode)
+            .with_consumer(lambda row: sink.append(row))
+            .build()
+        )
+
+    def test_auto_agrees_with_naive(self):
+        def drive(engine):
+            # phase 1: slowly-evolving stream (low churn -> incremental)
+            for ts in range(1, 7):
+                engine.add_to_stream(
+                    "http://e/tempStream", WindowTriple("r1", "hot", '"42"'), ts
+                )
+                engine.add_to_stream(
+                    "http://e/humStream", WindowTriple("r1", "humid", '"x"'), ts
+                )
+                engine.process_single_thread_window_results()
+            # phase 2: burst of new content (high churn -> naive)
+            for ts in range(7, 10):
+                for k in range(6):
+                    engine.add_to_stream(
+                        "http://e/tempStream",
+                        WindowTriple(f"r{k}", "hot", f'"{k}"'),
+                        ts,
+                    )
+                    engine.add_to_stream(
+                        "http://e/humStream",
+                        WindowTriple(f"r{k}", "humid", '"x"'),
+                        ts,
+                    )
+                engine.process_single_thread_window_results()
+            engine.process_single_thread_window_results()
+
+        auto_rows, naive_rows = [], []
+        e_auto = self._engine(CrossWindowReasoningMode.AUTO, auto_rows)
+        decisions = []
+        orig = e_auto._auto_mode
+        e_auto._auto_mode = lambda sds: decisions.append(orig(sds)) or decisions[-1]
+        drive(e_auto)
+        e_naive = self._engine(CrossWindowReasoningMode.NAIVE, naive_rows)
+        drive(e_naive)
+        assert auto_rows and auto_rows == naive_rows
+        # BOTH branches must have been chosen: incremental in the steady
+        # phase, naive on the burst — a threshold regression that pins one
+        # mode would otherwise pass (the modes agree semantically)
+        assert CrossWindowReasoningMode.INCREMENTAL in decisions, decisions
+        assert CrossWindowReasoningMode.NAIVE in decisions, decisions
