@@ -255,8 +255,14 @@ def _pallas_join_core(
         out_specs=[out_block] * 4,
         scratch_shapes=[pltpu.VMEM((2 * BW, _NCOLS), jnp.int32)],
     )
+    # Inside a shard_map body (jax>=0.9 check_vma) the kernel's outputs
+    # must declare how they vary across mesh axes; propagate the operand's
+    # varying-mesh-axes set (empty outside shard_map).
+    vma = getattr(jax.typeof(lkey_u), "vma", None)
+    kwargs = {"vma": vma} if vma else {}
     out_shape = [
-        jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32) for _ in range(4)
+        jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32, **kwargs)
+        for _ in range(4)
     ]
     key_o, lval_o, pos_o, valid_o = pl.pallas_call(
         _merge_join_kernel,

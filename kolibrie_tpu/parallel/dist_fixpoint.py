@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kolibrie_tpu.core.rule import Rule
 from kolibrie_tpu.core.terms import Term
 from kolibrie_tpu.parallel.dist_join import (
+    _dist_check_vma,
     exchange,
     local_join_u32,
     shard_of_dev,
@@ -340,6 +341,7 @@ class DistributedReasoner:
             jax.shard_map(
                 lambda *state: body(state),
                 mesh=mesh,
+                check_vma=_dist_check_vma(),
                 in_specs=(spec,) * 12,
                 out_specs=((spec,) * 12, P(self.axis), P(self.axis)),
             )

@@ -48,6 +48,7 @@ from kolibrie_tpu.core.rule import Rule
 from kolibrie_tpu.ops import round_cap
 from kolibrie_tpu.parallel.dist_fixpoint import _append_rows, _member3, _sort_unique3
 from kolibrie_tpu.parallel.dist_join import (
+    _dist_check_vma,
     _LPAD32,
     exchange,
     local_join_u32,
@@ -338,6 +339,7 @@ class DistGeneralReasoner:
             jax.shard_map(
                 lambda state, masks: body(state, masks),
                 mesh=self.mesh,
+                check_vma=_dist_check_vma(),
                 in_specs=((spec,) * 12, (rep,) * n_masks),
                 out_specs=((spec,) * 12, P(self.axis), P(self.axis)),
             )

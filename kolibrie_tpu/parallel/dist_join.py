@@ -44,6 +44,27 @@ def shard_of_dev(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
     return (mix32(key) % np.uint32(n_shards)).astype(jnp.int32)
 
 
+def dist_pallas_enabled() -> bool:
+    """Opt-in (KOLIBRIE_PALLAS_DIST=1): route the distributed rounds'
+    shard-local joins through the Pallas tile kernel.  EXPERIMENTAL —
+    read at TRACE time, so it must be set before the first round program
+    of a process is built (the compiled-program caches do not key on it);
+    default off everywhere until shard_map+Pallas composition is
+    validated on real hardware (see COVERAGE.md "remaining gaps")."""
+    import os
+
+    return os.environ.get("KOLIBRIE_PALLAS_DIST") == "1"
+
+
+def _dist_check_vma() -> bool:
+    """shard_map's varying-mesh-axes checking (jax>=0.9 default) rejects
+    ``pallas_call`` bodies (``dynamic_slice`` vma mismatch raised from the
+    kernel's internal machinery, with jax's own error message suggesting
+    ``check_vma=False``) — disable it exactly when the experimental dist
+    Pallas route is on; all XLA-only programs keep the check."""
+    return not dist_pallas_enabled()
+
+
 def local_join_u32(
     lkey: jnp.ndarray,
     rkey: jnp.ndarray,
@@ -52,6 +73,8 @@ def local_join_u32(
     rvalid: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """32-bit static-shape equi-join (see device_join.join_indices)."""
+    if dist_pallas_enabled():
+        return _local_join_u32_pallas(lkey, rkey, cap, lvalid, rvalid)
     lkey = jnp.where(lvalid, lkey.astype(jnp.uint32), _LPAD32)
     rkey = jnp.where(rvalid, rkey.astype(jnp.uint32), _RPAD32)
     ln, rn = lkey.shape[0], rkey.shape[0]
@@ -74,6 +97,31 @@ def local_join_u32(
     li = jnp.where(valid, row_c, 0).astype(jnp.int32)
     ri = jnp.where(valid, order[jnp.clip(pos, 0, rn - 1)], 0).astype(jnp.int32)
     return li, ri, valid, total
+
+
+def _local_join_u32_pallas(
+    lkey: jnp.ndarray,
+    rkey: jnp.ndarray,
+    cap: int,
+    lvalid: jnp.ndarray,
+    rvalid: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`local_join_u32` via the Pallas tile kernel: sort the right
+    keys once, run the merge-join kernel, map ``ri`` back through the sort
+    permutation.  Same ``(li, ri, valid, total)`` contract; u32 keys need
+    no dense-rank prepass."""
+    from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
+
+    lk = jnp.where(lvalid, lkey.astype(jnp.uint32), _LPAD32)
+    rk = jnp.where(rvalid, rkey.astype(jnp.uint32), _RPAD32)
+    if lk.shape[0] == 0 or rk.shape[0] == 0:
+        z = jnp.zeros(cap, dtype=jnp.int32)
+        return z, z, jnp.zeros(cap, dtype=bool), jnp.int32(0)
+    rorder = jnp.argsort(rk)
+    li, rpos, valid, total = merge_join_indices(lk, rk[rorder], cap)
+    li, rpos, valid = li[:cap], rpos[:cap], valid[:cap]
+    ri = jnp.where(valid, rorder[rpos], 0).astype(jnp.int32)
+    return li, ri, valid, total.astype(jnp.int32)
 
 
 def bucketize(
@@ -177,6 +225,7 @@ def _equi_join_fn(mesh, nl, nr, lkey_i, rkey_i, bucket_cap, out_cap):
         jax.shard_map(
             body,
             mesh=mesh,
+            check_vma=_dist_check_vma(),
             in_specs=(
                 (spec_cols,) * nl,
                 spec_cols,

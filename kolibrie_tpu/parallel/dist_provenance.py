@@ -53,6 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kolibrie_tpu.ops import round_cap
 from kolibrie_tpu.parallel.dist_fixpoint import _bsearch, _member3
 from kolibrie_tpu.parallel.dist_join import (
+    _dist_check_vma,
     _LPAD32,
     _RPAD32,
     exchange,
@@ -484,6 +485,7 @@ class DistProvenanceReasoner:
             jax.shard_map(
                 lambda state, masks, one: body(state, masks, one),
                 mesh=self.mesh,
+                check_vma=_dist_check_vma(),
                 in_specs=((spec,) * 15, (rep,) * n_masks, P(self.axis)),
                 out_specs=((spec,) * 15, P(self.axis), P(self.axis)),
             )

@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kolibrie_tpu.ops import round_cap
 from kolibrie_tpu.parallel.dist_general import _exchange_table, _plan_rule_dist
-from kolibrie_tpu.parallel.dist_join import local_join_u32
+from kolibrie_tpu.parallel.dist_join import _dist_check_vma, local_join_u32
 from kolibrie_tpu.parallel.sharded_store import ShardedTripleStore
 from kolibrie_tpu.query import ast as A
 from kolibrie_tpu.reasoner.device_fixpoint import (
@@ -248,6 +248,7 @@ def _query_fn(mesh, premises, seed, steps, filters, out_vars, n_masks, join_cap,
         jax.shard_map(
             lambda state, masks: body(state, masks),
             mesh=mesh,
+            check_vma=_dist_check_vma(),
             in_specs=((spec,) * 8, (P(),) * n_masks),
             out_specs=(
                 (spec,) * len(out_vars),

@@ -737,6 +737,9 @@ class RSPEngine:
                 k: [(_ckpt_decode(t), ts) for t, ts in v]
                 for k, v in state["latest_contents"].items()
             }
+            # AUTO churn baseline is post-checkpoint transient state — a
+            # stale baseline would mis-classify the first restored cycle
+            self._auto_prev_alive = None
 
     # ----------------------------------------------------------------- misc
 

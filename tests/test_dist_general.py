@@ -281,3 +281,60 @@ def test_predicate_position_join_unsupported(mesh):
     )
     with pytest.raises(Unsupported):
         lower_rules_dist(r, r.rules)
+
+
+def test_dist_pallas_join_composition():
+    """KOLIBRIE_PALLAS_DIST=1: the shard-local joins run through the
+    Pallas kernel INSIDE shard_map (interpret mode on the CPU mesh).
+    Subprocess-isolated: the flag is read at trace time and the compiled
+    round programs are cached per process."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["KOLIBRIE_PALLAS_DIST"] = "1"
+import jax; jax.config.update("jax_platforms", "cpu")
+import kolibrie_tpu.parallel.dist_join as dj
+from kolibrie_tpu.parallel import DistGeneralReasoner, make_mesh
+from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+# trace-time marker: the kernel route must ACTUALLY be taken — a silent
+# fallback to the XLA join would still produce agreeing closures
+_pallas_calls = []
+_orig = dj._local_join_u32_pallas
+dj._local_join_u32_pallas = (
+    lambda *a, **k: (_pallas_calls.append(1), _orig(*a, **k))[1]
+)
+
+def build():
+    r = Reasoner()
+    for i in range(16):
+        r.add_abox_triple(f"s{i}", "knows", f"s{(i + 3) % 16}")
+    r.add_rule(r.rule_from_strings(
+        [("?x", "knows", "?y"), ("?y", "knows", "?z")],
+        [("?x", "fof", "?z")]))
+    return r
+
+d, h = build(), build()
+DistGeneralReasoner(make_mesh(8), d, fact_cap=128, delta_cap=64,
+                    join_cap=64, bucket_cap=32).infer()
+h.infer_new_facts_semi_naive()
+assert d.facts.triples_set() == h.facts.triples_set()
+assert _pallas_calls, "Pallas local-join route was never traced"
+print("DIST_PALLAS_OK")
+"""
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=480,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "DIST_PALLAS_OK" in proc.stdout
