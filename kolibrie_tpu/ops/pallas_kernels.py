@@ -255,9 +255,13 @@ def _pallas_join_core(
         out_specs=[out_block] * 4,
         scratch_shapes=[pltpu.VMEM((2 * BW, _NCOLS), jnp.int32)],
     )
-    # Inside a shard_map body (jax>=0.9 check_vma) the kernel's outputs
+    # Inside a shard_map body with vma checking ON, the kernel's outputs
     # must declare how they vary across mesh axes; propagate the operand's
-    # varying-mesh-axes set (empty outside shard_map).
+    # varying-mesh-axes set (empty outside shard_map).  NOTE: the dist
+    # callers currently run with check_vma=False (jax's checker still
+    # rejects the kernel's internal dynamic_slice), making this branch
+    # dormant — it exists so the escape hatch can be dropped the moment
+    # jax accepts pallas_call under vma checking.
     vma = getattr(jax.typeof(lkey_u), "vma", None)
     kwargs = {"vma": vma} if vma else {}
     out_shape = [

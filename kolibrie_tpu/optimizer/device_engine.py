@@ -784,17 +784,7 @@ class LoweredPlan:
         return spec, (order_arrays, scalars, masks, values, numf)
 
     def _device_numf(self):
-        import jax
-        import jax.numpy as jnp
-
-        cache = self.db.__dict__.get("_device_numf_cache")
-        vals = self.db.numeric_values()
-        if cache is not None and cache[0] == len(vals):
-            return cache[1]
-        with jax.enable_x64(True):
-            arr = jnp.asarray(vals, dtype=jnp.float64)
-        self.db.__dict__["_device_numf_cache"] = (len(vals), arr)
-        return arr
+        return device_numf(self.db)
 
     # ------------------------------------------------------- host evaluation
 
@@ -1178,15 +1168,44 @@ def try_device_execute_aggregated(
             return None
         funcs.append(a.func)
 
+    with jax.enable_x64(True):
+        out_cols, valid = lowered.converge(lowered.run())
+    return aggregate_table(
+        db, tuple(out_cols), valid, q.group_by, agg_items, gpos, funcs, apos
+    )
+
+
+def device_numf(db):
+    """Per-database device copy of the numeric-literal table (f64), cached
+    until the dictionary grows — the one cache both the single-chip plan
+    lowering and the distributed aggregate tail read/populate."""
+    import jax.numpy as jnp
+
+    cache = db.__dict__.get("_device_numf_cache")
+    vals = db.numeric_values()
+    if cache is not None and cache[0] == len(vals):
+        return cache[1]
+    with jax.enable_x64(True):
+        arr = jnp.asarray(vals, dtype=jnp.float64)
+    db.__dict__["_device_numf_cache"] = (len(vals), arr)
+    return arr
+
+
+def aggregate_table(
+    db, cols, valid, group_by, agg_items, gpos, funcs, apos
+) -> BindingTable:
+    """Shared aggregate tail: run :func:`_segment_aggregate` with the
+    capacity-retry protocol and decode the per-group results into a host
+    table.  The ONE definition of aggregate readback semantics — used by
+    the single-chip engine and the distributed query executor."""
     from kolibrie_tpu.query.executor import _encode_numbers
 
     cap = 1024
     with jax.enable_x64(True):
-        numf_dev = lowered._device_numf()  # per-db device cache
-        out_cols, valid = lowered.converge(lowered.run())
+        numf_dev = device_numf(db)
         for _attempt in range(8):
             gcols, aggs, n_groups = _segment_aggregate(
-                tuple(out_cols),
+                tuple(cols),
                 valid,
                 numf_dev,
                 tuple(gpos),
@@ -1202,7 +1221,7 @@ def try_device_execute_aggregated(
         else:
             raise RuntimeError("group capacity failed to converge")
     table: BindingTable = {}
-    for g, col in zip(q.group_by, gcols):
+    for g, col in zip(group_by, gcols):
         table[g] = np.asarray(col)[:ng].astype(np.uint32)
     enc = db.dictionary.encode
     for item, arr in zip(agg_items, aggs):

@@ -305,17 +305,42 @@ class DistQueryExecutor:
             or w.minus
         ):
             raise Unsupported("non-BGP clause in WHERE")
-        if q.group_by or (
-            not q.select_all()
-            and any(item.kind != "var" for item in q.select)
-        ):
-            raise Unsupported("aggregates/expressions in SELECT")
         if not w.patterns:
             raise Unsupported("empty BGP")
         resolved = [resolve_pattern(db, p) for p in w.patterns]
         self.premises = tuple(_lower_query_pattern(p) for p in resolved)
         bound = {v for pr in self.premises for v, _ in pr.vars}
-        if q.select_all():
+        # GROUP BY + aggregates (BASELINE config 2 distributed): the plan's
+        # out columns stay mesh-resident and flow into the single-chip
+        # segment aggregator (XLA all-gathers the post-join/post-filter
+        # rows — the aggregation input, not the base data); host reads one
+        # row per group.  GROUP_CONCAT / DISTINCT-on-non-COUNT mirror the
+        # single-chip engine's fallback contract.
+        self.agg_items = [i for i in q.select if i.kind == "agg"]
+        if self.agg_items or q.group_by:
+            for item in self.agg_items:
+                a = item.agg
+                if a.func not in ("COUNT", "SUM", "AVG", "MIN", "MAX", "SAMPLE"):
+                    raise Unsupported(f"aggregate {a.func}")
+                if a.distinct and a.func != "COUNT":
+                    raise Unsupported("DISTINCT on non-COUNT aggregate")
+                if a.var is not None and a.var not in bound:
+                    raise Unsupported(f"aggregate variable unbound: {a.var}")
+            if any(i.kind == "expr" for i in q.select):
+                raise Unsupported("expressions in aggregate SELECT")
+            missing = set(q.group_by) - bound
+            if missing:
+                raise Unsupported(f"group variables unbound: {missing}")
+            # out columns = group vars + every aggregated var
+            need = list(q.group_by) + [
+                i.agg.var
+                for i in self.agg_items
+                if i.agg.var is not None
+            ]
+            self.out_vars = tuple(dict.fromkeys(need)) or tuple(sorted(bound))[:1]
+        elif not q.select_all() and any(i.kind != "var" for i in q.select):
+            raise Unsupported("expressions in SELECT")
+        elif q.select_all():
             self.out_vars = tuple(sorted(bound))
         else:
             self.out_vars = tuple(item.var for item in q.select)
@@ -469,6 +494,44 @@ class DistQueryExecutor:
             self.bucket_cap *= 2
         raise RuntimeError("distributed query capacities failed to converge")
 
+    def _run_aggregated(self) -> List[List[str]]:
+        """GROUP BY/aggregate tail: the mesh-resident result columns flow
+        into the single-chip device segment aggregator (same program the
+        engine uses — one definition of aggregate semantics); readback is
+        one row per group."""
+        from kolibrie_tpu.optimizer.device_engine import aggregate_table
+        from kolibrie_tpu.query.executor import (
+            _apply_limit_offset,
+            _order_table,
+            format_results,
+        )
+
+        q = self.query
+        outs, valid, _total = self.run_device()
+        flat_cols = tuple(jnp.reshape(c, (-1,)) for c in outs)
+        flat_valid = jnp.reshape(valid, (-1,))
+        gpos = [self.out_vars.index(g) for g in q.group_by]
+        funcs, apos = [], []
+        for item in self.agg_items:
+            a = item.agg
+            funcs.append(a.func)
+            apos.append(-1 if a.var is None else self.out_vars.index(a.var))
+        table = aggregate_table(
+            self.db,
+            flat_cols,
+            flat_valid,
+            q.group_by,
+            self.agg_items,
+            gpos,
+            funcs,
+            apos,
+        )
+        table = _order_table(self.db, table, q.order_by)
+        rows = format_results(self.db, table, q)
+        if not q.order_by:
+            rows.sort()
+        return _apply_limit_offset(rows, q)
+
     def run(self) -> List[List[str]]:
         """Execute and return decoded rows identical to the host volcano
         executor (same formatting, ordering, DISTINCT, LIMIT post-passes)."""
@@ -478,6 +541,8 @@ class DistQueryExecutor:
             format_results,
         )
 
+        if self.agg_items or self.query.group_by:
+            return self._run_aggregated()
         outs, valid, _total = self.run_device()
         v = np.asarray(valid).reshape(-1)
         table = {

@@ -126,10 +126,12 @@ def test_unsupported_shapes_raise(mesh, lubm_db):
             "SELECT ?x WHERE { ?x ?p ?y . BIND((1+1) AS ?b) }",
         )
     with pytest.raises(Unsupported):
+        # GROUP_CONCAT stays host-side (same contract as the single-chip
+        # device engine); plain COUNT/SUM/AVG/MIN/MAX are supported
         DistQueryExecutor(
             mesh,
             lubm_db,
-            "SELECT (COUNT(?x) AS ?c) WHERE { ?x ?p ?y }",
+            "SELECT (GROUP_CONCAT(?x) AS ?c) WHERE { ?x ?p ?y }",
         )
 
 
@@ -142,3 +144,38 @@ def test_executor_reuse_and_store_reuse(mesh, lubm_db):
     r9 = ex9.run()
     assert r1 == execute_query_volcano(lubm.LUBM_Q2, lubm_db)
     assert r9 == execute_query_volcano(lubm.LUBM_Q9, lubm_db)
+
+
+def test_group_by_aggregates_agreement(mesh):
+    """Distributed GROUP BY + aggregates: mesh-resident result columns feed
+    the single-chip segment aggregator; rows equal the host engine."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(240):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/dept> <http://example.org/d{i % 6}> ."
+        )
+        lines.append(
+            f'{e} <http://example.org/salary> "{30000 + (i % 40) * 500}" .'
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?d (COUNT(?e) AS ?n) (AVG(?s) AS ?avg) (MAX(?s) AS ?mx) WHERE {
+        ?e ex:dept ?d . ?e ex:salary ?s
+    } GROUP BY ?d"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 6
+    assert dist == host
+    # COUNT(DISTINCT) + filter
+    q2 = """PREFIX ex: <http://example.org/>
+    SELECT ?d (COUNT(DISTINCT ?s) AS ?k) WHERE {
+        ?e ex:dept ?d . ?e ex:salary ?s . FILTER(?s > 40000)
+    } GROUP BY ?d"""
+    assert execute_query_distributed(q2, db, mesh) == execute_query_volcano(q2, db)
+    # aggregate without GROUP BY: exactly one row
+    q3 = """PREFIX ex: <http://example.org/>
+    SELECT (COUNT(?e) AS ?n) WHERE { ?e ex:salary ?s }"""
+    assert execute_query_distributed(q3, db, mesh) == execute_query_volcano(q3, db)
