@@ -10,6 +10,15 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import os  # noqa: E402
+
+import jax  # noqa: E402
+
+# Default to the CPU platform: probing/initializing the default backend
+# hangs when the TPU tunnel is unreachable.  KOLIBRIE_EXAMPLE_TPU=1 runs
+# on the real device instead.
+if not os.environ.get("KOLIBRIE_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from kolibrie_tpu.query.executor import execute_query_volcano  # noqa: E402

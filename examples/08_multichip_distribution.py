@@ -28,6 +28,12 @@ if "host_platform_device_count" not in flags:
 import numpy as np  # noqa: E402
 import jax  # noqa: E402
 
+# Default to the CPU platform: probing/initializing the default backend
+# hangs when the TPU tunnel is unreachable.  KOLIBRIE_EXAMPLE_TPU=1 runs
+# on the real device instead.
+if not os.environ.get("KOLIBRIE_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
 from kolibrie_tpu.parallel.dist_fixpoint import (  # noqa: E402
     DistributedReasoner,
     DistRuleSet,

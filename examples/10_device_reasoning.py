@@ -31,10 +31,10 @@ if "host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-# KOLIBRIE_EXAMPLE_CPU=1 pins the demo to the (virtual-mesh) CPU backend —
-# e.g. when the machine's accelerator tunnel is unavailable; by default the
-# natural backend (the TPU, when present) is used.
-if os.environ.get("KOLIBRIE_EXAMPLE_CPU"):
+# Default to the CPU platform (virtual mesh): initializing the TPU backend
+# hangs when the tunnel is unreachable.  KOLIBRIE_EXAMPLE_TPU=1 runs on the
+# real device instead.
+if not os.environ.get("KOLIBRIE_EXAMPLE_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 from kolibrie_tpu.parallel import DistProvenanceReasoner, make_mesh  # noqa: E402
