@@ -179,3 +179,29 @@ def test_group_by_aggregates_agreement(mesh):
     q3 = """PREFIX ex: <http://example.org/>
     SELECT (COUNT(?e) AS ?n) WHERE { ?e ex:salary ?s }"""
     assert execute_query_distributed(q3, db, mesh) == execute_query_volcano(q3, db)
+
+
+def test_repeated_variable_and_single_pattern(mesh):
+    """Edge shapes: a pattern with a repeated variable (?x p ?x) and a
+    single-pattern query (seed scan only, no join steps)."""
+    db = SparqlDatabase()
+    db.parse_ntriples(
+        "\n".join(
+            [
+                "<http://e/a> <http://e/p> <http://e/a> .",
+                "<http://e/a> <http://e/p> <http://e/b> .",
+                "<http://e/b> <http://e/p> <http://e/b> .",
+                "<http://e/c> <http://e/q> <http://e/c> .",
+                "<http://e/a> <http://e/q> <http://e/b> .",
+            ]
+        )
+    )
+    db.execution_mode = "host"
+    q_rep = "SELECT ?x WHERE { ?x <http://e/p> ?x }"
+    assert execute_query_distributed(q_rep, db, mesh) == execute_query_volcano(
+        q_rep, db
+    ) != []
+    q_one = "SELECT ?s ?o WHERE { ?s <http://e/q> ?o }"
+    assert execute_query_distributed(q_one, db, mesh) == execute_query_volcano(
+        q_one, db
+    ) != []
