@@ -487,3 +487,52 @@ def test_pallas_join_two_var_key_agreement(monkeypatch):
     assert sorted(dev) == sorted(host)
     monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "0")
     assert sorted(execute_query_volcano(q, db)) == sorted(dev)
+
+
+def test_device_query_fuzz():
+    """Randomized BGP+FILTER queries over random data: the device engine
+    (auto-routing, fallbacks included) must agree with the host engine on
+    every query.  Seeded for reproducibility."""
+    import random
+
+    rng = random.Random(20260731)
+    db = SparqlDatabase()
+    lines = []
+    preds = [f"<http://f.e/p{k}>" for k in range(5)]
+    for i in range(400):
+        s = f"<http://f.e/s{rng.randrange(80)}>"
+        pr = rng.choice(preds)
+        if rng.random() < 0.5:
+            o = f"<http://f.e/s{rng.randrange(80)}>"
+        else:
+            o = f'"{rng.randrange(0, 5000)}"'
+        lines.append(f"{s} {pr} {o} .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+
+    vars_pool = ["?a", "?b", "?c", "?d"]
+    for trial in range(30):
+        n_pat = rng.randrange(1, 4)
+        used = []
+        pats = []
+        for _ in range(n_pat):
+            s = rng.choice(used) if used and rng.random() < 0.8 else rng.choice(vars_pool)
+            o = rng.choice(vars_pool + [f"<http://f.e/s{rng.randrange(80)}>"])
+            pr = rng.choice(preds)
+            pats.append(f"{s} {pr} {o} .")
+            for t in (s, o):
+                if t.startswith("?") and t not in used:
+                    used.append(t)
+        filt = ""
+        numeric_vars = [v for v in used]
+        if used and rng.random() < 0.5:
+            v = rng.choice(numeric_vars)
+            op = rng.choice([">", "<", ">=", "<=", "=", "!="])
+            filt = f"FILTER({v} {op} {rng.randrange(0, 5000)})"
+        sel = " ".join(used) if used else "*"
+        q = f"SELECT {sel} WHERE {{ {' '.join(pats)} {filt} }}"
+        try:
+            dev, host = run_both(db, q)
+        except Exception as e:
+            raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
+        assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
