@@ -2,11 +2,12 @@
 # Round-3 hardware capture pipeline (run when the TPU is free):
 #   1. Mosaic fault repros at the gate boundary (pass) and past it (fault)
 #   2. LUBM-1000 full bench suite -> update BENCH_LUBM1000.json by hand
-# Each step is its own process (tunnel readback discipline).
+# Each step is its own process (tunnel readback discipline).  KILL-based
+# timeouts: a hung backend init ignores SIGTERM.
 set -x
 cd /root/repo
-python repros/mosaic_merge_join_rowstart_fault.py 393216   2>&1 | tail -2
-python repros/mosaic_merge_join_rowstart_fault.py 1048576  2>&1 | tail -4
-python repros/mosaic_composed_fixpoint_cap_fault.py 2097152 2>&1 | tail -2
-python repros/mosaic_composed_fixpoint_cap_fault.py 4194304 2>&1 | tail -4
-LUBM_UNIVERSITIES=1000 python benches/bench_lubm.py 2>&1 | tail -30
+timeout -s KILL 600  python repros/mosaic_merge_join_rowstart_fault.py 393216   2>&1 | tail -2
+timeout -s KILL 600  python repros/mosaic_merge_join_rowstart_fault.py 1048576  2>&1 | tail -4
+timeout -s KILL 600  python repros/mosaic_composed_fixpoint_cap_fault.py 2097152 2>&1 | tail -2
+timeout -s KILL 600  python repros/mosaic_composed_fixpoint_cap_fault.py 4194304 2>&1 | tail -4
+LUBM_UNIVERSITIES=1000 timeout -s KILL 3600 python benches/bench_lubm.py 2>&1 | tail -30
