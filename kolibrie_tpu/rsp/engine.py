@@ -661,6 +661,10 @@ class RSPEngine:
         The blob is JSON (``_ckpt_encode``), NOT pickle: checkpoint blobs
         travel over the HTTP API (``/rsp/checkpoint`` → ``/rsp/restore``),
         and unpickling network-supplied bytes is arbitrary code execution.
+
+        Thread-safety: callers must quiesce event pushes for the duration
+        (the HTTP layer holds its per-session push lock); ``_cw_lock``
+        covers only the cross-window state.
         """
         import json
 
