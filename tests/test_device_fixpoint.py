@@ -326,3 +326,62 @@ def test_fixpoint_pallas_join_route(monkeypatch):
     r_host.infer_new_facts_semi_naive()
     assert derived == 40
     assert r_dev.facts.triples_set() == r_host.facts.triples_set()
+
+
+def test_device_fixpoint_fuzz():
+    """Randomized rule sets (chains, stars, constants, multi-head) over
+    random graphs: the device fixpoint must reach exactly the host
+    semi-naive closure, or decline to lower (Unsupported -> skip).
+    Seeded for reproducibility."""
+    import random
+
+    from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint, Unsupported
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    rng = random.Random(20260732)
+    preds = ["p", "q", "r"]
+
+    for trial in range(12):
+        n_nodes = rng.randrange(8, 30)
+        edges = [
+            (f"n{rng.randrange(n_nodes)}", rng.choice(preds), f"n{rng.randrange(n_nodes)}")
+            for _ in range(rng.randrange(15, 60))
+        ]
+
+        def build():
+            r = Reasoner()
+            for s, p, o in edges:
+                r.add_abox_triple(s, p, o)
+            n_rules = rng2_state.pop()
+            for spec in n_rules:
+                r.add_rule(r.rule_from_strings(*spec))
+            return r
+
+        # generate rule specs once per trial (same for both builds)
+        specs = []
+        for _ in range(rng.randrange(1, 4)):
+            shape = rng.randrange(3)
+            p1, p2, p3 = rng.choice(preds), rng.choice(preds), f"d{rng.randrange(3)}"
+            if shape == 0:  # chain
+                specs.append(([("?x", p1, "?y"), ("?y", p2, "?z")], [("?x", p3, "?z")]))
+            elif shape == 1:  # renaming
+                specs.append(([("?x", p1, "?y")], [("?y", p3, "?x")]))
+            else:  # star + multi-head
+                specs.append((
+                    [("?x", p1, "?y"), ("?x", p2, "?z")],
+                    [("?x", p3, "?z"), ("?y", p3, "?x")],
+                ))
+        rng2_state = [specs, list(specs)]
+
+        r_dev = build()
+        try:
+            fx = DeviceFixpoint(r_dev)
+        except Unsupported:
+            continue
+        fx.infer()
+        r_host = build()
+        r_host.infer_new_facts_semi_naive()
+        assert r_dev.facts.triples_set() == r_host.facts.triples_set(), (
+            trial,
+            specs,
+        )
