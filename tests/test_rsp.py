@@ -631,3 +631,43 @@ class TestAutoCrossWindowMode:
         # mode would otherwise pass (the modes agree semantically)
         assert CrossWindowReasoningMode.INCREMENTAL in decisions, decisions
         assert CrossWindowReasoningMode.NAIVE in decisions, decisions
+
+
+class TestMultiThreadMode:
+    """MULTI_THREAD operation: per-window worker threads + the coordinator
+    thread joining latest window results under the sync policy (the
+    reference's threaded rsp_engine tests' regime)."""
+
+    def test_two_window_join_multi_thread(self):
+        import time as _time
+
+        rows = []
+        engine = (
+            RSPBuilder(MULTI_QUERY)
+            .with_consumer(lambda row: rows.append(dict(row)))
+            .set_operation_mode(OperationMode.MULTI_THREAD)
+            .set_sync_policy(SyncPolicy(SyncPolicyKind.STEAL))
+            .build()
+        )
+        try:
+            for ts in range(1, 6):
+                engine.add_to_stream(
+                    "http://e/tempStream",
+                    WindowTriple("<http://e/room1>", "<http://e/temp>", '"21"'),
+                    ts,
+                )
+                engine.add_to_stream(
+                    "http://e/humStream",
+                    WindowTriple("<http://e/room1>", "<http://e/hum>", '"60"'),
+                    ts,
+                )
+            # worker + coordinator threads drain asynchronously
+            deadline = _time.time() + 10
+            while not rows and _time.time() < deadline:
+                _time.sleep(0.05)
+        finally:
+            engine.stop()
+        assert rows, "multi-thread coordinator emitted nothing in 10s"
+        row = rows[0]
+        assert row["room"] == "http://e/room1"
+        assert row["temp"] == "21" and row["hum"] == "60"
