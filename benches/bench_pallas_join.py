@@ -95,7 +95,10 @@ def section_employee():
 def section_size(n: int):
     import jax
 
-    from kolibrie_tpu.ops.pallas_kernels import _PALLAS_MAX_LEFT_ROWS
+    from kolibrie_tpu.ops.pallas_kernels import (
+        _PALLAS_MAX_LEFT_ROWS,
+        pallas_chunked_enabled,
+    )
 
     rng = np.random.default_rng(0)
     lk = np.sort(rng.integers(0, n, n).astype(np.uint32))
@@ -109,7 +112,15 @@ def section_size(n: int):
             {
                 "metric": f"merge_join_uniform_{n}",
                 "platform": jax.devices()[0].platform,
-                "path": "pallas" if n <= _PALLAS_MAX_LEFT_ROWS else "xla_fallback",
+                "path": (
+                    "pallas"
+                    if n <= _PALLAS_MAX_LEFT_ROWS
+                    else (
+                        "pallas_chunked"
+                        if pallas_chunked_enabled()
+                        else "xla_fallback"
+                    )
+                ),
                 "pairs": n_pairs,
                 "pallas_ms": round(1000 * t_pallas, 3),
                 "xla_ms": round(1000 * t_xla, 3),

@@ -64,9 +64,14 @@ W = TILE + _WPAD  # per-sub-tile row window
 BW = 2048  # block-quantized row-window granule (two consecutive blocks
 #            always cover a group's G*TILE + W row span: G*TILE + W +
 #            (BW - 1) <= 2 * BW)
-# Verified-safe kernel range on the current Mosaic toolchain (see
-# merge_join docstring); larger left sides use the XLA formulation.
+# Verified-safe SINGLE-LAUNCH kernel range on the current Mosaic toolchain
+# (see merge_join docstring); larger left sides use the chunk-level driver
+# (_pallas_join_core_chunked), which keeps every launch inside this range.
 _PALLAS_MAX_LEFT_ROWS = 393216
+# Outputs per chunked-driver launch: 1024 tiles / 128 groups per launch;
+# local row windows are bounded by _CHUNK_OUT + 1 rows — an order of
+# magnitude under the fault boundary.
+_CHUNK_OUT = 131072
 _CHUNK_ROWS = 256  # grid chunk height for elementwise kernels (128KB/col)
 
 
@@ -111,6 +116,11 @@ def _merge_join_kernel(
     g = pl.program_id(0)
     base = (row_start_ref[g * G] // BW) * BW  # first resident row
     total = row_start_ref[pl.num_programs(0) * G]
+    # Global index of this launch's first output: 0 for the whole-join
+    # launch; chunk_index * chunk_out for the chunked driver, whose row
+    # table, row starts and tile ids are all launch-local while cum/low
+    # stay global (see _pallas_join_core_chunked).
+    kbase = row_start_ref[pl.num_programs(0) * G + 1]
 
     # Two consecutive BW-row blocks of the packed per-row table are
     # VMEM-resident (block-quantized index maps driven by the prefetched
@@ -138,7 +148,7 @@ def _merge_join_kernel(
         cum_w = win[:, 3:4]
         cumprev0 = rows_s[off, 4]  # off already clamped in-bounds above
 
-        k = t * TILE + jax.lax.broadcasted_iota(
+        k = kbase + t * TILE + jax.lax.broadcasted_iota(
             jnp.int32, (1, TILE), 1
         )  # (1, T)
 
@@ -169,6 +179,33 @@ def _merge_join_kernel(
         valid_out_ref[r, :] = valid[0, :]
 
 
+def _join_prepass(lkey_u, lval, rkey_u):
+    """Shared XLA pre-pass of both kernel drivers: searchsorted run bounds,
+    stable compaction of matched rows to the front, cumsum.  Returns
+    ``(lkey_c, lval_c, low_c, cum, cumprev, total, total64)`` — the packed
+    per-row columns (bitcast i32), the global output-offset prefix, the i32
+    device total and the exact i64 match count."""
+
+    def _bc(x):
+        return lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32)
+
+    low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
+    high = jnp.searchsorted(rkey_u, lkey_u, side="right").astype(jnp.int32)
+    counts = high - low
+    with jax.enable_x64(True):
+        total64 = jnp.sum(counts.astype(jnp.int64))
+    # Compact to rows with ≥1 match (stable: False sorts before True).
+    order = jnp.argsort(counts == 0, stable=True)
+    lkey_c = _bc(lkey_u)[order]
+    lval_c = _bc(lval)[order]
+    low_c = low[order]
+    counts_c = jnp.where(counts[order] > 0, counts[order], 0)
+    cum = jnp.cumsum(counts_c).astype(jnp.int32)
+    total = cum[-1] if cum.shape[0] else jnp.int32(0)
+    cumprev = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1]])
+    return lkey_c, lval_c, low_c, cum, cumprev, total, total64
+
+
 def _pallas_join_core(
     lkey_u: jnp.ndarray,
     lval: jnp.ndarray,
@@ -187,31 +224,18 @@ def _pallas_join_core(
     n_tiles = n_groups * G
     cap = n_tiles * TILE
 
-    def _bc(x):
-        return lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32)
-
-    # --- XLA pre-pass -----------------------------------------------------
-    low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
-    high = jnp.searchsorted(rkey_u, lkey_u, side="right").astype(jnp.int32)
-    counts = high - low
-    with jax.enable_x64(True):
-        total64 = jnp.sum(counts.astype(jnp.int64))
-    # Compact to rows with ≥1 match (stable: False sorts before True).
-    order = jnp.argsort(counts == 0, stable=True)
-    lkey_c = _bc(lkey_u)[order]
-    lval_c = _bc(lval)[order]
-    low_c = low[order]
-    counts_c = jnp.where(counts[order] > 0, counts[order], 0)
-    cum = jnp.cumsum(counts_c).astype(jnp.int32)
-    total = cum[-1] if cum.shape[0] else jnp.int32(0)
-    cumprev = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1]])
+    lkey_c, lval_c, low_c, cum, cumprev, total, total64 = _join_prepass(
+        lkey_u, lval, rkey_u
+    )
 
     # Merge-path partition: first compacted row feeding each output tile.
     tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * TILE
     row_start = jnp.searchsorted(cum, tile_starts, side="right").astype(
         jnp.int32
     )
-    row_start = jnp.concatenate([row_start, total[None]])
+    row_start = jnp.concatenate(
+        [row_start, total[None], jnp.zeros(1, jnp.int32)]
+    )
 
     # Pack the five per-row columns into one (N, 5) table (linear in HBM;
     # ONE lane-padded VMEM block instead of five), padded to whole BW
@@ -282,13 +306,136 @@ def _pallas_join_core(
     return key_o, lval_o, pos_o, valid_o, total64
 
 
-@partial(jax.jit, static_argnames=("cap",))
+def _pallas_join_core_chunked(
+    lkey_u: jnp.ndarray,
+    lval: jnp.ndarray,
+    rkey_u: jnp.ndarray,
+    cap: int,
+    chunk_out: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Chunk-level merge-path driver: same tile kernel, bounded local windows.
+
+    Lifts the ``_PALLAS_MAX_LEFT_ROWS`` limit by hoisting the merge-path
+    partition one level up: the output space is cut into ``chunk_out``-wide
+    ranges, and because every compacted left row emits >= 1 output, the rows
+    feeding outputs ``[a, b)`` span at most ``b - a + 1`` compacted rows.
+    Each launch therefore dynamic-slices a bounded local window of the
+    packed row table and passes LOCAL row starts — offsets never approach
+    the empirical 2^19 Mosaic fault boundary regardless of total left size,
+    and each launch's grid is a fixed ``chunk_out / 1024`` groups (vs the
+    multi-thousand-tile grids of the faulting regime).  ``cum``/``low``
+    columns stay GLOBAL; the kernel offsets its output ids by the launch's
+    ``kbase`` prefetch slot, so the concatenation of chunk outputs is
+    bit-identical to the unchunked kernel's output.  Total grid work across
+    chunks equals the unchunked kernel's; ``lax.scan`` reuses ONE compiled
+    kernel across chunks.  Same return contract as
+    :func:`_pallas_join_core` with outputs of length
+    ``n_chunks * chunk_out >= cap``.
+    """
+    if chunk_out % (G * TILE):
+        raise ValueError("chunk_out must be a multiple of G * TILE")
+    n_chunks = max(1, -(-cap // chunk_out))
+    t_c = chunk_out // TILE  # tiles per chunk
+    nb_loc = -(-(chunk_out + W) // BW) + 1  # resident-quantized local blocks
+    l_win = nb_loc * BW  # local row window (covers chunk_out + 1 + W rows)
+
+    lkey_c, lval_c, low_c, cum, cumprev, total, total64 = _join_prepass(
+        lkey_u, lval, rkey_u
+    )
+
+    # Packed table stays FLAT (the local slice is reshaped per chunk);
+    # l_win rows of padding guarantee every slice is in-bounds unclamped
+    # (slice starts are row indices <= n_rows).
+    big = jnp.int32(np.iinfo(np.int32).max)
+    rows_p = jnp.stack([lkey_c, lval_c, low_c, cum, cumprev], axis=1)
+    pad_row = jnp.array([[0, 0, 0, big, big]], jnp.int32)
+    rows_p = jnp.concatenate(
+        [rows_p, jnp.broadcast_to(pad_row, (l_win, _NCOLS))]
+    )
+
+    tile_starts = jnp.arange(n_chunks * t_c, dtype=jnp.int32) * TILE
+    row_start_g = jnp.searchsorted(cum, tile_starts, side="right").astype(
+        jnp.int32
+    )
+
+    out_block = pl.BlockSpec((G, TILE), lambda g, *_: (g, 0))
+
+    def blk_a(g, rs):
+        return (jnp.minimum(rs[g * G] // BW, nb_loc - 2), 0, 0)
+
+    def blk_b(g, rs):
+        return (jnp.minimum(rs[g * G] // BW + 1, nb_loc - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(t_c // G,),
+        in_specs=[
+            pl.BlockSpec((1, BW, _NCOLS), blk_a),
+            pl.BlockSpec((1, BW, _NCOLS), blk_b),
+        ],
+        out_specs=[out_block] * 4,
+        scratch_shapes=[pltpu.VMEM((2 * BW, _NCOLS), jnp.int32)],
+    )
+    vma = getattr(jax.typeof(lkey_u), "vma", None)
+    kwargs = {"vma": vma} if vma else {}
+    out_shape = [
+        jax.ShapeDtypeStruct((t_c, TILE), jnp.int32, **kwargs)
+        for _ in range(4)
+    ]
+
+    def chunk_body(_, c):
+        row_base = row_start_g[c * t_c]
+        rs_local = (
+            lax.dynamic_slice(row_start_g, (c * t_c,), (t_c,)) - row_base
+        )
+        # Tiles past the last match carry row_start == n_rows; clamp their
+        # LOCAL starts to the window (their outputs are masked by the
+        # valid bit).  Legitimate local starts are <= chunk_out + 1 and
+        # are never clamped.
+        rs_local = jnp.minimum(rs_local, jnp.int32(chunk_out + W))
+        pref = jnp.concatenate(
+            [rs_local, total[None], (c * chunk_out)[None].astype(jnp.int32)]
+        )
+        rows_loc = lax.dynamic_slice(
+            rows_p, (row_base, 0), (l_win, _NCOLS)
+        ).reshape(nb_loc, BW, _NCOLS)
+        outs = pl.pallas_call(
+            _merge_join_kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(pref, rows_loc, rows_loc)
+        return None, outs
+
+    _, (key_s, lval_s, pos_s, valid_s) = lax.scan(
+        chunk_body, None, jnp.arange(n_chunks, dtype=jnp.int32)
+    )
+    n_out = n_chunks * chunk_out
+    key_o = lax.bitcast_convert_type(key_s.reshape(n_out), jnp.uint32)
+    lval_o = lax.bitcast_convert_type(lval_s.reshape(n_out), jnp.uint32)
+    pos_o = pos_s.reshape(n_out)
+    valid_o = valid_s.reshape(n_out).astype(bool)
+    return key_o, lval_o, pos_o, valid_o, total64
+
+
+def pallas_chunked_enabled() -> bool:
+    """Route left sides past ``_PALLAS_MAX_LEFT_ROWS`` through the chunked
+    kernel driver (default) instead of the pure-XLA formulation.
+    ``KOLIBRIE_PALLAS_CHUNKED=0`` restores the XLA fallback (checked at
+    trace time — set it before first use)."""
+    import os
+
+    return os.environ.get("KOLIBRIE_PALLAS_CHUNKED") != "0"
+
+
+@partial(jax.jit, static_argnames=("cap", "chunk_out"))
 def merge_join(
     lkey: jnp.ndarray,
     lval: jnp.ndarray,
     rkey: jnp.ndarray,
     rval: jnp.ndarray,
     cap: int,
+    chunk_out: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Equi-join of two runs (right sorted), Pallas-tiled materialization.
 
@@ -306,12 +453,17 @@ def merge_join(
     bitcast int32 (pure passthrough, exact for the full u32 range — the
     sorted-order-sensitive searchsorted runs on the u32 originals).
 
-    Inputs past ``_PALLAS_MAX_LEFT_ROWS`` route to the pure-XLA
-    formulation: the current Mosaic toolchain raises a device fault once
-    row-start offsets cross 2^19 under multi-thousand-tile grids (verified
-    empirically on v5e; block-index, pipeline-lookahead and SMEM-size
-    causes ruled out), so the kernel path is gated to the proven range.
-    The XLA path is the same algorithm (searchsorted + cumsum expansion).
+    Inputs past ``_PALLAS_MAX_LEFT_ROWS`` route to the chunk-level driver
+    (:func:`_pallas_join_core_chunked`): the current Mosaic toolchain
+    raises a device fault once row-start offsets cross 2^19 under
+    multi-thousand-tile grids (verified empirically on v5e; block-index,
+    pipeline-lookahead and SMEM-size causes ruled out), so the
+    single-launch kernel is gated to the proven range and larger inputs
+    run the same kernel per bounded output chunk.  ``chunk_out`` (a
+    multiple of 1024) forces the chunked driver with that chunk width —
+    used by tests; production picks ``_CHUNK_OUT`` automatically.
+    ``KOLIBRIE_PALLAS_CHUNKED=0`` restores the pure-XLA fallback (the
+    same algorithm — searchsorted + cumsum expansion — gather-based).
     """
     lkey_u = lkey.astype(jnp.uint32)
     rkey_u = rkey.astype(jnp.uint32)
@@ -320,11 +472,18 @@ def merge_join(
     if lkey.shape[0] == 0 or rkey.shape[0] == 0:
         z = jnp.zeros(cap, jnp.uint32)
         return z, z, z, jnp.zeros(cap, bool), jnp.int32(0)
-    if lkey.shape[0] > _PALLAS_MAX_LEFT_ROWS:
-        return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
-    key_o, lval_o, pos_o, valid_o, total = _pallas_join_core(
-        lkey_u, lval, rkey_u, cap
-    )
+    if chunk_out is not None or lkey.shape[0] > _PALLAS_MAX_LEFT_ROWS:
+        if chunk_out is None and not pallas_chunked_enabled():
+            return _xla_merge_join(lkey_u, lval, rkey_u, rval, cap)
+        key_o, lval_o, pos_o, valid_o, total = _pallas_join_core_chunked(
+            lkey_u, lval, rkey_u, cap, chunk_out or _CHUNK_OUT
+        )
+        key_o, lval_o = key_o[:cap], lval_o[:cap]
+        pos_o, valid_o = pos_o[:cap], valid_o[:cap]
+    else:
+        key_o, lval_o, pos_o, valid_o, total = _pallas_join_core(
+            lkey_u, lval, rkey_u, cap
+        )
     rval_o = jnp.where(
         valid_o,
         rval.astype(jnp.uint32)[jnp.clip(pos_o, 0, max(rval.shape[0] - 1, 0))],
@@ -333,13 +492,14 @@ def merge_join(
     return key_o, lval_o, rval_o, valid_o, total
 
 
-@partial(jax.jit, static_argnames=("cap",))
+@partial(jax.jit, static_argnames=("cap", "chunk_out"))
 def merge_join_indices(
     lkey: jnp.ndarray,
     rkey_sorted: jnp.ndarray,
     cap: int,
     lvalid: Optional[jnp.ndarray] = None,
     rvalid_prefix: Optional[jnp.ndarray] = None,
+    chunk_out: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Index-returning Pallas merge join: the drop-in kernel twin of
     :func:`kolibrie_tpu.ops.device_join.join_indices_presorted` for
@@ -366,14 +526,27 @@ def merge_join_indices(
     if ln == 0 or rn == 0:
         z = jnp.zeros(cap_r, jnp.int32)
         return z, z, jnp.zeros(cap_r, bool), jnp.int32(0)
-    if ln > _PALLAS_MAX_LEFT_ROWS:
-        from kolibrie_tpu.ops.device_join import join_indices_presorted
+    if chunk_out is not None or ln > _PALLAS_MAX_LEFT_ROWS:
+        if chunk_out is None and not pallas_chunked_enabled():
+            from kolibrie_tpu.ops.device_join import join_indices_presorted
 
-        li, ri, valid, total = join_indices_presorted(lkey_u, rkey_u, cap_r)
-        return li, ri.astype(jnp.int32), valid, total
-    _, li_o, pos_o, valid_o, total = _pallas_join_core(
-        lkey_u, jnp.arange(ln, dtype=jnp.uint32), rkey_u, cap_r
-    )
+            li, ri, valid, total = join_indices_presorted(
+                lkey_u, rkey_u, cap_r
+            )
+            return li, ri.astype(jnp.int32), valid, total
+        _, li_o, pos_o, valid_o, total = _pallas_join_core_chunked(
+            lkey_u,
+            jnp.arange(ln, dtype=jnp.uint32),
+            rkey_u,
+            cap_r,
+            chunk_out or _CHUNK_OUT,
+        )
+        li_o, pos_o = li_o[:cap_r], pos_o[:cap_r]
+        valid_o = valid_o[:cap_r]
+    else:
+        _, li_o, pos_o, valid_o, total = _pallas_join_core(
+            lkey_u, jnp.arange(ln, dtype=jnp.uint32), rkey_u, cap_r
+        )
     li = lax.bitcast_convert_type(li_o, jnp.int32)
     li = jnp.where(valid_o, jnp.clip(li, 0, ln - 1), 0)
     ri = jnp.where(valid_o, jnp.clip(pos_o, 0, rn - 1), 0)

@@ -2,10 +2,15 @@
 cross 2^19 under a multi-thousand-tile grid.
 
 Gate it documents: ``ops/pallas_kernels._PALLAS_MAX_LEFT_ROWS = 393216`` —
-the tiled merge-join kernel is verified stable up to that left size; past
-~2^19 compacted rows the SAME kernel raises a TPU device fault at dispatch
-(v5e via the axon tunnel).  Block-index, pipeline-lookahead and SMEM-size
-causes were ruled out in round-2 elimination runs (TPU_VALIDATION.md).
+the SINGLE-LAUNCH tiled merge-join kernel is verified stable up to that
+left size; past ~2^19 compacted rows the SAME kernel raises a TPU device
+fault at dispatch (v5e via the axon tunnel).  Block-index,
+pipeline-lookahead and SMEM-size causes were ruled out in round-2
+elimination runs (TPU_VALIDATION.md).  Since round 4, production inputs
+past the gate run the chunk-level driver (bounded local windows — see
+``repros/pallas_chunked_join_validation.py``), so this repro bypasses the
+gate to reach the raw single-launch path and document the fault boundary
+itself.
 
 Run on real TPU:  python repros/mosaic_merge_join_rowstart_fault.py [n_left]
 Default n_left = 1_048_576 (faults).  n_left = 393_216 passes.

@@ -133,6 +133,90 @@ class TestMergeJoin:
         assert got == ref_join(lk, lv, rk, rv)
 
 
+class TestChunkedMergeJoin:
+    """The chunk-level driver that lifts ``_PALLAS_MAX_LEFT_ROWS``: forces
+    small ``chunk_out`` so multi-chunk stitching (global ``cum``/``kbase``
+    against local row windows) is exercised at test sizes.  Output must be
+    bit-identical to the XLA formulation of the same join."""
+
+    def _check(self, lk, lv, rk, rv, cap, chunk_out):
+        from kolibrie_tpu.ops.pallas_kernels import _xla_merge_join
+
+        ref = _xla_merge_join(*map(jnp.asarray, (lk, lv, rk, rv)), cap)
+        got = merge_join(
+            *map(jnp.asarray, (lk, lv, rk, rv)), cap, chunk_out=chunk_out
+        )
+        rt, gt = int(ref[4]), int(got[4])
+        assert rt == gt
+
+        def rows(o):
+            k, l, r, v, _ = (np.asarray(x) for x in o)
+            return sorted(
+                zip(k[v].tolist(), l[v].tolist(), r[v].tolist())
+            )
+
+        assert rows(ref) == rows(got)
+        return gt
+
+    def test_multi_chunk_skewed(self):
+        rng = np.random.default_rng(42)
+        lk = rng.integers(0, 800, 5000).astype(np.uint32)
+        lv = rng.integers(0, 1 << 20, 5000).astype(np.uint32)
+        rk = np.sort(rng.integers(0, 800, 3000).astype(np.uint32))
+        rv = rng.integers(0, 1 << 20, 3000).astype(np.uint32)
+        total = self._check(lk, lv, rk, rv, 32768, 1024)
+        assert total > 1024  # really spans many chunks
+
+    def test_heavy_fanout_crosses_chunks(self):
+        # One key's run spans several whole chunks: the chunk-level window
+        # bound (<= chunk_out + 1 rows) with a single straddling left row.
+        lk = np.array([3, 5, 9], np.uint32)
+        lv = np.array([30, 50, 90], np.uint32)
+        rk = np.sort(
+            np.concatenate(
+                [np.full(2500, 5, np.uint32), np.array([3, 9], np.uint32)]
+            )
+        )
+        rv = np.arange(2502, dtype=np.uint32)
+        total = self._check(lk, lv, rk, rv, 4096, 1024)
+        assert total == 2502
+
+    def test_no_matches_multi_chunk(self):
+        lk = np.arange(100, dtype=np.uint32)
+        rk = np.arange(1000, 1100, dtype=np.uint32)
+        total = self._check(lk, lk, rk, rk, 4096, 1024)
+        assert total == 0
+
+    def test_tail_chunk_past_total(self):
+        # cap far beyond total: tail chunks are all-masked (clamped local
+        # row starts, zero valid bits).
+        lk = np.arange(50, dtype=np.uint32)
+        rk = np.arange(50, dtype=np.uint32)
+        total = self._check(lk, lk, rk, rk, 8192, 1024)
+        assert total == 50
+
+    def test_indices_multi_chunk(self):
+        from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
+
+        rng = np.random.default_rng(7)
+        lk = rng.integers(0, 300, 4000).astype(np.uint32)
+        rk = np.sort(rng.integers(0, 300, 2000).astype(np.uint32))
+        li, ri, valid, tot = merge_join_indices(
+            jnp.asarray(lk), jnp.asarray(rk), 65536, chunk_out=1024
+        )
+        li, ri, valid = (np.asarray(x) for x in (li, ri, valid))
+        tot = int(tot)
+        assert valid.sum() == tot
+        assert np.all(lk[li[valid]] == rk[ri[valid]])
+        pairs = set(zip(li[valid].tolist(), ri[valid].tolist()))
+        assert len(pairs) == tot
+        # exact pair set vs brute force over the key runs
+        exp = 0
+        for k in np.unique(lk):
+            exp += (lk == k).sum() * (rk == k).sum()
+        assert tot == exp
+
+
 class TestFilterMask:
     def test_pattern_and_range(self):
         rng = np.random.default_rng(3)
