@@ -11,11 +11,20 @@ Prints one JSON line per mode with events/sec through the whole engine
 """
 
 import json
+import os
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# KOLIBRIE_BENCH_CPU=1: force the CPU backend — the device-R2R section
+# touches jax, and a dead TPU tunnel hangs backend init (same dance as
+# tests/conftest.py / bench.py / bench_lubm.py).
+if os.environ.get("KOLIBRIE_BENCH_CPU"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
 
 from kolibrie_tpu.rsp.builder import RSPBuilder  # noqa: E402
 from kolibrie_tpu.rsp.engine import CrossWindowReasoningMode  # noqa: E402
@@ -84,6 +93,58 @@ def run_mode(mode: str) -> dict:
     }
 
 
+R2R_QUERY = """PREFIX ex: <http://city/>
+REGISTER RSTREAM <http://out/reach> AS
+SELECT ?a ?c
+FROM NAMED WINDOW <http://city/w/> ON <http://city/social> [RANGE 120 STEP 60]
+WHERE { WINDOW <http://city/w/> { ?a ex:reach ?c } }"""
+
+R2R_RULES = """@prefix s: <http://city/> .
+{ ?a s:knows ?b . ?b s:knows ?c . } => { ?a s:reach ?c . } .
+"""
+
+
+def run_r2r_mode(mode: str) -> dict:
+    """Single window + per-window rules: the SimpleR2R/DeviceR2R
+    materialize path (no cross-window coordinator), host vs
+    device-resident (VERDICT r3 item 4 done-criterion)."""
+    results = []
+    engine = (
+        RSPBuilder(R2R_QUERY)
+        .add_rules(R2R_RULES)
+        .set_r2r_mode(mode)
+        .with_consumer(lambda row: results.append(row))
+        .build()
+    )
+    t0 = time.perf_counter()
+    last_ts = -1
+    for i in range(N_EVENTS):
+        ts = i // 4
+        if ts != last_ts:
+            engine.process_single_thread_window_results()
+            last_ts = ts
+        engine.add_to_stream(
+            "http://city/social",
+            WindowTriple(
+                f"<http://city/p{i % N_ROADS}>",
+                "<http://city/knows>",
+                f"<http://city/p{(i * 7 + 1) % N_ROADS}>",
+            ),
+            ts,
+        )
+    engine.process_single_thread_window_results()
+    engine.stop()
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "rsp_engine_r2r_materialize_e2e",
+        "mode": mode,
+        "events": N_EVENTS,
+        "seconds": round(elapsed, 3),
+        "events_per_sec": round(N_EVENTS / elapsed, 1),
+        "result_rows": len(results),
+    }
+
+
 def main():
     out_naive = run_mode(CrossWindowReasoningMode.NAIVE)
     out_inc = run_mode(CrossWindowReasoningMode.INCREMENTAL)
@@ -95,6 +156,14 @@ def main():
     )
     print(json.dumps(out_naive))
     print(json.dumps(out_inc))
+    out_host = run_r2r_mode("host")
+    out_dev = run_r2r_mode("device")
+    assert out_host["result_rows"] == out_dev["result_rows"] > 0, (
+        out_host["result_rows"],
+        out_dev["result_rows"],
+    )
+    print(json.dumps(out_host))
+    print(json.dumps(out_dev))
 
 
 if __name__ == "__main__":

@@ -666,20 +666,34 @@ class DeviceFixpoint:
                 pallas_join_enabled(),
             )
 
-    def infer(self, max_attempts: int = 12, initial_caps: Optional[_Caps] = None) -> int:
+    def infer_padded(
+        self,
+        fs,
+        fp,
+        fo,
+        n_facts,
+        caps: _Caps,
+        max_attempts: int = 12,
+    ):
+        """Capacity-retry fixpoint over device-resident fact columns.
+
+        ``fs/fp/fo`` are u32 device columns holding ``n_facts`` valid rows
+        (any padding beyond is ignored; columns shorter than ``caps.fact``
+        are re-padded).  Returns ``(ofs, ofp, ofo, n_out, caps)`` — the raw
+        padded output columns (input rows first, derived appended), the int
+        fact count, and the converged capacities — WITHOUT touching
+        ``reasoner.facts``.  This is the entry the device-resident RSP
+        driver reuses every window firing: no host round-trip of the fact
+        columns, one compiled program per capacity configuration.
+        """
         import jax.numpy as jnp
 
-        r = self.reasoner
-        s, p, o = r.facts.columns()
-        n0 = len(s)
-        if n0 == 0:
-            return 0
         masks = tuple(jnp.asarray(m) for m in self.bank.materialize()) or (
             jnp.zeros(1, dtype=bool),
         )
-        caps = initial_caps if initial_caps is not None else self._caps(n0)
-        fs, fp, fo = jnp.asarray(s), jnp.asarray(p), jnp.asarray(o)
-        n_facts = jnp.int32(n0)
+        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+
+        use_pallas = pallas_join_enabled()
         for _attempt in range(max_attempts):
 
             def pad(x):
@@ -690,19 +704,18 @@ class DeviceFixpoint:
                             jnp.zeros(caps.fact - x.shape[0], dtype=jnp.uint32),
                         ]
                     )
-                return x.astype(jnp.uint32)
-
-            from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+                # longer columns (an oversized resident mirror) are sliced:
+                # caps.fact >= 8 * n_facts, so only invalid padding drops
+                return x[: caps.fact].astype(jnp.uint32)
 
             fs, fp, fo = pad(fs), pad(fp), pad(fo)
             with jax.enable_x64(True):
                 ofs, ofp, ofo, on, rounds, code = _device_fixpoint(
-                    self.rules, caps, fs, fp, fo, n_facts, masks,
-                    pallas_join_enabled(),
+                    self.rules, caps, fs, fp, fo, n_facts, masks, use_pallas
                 )
             code = int(code)
             if code == 0:
-                break
+                return ofs, ofp, ofo, int(on), caps
             if code & 8:
                 raise RuntimeError(
                     "device fixpoint hit the round limit before convergence"
@@ -722,10 +735,26 @@ class DeviceFixpoint:
                 # the doubled program would hit the toolchain fault the
                 # entry gate exists to avoid — bail to the host path
                 raise JoinCapExceeded(caps.join)
-        else:
-            raise RuntimeError("device fixpoint capacities failed to converge")
+        raise RuntimeError("device fixpoint capacities failed to converge")
+
+    def infer(self, max_attempts: int = 12, initial_caps: Optional[_Caps] = None) -> int:
+        import jax.numpy as jnp
+
+        r = self.reasoner
+        s, p, o = r.facts.columns()
+        n0 = len(s)
+        if n0 == 0:
+            return 0
+        caps = initial_caps if initial_caps is not None else self._caps(n0)
+        ofs, ofp, ofo, n_out, caps = self.infer_padded(
+            jnp.asarray(s),
+            jnp.asarray(p),
+            jnp.asarray(o),
+            jnp.int32(n0),
+            caps,
+            max_attempts,
+        )
         self.converged_caps = caps
-        n_out = int(on)
         if n_out > n0:
             s_h = np.asarray(ofs[:n_out])
             p_h = np.asarray(ofp[:n_out])

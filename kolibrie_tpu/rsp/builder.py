@@ -42,6 +42,7 @@ class RSPBuilder:
         self._consumer: Optional[Callable] = None
         self._cross_window_rules_text: Optional[str] = None
         self._cross_window_mode = CrossWindowReasoningMode.INCREMENTAL
+        self._r2r_mode: Optional[str] = None
 
     # fluent configuration ---------------------------------------------------
 
@@ -80,6 +81,14 @@ class RSPBuilder:
 
     def with_consumer(self, fn: Callable) -> "RSPBuilder":
         self._consumer = fn
+        return self
+
+    def set_r2r_mode(self, mode: str) -> "RSPBuilder":
+        """Per-window reasoning backend: ``"host"`` (numpy closure),
+        ``"device"`` (device-resident window columns + device fixpoint per
+        firing — :class:`kolibrie_tpu.rsp.r2r.DeviceR2R`), or ``"auto"``
+        (device when running on TPU)."""
+        self._r2r_mode = mode
         return self
 
     # build ------------------------------------------------------------------
@@ -147,4 +156,5 @@ class RSPBuilder:
             rules=self._rules,
             cross_window_mode=self._cross_window_mode,
             cross_window_rules_text=self._cross_window_rules_text,
+            r2r_mode=self._r2r_mode,
         )

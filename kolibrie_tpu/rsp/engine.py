@@ -205,14 +205,39 @@ class RSPEngine:
         cross_window_context: Optional[WindowContext] = None,
         cross_window_mode: str = CrossWindowReasoningMode.INCREMENTAL,
         cross_window_rules_text: Optional[str] = None,
+        r2r_mode: Optional[str] = None,
     ):
         self.window_configs = window_configs
         self.operation_mode = operation_mode
         self.sync_policy = sync_policy or SyncPolicy(SyncPolicyKind.STEAL)
         self.consumer = consumer or (lambda row: None)
 
-        # R2R store; one dictionary shared across store, static db, plans
-        self.r2r = SimpleR2R(SparqlDatabase())
+        # R2R store; one dictionary shared across store, static db, plans.
+        # r2r_mode: "host" (default) = numpy closure per firing; "device" =
+        # device-resident window columns + device fixpoint (DeviceR2R);
+        # "auto" = device iff the default backend is TPU.  Overridable via
+        # KOLIBRIE_RSP_DEVICE=1 when no explicit mode was configured.
+        if r2r_mode is None:
+            import os
+
+            r2r_mode = (
+                "device" if os.environ.get("KOLIBRIE_RSP_DEVICE") == "1"
+                else "host"
+            )
+        if r2r_mode == "auto":
+            import jax
+
+            r2r_mode = (
+                "device" if jax.default_backend() == "tpu" else "host"
+            )
+        if r2r_mode == "device":
+            from kolibrie_tpu.rsp.r2r import DeviceR2R
+
+            self.r2r = DeviceR2R(SparqlDatabase())
+        elif r2r_mode == "host":
+            self.r2r = SimpleR2R(SparqlDatabase())
+        else:
+            raise ValueError(f"unknown r2r_mode {r2r_mode!r}")
         self.dictionary = self.r2r.db.dictionary
         self.static_db = SparqlDatabase()
         self.static_db.dictionary = self.dictionary

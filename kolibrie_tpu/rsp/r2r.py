@@ -11,6 +11,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Union
 
+import jax
+import numpy as np
+
 from kolibrie_tpu.core.triple import Triple
 from kolibrie_tpu.query.ast import SelectItem, SelectQuery, WhereClause
 from kolibrie_tpu.query.executor import eval_select_to_table, format_results, table_header
@@ -113,3 +116,250 @@ class SimpleR2R(R2ROperator):
         header = table_header(table, plan)
         rows = format_results(self.db, table, plan)
         return [tuple(sorted(zip(header, row))) for row in rows]
+
+
+class DeviceR2R(SimpleR2R):
+    """Device-resident R2R: the window's base facts live as padded u32
+    device columns ACROSS firings, and ``materialize`` becomes two device
+    dispatches — a net-delta window-maintenance program (set-difference of
+    evicted rows + appended arrivals) and the semi-naive device fixpoint
+    (:meth:`DeviceFixpoint.infer_padded`) — reading back ONLY the derived
+    rows.  This replaces SimpleR2R's per-firing rebuild (fresh Reasoner +
+    host closure + full set diff) with work that scales with the firing's
+    delta dispatch-side and with the derived count readback-side.
+
+    TPU-native redesign of ``kolibrie/src/rsp/simple_r2r.rs:103-128``
+    (SURVEY §7 step 5: "R2R = closure device program per firing").
+
+    Semantics are identical to :class:`SimpleR2R`: the host ``db`` remains
+    authoritative for queries (derived facts are inserted/evicted there
+    too), and a count guard rebuilds the device mirror whenever the db was
+    mutated outside add/remove (e.g. a derived fact colliding with a
+    streamed one).  Rule sets the device fixpoint cannot lower fall back to
+    the host path permanently.  Note: rules with numeric filters rebuild
+    their literal masks when the dictionary grows, which retraces the
+    fixpoint program — filter-free rule sets (the common RSP case) compile
+    once per capacity configuration.
+    """
+
+    def __init__(self, db: Optional[SparqlDatabase] = None):
+        super().__init__(db)
+        self._pending: List[tuple] = []  # chronological ("add"/"rem", Triple)
+        self._base: set = set()  # host twin of the device mirror's rows
+        self._mir = None  # (fs, fp, fo) padded u32 device columns
+        self._cap = 0
+        self._fx = None
+        self._caps_cache = None
+        self._device_ok = True
+        self._last_derived: Optional[List[Triple]] = None
+
+    def load_rules(self, rules: str) -> int:
+        n = super().load_rules(rules)
+        self._fx = None  # re-lower against the extended rule set
+        self._caps_cache = None
+        self._last_derived = None
+        return n
+
+    def add(self, item) -> None:
+        t = self._to_triple(item)
+        self.db.add_triple(t)
+        if self._device_ok:
+            self._pending.append(("add", t))
+
+    def remove(self, item) -> None:
+        t = self._to_triple(item)
+        self.db.delete_triple(t)
+        if self._device_ok:
+            self._pending.append(("rem", t))
+
+    # ------------------------------------------------------------- helpers
+
+    def _ensure_lowered(self):
+        if self._fx is None:
+            from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint
+
+            kg = Reasoner(self.db.dictionary)
+            for rule in self.rules:
+                kg.add_rule(rule)
+            self._fx = DeviceFixpoint(kg)
+        return self._fx
+
+    def _rebuild_mirror(self) -> None:
+        import jax.numpy as jnp
+
+        from kolibrie_tpu.ops import round_cap
+
+        s, p, o = self.db.store.columns()
+        n = len(s)
+        self._base = set(zip(s.tolist(), p.tolist(), o.tolist()))
+        self._cap = round_cap(max(2 * n, 1024))
+        self._last_derived = None  # base changed -> closure cache invalid
+
+        def put(x):
+            col = np.zeros(self._cap, np.uint32)
+            col[:n] = x
+            return jnp.asarray(col)
+
+        self._mir = (put(s), put(p), put(o))
+
+    def _apply_delta(self, rem: List[tuple], add: List[tuple]) -> None:
+        """One fixed-shape maintenance dispatch: drop ``rem`` rows, append
+        ``add`` rows.  Exactness of both lists (all removals present, all
+        adds absent) is guaranteed by the host twin, so the new count is
+        known host-side without any device readback."""
+        import jax.numpy as jnp
+
+        from kolibrie_tpu.ops import round_cap
+
+        n = len(self._base)  # already updated to the post-delta count
+        if n > self._cap:
+            # grow: rebuild at doubled capacity from the authoritative db
+            self._rebuild_mirror()
+            return
+
+        def pad_cols(keys, cap):
+            arr = np.zeros((3, cap), np.uint32)
+            if keys:
+                arr[:, : len(keys)] = np.array(keys, np.uint32).T
+            return (jnp.asarray(arr[0]), jnp.asarray(arr[1]), jnp.asarray(arr[2]))
+
+        rcap = round_cap(max(len(rem), 1), 16)
+        acap = round_cap(max(len(add), 1), 16)
+        rs, rp, ro = pad_cols(rem, rcap)
+        as_, ap_, ao_ = pad_cols(add, acap)
+        fs, fp, fo = self._mir
+        self._mir = _window_maintain(
+            fs, fp, fo,
+            jnp.int32(n - len(add) + len(rem)),  # count before this delta
+            rs, rp, ro, jnp.int32(len(rem)),
+            as_, ap_, ao_, jnp.int32(len(add)),
+        )
+
+    # --------------------------------------------------------- materialize
+
+    def materialize(self) -> List[Triple]:
+        if not self._device_ok:
+            return super().materialize()
+        from kolibrie_tpu.reasoner.device_fixpoint import (
+            JoinCapExceeded,
+            Unsupported,
+        )
+
+        for t in self._derived_prev:
+            self.db.delete_triple(t)
+        self._derived_prev = []
+        if not self.rules:
+            # no closure to run; the mirror (not yet built) syncs from the
+            # db when rules arrive, so the pendings can be dropped
+            self._pending.clear()
+            return []
+        try:
+            fx = self._ensure_lowered()
+        except Unsupported:
+            self._device_ok = False
+            self._pending.clear()
+            return super().materialize()
+
+        # Net effect of the chronological pendings: only rows whose final
+        # membership differs from their initial one touch the mirror (with
+        # overlapping sliding windows, most evict+re-add pairs cancel).
+        final: dict = {}
+        for op, t in self._pending:
+            final[tuple(t)] = op  # Triple is a (s, p, o) NamedTuple
+        self._pending = []
+        rem = [k for k, op in final.items() if op == "rem" and k in self._base]
+        add = [
+            k for k, op in final.items() if op == "add" and k not in self._base
+        ]
+        self._base.difference_update(rem)
+        self._base.update(add)
+        if self._mir is None or len(self.db.store) != len(self._base):
+            self._rebuild_mirror()  # first firing, or external db mutation
+        elif rem or add:
+            self._apply_delta(rem, add)
+        elif self._last_derived is not None:
+            # unchanged base between firings: the closure is unchanged too —
+            # reinstate the cached derived facts without a dispatch
+            for t in self._last_derived:
+                self.db.add_triple(t)
+            self._derived_prev = list(self._last_derived)
+            return list(self._last_derived)
+
+        import jax.numpy as jnp
+
+        n0 = len(self._base)
+        if n0 == 0:
+            self._last_derived = []
+            return []
+        from kolibrie_tpu.reasoner.device_fixpoint import _Caps
+
+        want = fx._caps(n0)
+        c = self._caps_cache
+        caps = (
+            want
+            if c is None
+            else _Caps(
+                max(c.fact, want.fact),
+                max(c.delta, want.delta),
+                max(c.join, want.join),
+            )
+        )
+        fs, fp, fo = self._mir
+        try:
+            ofs, ofp, ofo, n_out, caps = fx.infer_padded(
+                fs, fp, fo, jnp.int32(n0), caps
+            )
+        except JoinCapExceeded:
+            # data-dependent: THIS window's fan-out crossed the toolchain
+            # bound — host closure for this firing, device stays enabled.
+            # (The host path tracks _derived_prev, so the next device
+            # firing's eviction restores db == base before the guard.)
+            self._last_derived = None
+            return super().materialize()
+        except RuntimeError:
+            # convergence/backend failure: disable the device path rather
+            # than paying a failed dispatch every firing
+            self._device_ok = False
+            self._pending.clear()
+            return super().materialize()
+        self._caps_cache = caps
+        if n_out <= n0:
+            self._last_derived = []
+            return []
+        s_h = np.asarray(ofs[n0:n_out])
+        p_h = np.asarray(ofp[n0:n_out])
+        o_h = np.asarray(ofo[n0:n_out])
+        derived = [
+            Triple(int(a), int(b), int(c)) for a, b, c in zip(s_h, p_h, o_h)
+        ]
+        for t in derived:
+            self.db.add_triple(t)
+        self._derived_prev = derived
+        self._last_derived = list(derived)
+        return derived
+
+
+@jax.jit
+def _window_maintain(fs, fp, fo, n, rs, rp, ro, n_rem, as_, ap_, ao_, n_add):
+    """Jitted fixed-shape window maintenance: set-difference out the evicted
+    rows (compacting survivors to the front), then append the arrivals at
+    the compacted end.  All shapes come from the operands, so one compiled
+    program serves every firing at a given (cap, rcap, acap)."""
+    import jax.numpy as jnp
+
+    from kolibrie_tpu.ops.device_join import set_difference_rows
+
+    cap = fs.shape[0]
+    acap = as_.shape[0]
+    valid = jnp.arange(cap, dtype=jnp.int32) < n
+    rvalid = jnp.arange(rs.shape[0], dtype=jnp.int32) < n_rem
+    (fs2, fp2, fo2), _valid2, _n2 = set_difference_rows(
+        (fs, fp, fo), valid, (rs, rp, ro), rvalid, cap
+    )
+    pos = (n - n_rem) + jnp.arange(acap, dtype=jnp.int32)
+    avalid = jnp.arange(acap, dtype=jnp.int32) < n_add
+    pos = jnp.where(avalid, pos, cap)  # out-of-bounds -> dropped
+    fs2 = fs2.at[pos].set(as_, mode="drop")
+    fp2 = fp2.at[pos].set(ap_, mode="drop")
+    fo2 = fo2.at[pos].set(ao_, mode="drop")
+    return fs2, fp2, fo2
