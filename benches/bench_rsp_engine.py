@@ -157,12 +157,20 @@ def main():
     print(json.dumps(out_naive))
     print(json.dumps(out_inc))
     out_host = run_r2r_mode("host")
+    out_inc2 = run_r2r_mode("incremental")
     out_dev = run_r2r_mode("device")
-    assert out_host["result_rows"] == out_dev["result_rows"] > 0, (
+    assert (
+        out_host["result_rows"]
+        == out_inc2["result_rows"]
+        == out_dev["result_rows"]
+        > 0
+    ), (
         out_host["result_rows"],
+        out_inc2["result_rows"],
         out_dev["result_rows"],
     )
     print(json.dumps(out_host))
+    print(json.dumps(out_inc2))
     print(json.dumps(out_dev))
 
 

@@ -84,9 +84,12 @@ class RSPBuilder:
         return self
 
     def set_r2r_mode(self, mode: str) -> "RSPBuilder":
-        """Per-window reasoning backend: ``"host"`` (numpy closure),
-        ``"device"`` (device-resident window columns + device fixpoint per
-        firing — :class:`kolibrie_tpu.rsp.r2r.DeviceR2R`), or ``"auto"``
+        """Per-window reasoning backend: ``"host"`` (numpy closure per
+        firing), ``"device"`` (device-resident window columns + device
+        fixpoint per firing — :class:`kolibrie_tpu.rsp.r2r.DeviceR2R`),
+        ``"incremental"`` (expiration-provenance closure carried across
+        firings, delta-seeded per firing —
+        :class:`kolibrie_tpu.rsp.r2r.IncrementalR2R`), or ``"auto"``
         (device when running on TPU)."""
         self._r2r_mode = mode
         return self

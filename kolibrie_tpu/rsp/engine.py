@@ -234,6 +234,17 @@ class RSPEngine:
             from kolibrie_tpu.rsp.r2r import DeviceR2R
 
             self.r2r = DeviceR2R(SparqlDatabase())
+        elif r2r_mode == "incremental":
+            if len(window_configs) > 1:
+                # the single prune clock is only exact for one window;
+                # multi-window incremental reasoning is the cross-window
+                # SDS+ path's job (per-window expiries) — see
+                # IncrementalR2R's exactness-domain note
+                self.r2r = SimpleR2R(SparqlDatabase())
+            else:
+                from kolibrie_tpu.rsp.r2r import IncrementalR2R
+
+                self.r2r = IncrementalR2R(SparqlDatabase())
         elif r2r_mode == "host":
             self.r2r = SimpleR2R(SparqlDatabase())
         else:
@@ -316,14 +327,26 @@ class RSPEngine:
                     WindowResult(cfg.window_iri, [], ts, raw)
                 )
                 return
+            from kolibrie_tpu.rsp.r2r import IncrementalR2R
+
             with self._store_lock:
-                for t in prev_window_triples:
-                    self.r2r.remove(t)
-                prev_window_triples.clear()
-                for item in content:
-                    prev_window_triples.append(item)
-                    self.r2r.add(item)
-                self.r2r.materialize()
+                if isinstance(self.r2r, IncrementalR2R):
+                    # delta-incremental: reconcile full content (overlap is
+                    # O(1) per re-fed item), closure seeded with the delta
+                    self.r2r.feed_window(
+                        cfg.window_iri,
+                        cfg.width,
+                        content.iter_with_timestamps(),
+                    )
+                    self.r2r.materialize_incremental()
+                else:
+                    for t in prev_window_triples:
+                        self.r2r.remove(t)
+                    prev_window_triples.clear()
+                    for item in content:
+                        prev_window_triples.append(item)
+                        self.r2r.add(item)
+                    self.r2r.materialize()
                 results = self.r2r.execute_query(cfg.query)
             if self._has_joins:
                 mapped = [dict(row) for row in results]

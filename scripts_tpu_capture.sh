@@ -12,6 +12,7 @@ timeout -s KILL 600  python repros/mosaic_composed_fixpoint_cap_fault.py 2097152
 timeout -s KILL 600  python repros/mosaic_composed_fixpoint_cap_fault.py 4194304 2>&1 | tail -4
 # Round-4: chunk-level driver lifts the 393K gate — validate + time 1M/4M/16M
 timeout -s KILL 1200 python repros/pallas_chunked_join_validation.py 2>&1 | tail -6
-# Round-4: device-resident RSP R2R (host vs device mode on hardware)
-timeout -s KILL 1200 python benches/bench_rsp_engine.py 2>&1 | tail -4
+# Round-4: RSP R2R modes on hardware (host vs incremental vs device)
+timeout -s KILL 1200 python benches/bench_rsp_engine.py 2>&1 | tail -6
+timeout -s KILL 1200 python benches/bench_r2r_incremental.py 2>&1 | tail -7
 LUBM_UNIVERSITIES=1000 timeout -s KILL 3600 python benches/bench_lubm.py 2>&1 | tail -30
