@@ -55,6 +55,28 @@ def pack2(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return (a.astype(jnp.uint64) << np.uint64(32)) | b.astype(jnp.uint64)
 
 
+def pack_key_multi(lcols, rcols, lvalid, rvalid, lpad=_LPAD, rpad=_RPAD):
+    """Exact u64 keys for 3+ shared join columns: iterated dense-rank
+    composition over the UNION of both sides, so equal column tuples get
+    equal keys across sides (a per-side rank would not).  Each round is one
+    sort + two searchsorteds over (nl + nr) rows — the same cost class as
+    the join itself.  Invalid rows are sentinel-masked at the end (their
+    garbage intermediate ranks never surface).  Device twin of the host
+    ``ops/join.py::_pack_shared_keys`` 3+-column branch; shared by the
+    device query engine and the device fixpoint's premise joins."""
+    lk = lcols[0].astype(jnp.uint64)
+    rk = rcols[0].astype(jnp.uint64)
+    for lc, rc in zip(lcols[1:], rcols[1:]):
+        union = jnp.sort(jnp.concatenate([lk, rk]))
+        lr = jnp.searchsorted(union, lk).astype(jnp.uint64)
+        rr = jnp.searchsorted(union, rk).astype(jnp.uint64)
+        lk = (lr << jnp.uint64(32)) | lc.astype(jnp.uint64)
+        rk = (rr << jnp.uint64(32)) | rc.astype(jnp.uint64)
+    lk = jnp.where(lvalid, lk, jnp.uint64(lpad))
+    rk = jnp.where(rvalid, rk, jnp.uint64(rpad))
+    return lk, rk
+
+
 @_x64
 @partial(jax.jit, static_argnames="cap")
 def join_indices(

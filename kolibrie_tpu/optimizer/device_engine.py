@@ -16,10 +16,14 @@ whole operator tree compiles to a single device program with static shapes
 (padded buffers + validity masks, capacity doubling on overflow — SURVEY §7
 "hard parts"), instead of a tuple/thread-parallel interpreter.
 
-Unsupported constructs (quoted-pattern scans, BINDs, UDF/string functions,
-fully-constant patterns, 3+-variable join keys) raise :class:`Unsupported`
-at lowering time and the caller falls back to the host numpy engine —
-agreement between the two paths is tested in ``tests/test_device_engine.py``.
+Fully-constant patterns lower to host membership guards (zero device ops);
+3+-variable join keys ride a union dense-rank composition.  The remaining
+unsupported constructs (quoted-pattern scans, UDF/string functions,
+cartesian joins) raise :class:`Unsupported` at lowering time and the
+caller falls back to the host numpy engine — agreement between the two
+paths is tested in ``tests/test_device_engine.py``.  (BINDs never reach
+the device plan: the executor applies them host-side to the readback
+table, which is the right split — results are small next to the store.)
 
 Capacity / readback protocol (important on the shared-TPU tunnel, where any
 device→host read degrades later dispatches of the same executable): join
@@ -154,6 +158,8 @@ def _pack_key(cols: List, valid, pad_sentinel):
     return jnp.where(valid, key, jnp.uint64(pad_sentinel))
 
 
+
+
 def _plan_body(
     spec: PlanSpec, order_arrays, scalars, masks, values, numf, use_pallas=False
 ):
@@ -256,22 +262,29 @@ def _plan_body(
                 li, ri, valid, total = join_indices_presorted(
                     lkey, rkey, node.cap
                 )
-            elif use_pallas:
-                # unsorted / two-variable keys still ride the tile kernel
-                # via the dense-rank prepass (see ranked_merge_join_indices)
-                from kolibrie_tpu.ops.pallas_kernels import (
-                    ranked_merge_join_indices,
-                )
-
-                lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
-                rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
-                li, ri, valid, total = ranked_merge_join_indices(
-                    lkey, rkey, node.cap
-                )
             else:
-                lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
-                rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
-                li, ri, valid, total = join_indices(lkey, rkey, node.cap)
+                lc = [lcols[v] for v in node.key_vars]
+                rc = [rcols[v] for v in node.key_vars]
+                if len(node.key_vars) > 2:
+                    # 3+ shared variables: union dense-rank composition
+                    from kolibrie_tpu.ops.device_join import pack_key_multi
+
+                    lkey, rkey = pack_key_multi(lc, rc, lvalid, rvalid)
+                else:
+                    lkey = _pack_key(lc, lvalid, _LPAD)
+                    rkey = _pack_key(rc, rvalid, _RPAD)
+                if use_pallas:
+                    # unsorted keys still ride the tile kernel via the
+                    # dense-rank prepass (see ranked_merge_join_indices)
+                    from kolibrie_tpu.ops.pallas_kernels import (
+                        ranked_merge_join_indices,
+                    )
+
+                    li, ri, valid, total = ranked_merge_join_indices(
+                        lkey, rkey, node.cap
+                    )
+                else:
+                    li, ri, valid, total = join_indices(lkey, rkey, node.cap)
             counts.append(total)
             out = {}
             for v, c in lcols.items():
@@ -362,7 +375,14 @@ class LoweredPlan:
         self._order_idx: Dict[str, int] = {}
         self.join_count = 0
         self.need_numf = False
+        # fully-constant patterns: hoisted out of the join tree as host
+        # membership guards — a failed guard empties the whole result
+        # (engine.rs:144-260 evaluates them as 0/1-row scans; here they
+        # never cost a device op)
+        self.const_checks: List[tuple] = []
         self.root, vars_ = self._lower(plan)
+        if self.root is None:
+            raise Unsupported("constant-only query")
         self.out_vars = tuple(sorted(vars_))
         if not self.out_vars:
             raise Unsupported("no output variables")
@@ -433,7 +453,19 @@ class LoweredPlan:
 
     def _lower(self, op):
         if isinstance(op, (P.PhysIndexScan, P.PhysTableScan)):
-            return self._lower_scan(op.pattern)
+            pat = op.pattern
+            terms = [pat.subject, pat.predicate, pat.object]
+            if all(t.kind == "id" for t in terms):
+                # hoist as a host membership guard (an unknown constant can
+                # never match -> the guard is permanently false)
+                self.const_checks.append(
+                    tuple(
+                        None if t.value is None else int(t.value)
+                        for t in terms
+                    )
+                )
+                return None, set()
+            return self._lower_scan(pat)
         if isinstance(
             op,
             (P.PhysHashJoin, P.PhysMergeJoin, P.PhysParallelJoin, P.PhysNestedLoopJoin),
@@ -455,6 +487,8 @@ class LoweredPlan:
             return node, vars_
         if isinstance(op, P.PhysFilter):
             child, cv = self._lower(op.child)
+            if child is None:
+                raise Unsupported("filter over constant-only group")
             expr = self._lower_filter(op.expr, cv)
             return FilterSpec(child, expr), cv
         if isinstance(op, P.PhysValues):
@@ -503,8 +537,8 @@ class LoweredPlan:
             else:
                 raise Unsupported("quoted pattern scan")
         bound = frozenset(i for i, c in enumerate(consts) if c is not None)
-        if len(bound) == 3:
-            raise Unsupported("fully-constant pattern")
+        # fully-constant patterns never reach here: _lower hoists them into
+        # const_checks before calling _lower_scan
         order_name = self._DEFAULT_ORDER[bound]
         order_idx = self._order(order_name)
         scan_idx = len(self.scan_descs)
@@ -570,11 +604,14 @@ class LoweredPlan:
         return spec, set(values.variables)
 
     def _make_join(self, left, lv: set, right, rv: set):
+        # a constant-pattern child lowered to a host guard joins as identity
+        if left is None:
+            return right, rv
+        if right is None:
+            return left, lv
         shared = tuple(sorted(lv & rv))
         if not shared:
             raise Unsupported("cartesian join")
-        if len(shared) > 2:
-            raise Unsupported("3+ shared join variables")
         rsorted = False
         if len(shared) == 1:
             presorted = self._try_presort_scan(right, shared[0])
@@ -797,6 +834,8 @@ class LoweredPlan:
         executable) and as the oracle in spec-semantics tests."""
         from kolibrie_tpu.ops.join import join_indices as host_join_indices
 
+        if not self.const_ok():
+            return self.empty_table(), [0] * self.join_count
         self._refresh_masks()
         scan_ranges = self._scan_ranges()
         numf = self.db.numeric_values() if self.need_numf else None
@@ -860,12 +899,16 @@ class LoweredPlan:
                     for i, v in enumerate(node.vars)
                 }
             if isinstance(node, JoinSpec):
-                from kolibrie_tpu.ops.join import multi_key_pack
+                from kolibrie_tpu.ops.join import _pack_shared_keys
 
                 lcols = eval_node(node.left)
                 rcols = eval_node(node.right)
-                lkey = multi_key_pack([lcols[v] for v in node.key_vars])
-                rkey = multi_key_pack([rcols[v] for v in node.key_vars])
+                lkey, rkey = _pack_shared_keys(
+                    lcols,
+                    rcols,
+                    list(node.key_vars),
+                    len(next(iter(lcols.values()))),
+                )
                 li, ri = host_join_indices(lkey, rkey)
                 counts[node.join_idx] = len(li)
                 out = {v: c[li] for v, c in lcols.items()}
@@ -942,8 +985,28 @@ class LoweredPlan:
             for var, col in zip(self.out_vars, out_cols)
         }
 
+    def const_ok(self) -> bool:
+        """Evaluate the hoisted fully-constant pattern guards against the
+        CURRENT store (host binary searches; no device op).  False ⇒ the
+        query's result is empty regardless of the plan tree."""
+        if not self.const_checks:
+            return True
+        order = self.db.store.order("spo")
+        for s, p, o in self.const_checks:
+            if s is None or p is None or o is None:
+                return False  # unknown constant can never match
+            lo, hi = order.range012(s, p, o)
+            if lo >= hi:
+                return False
+        return True
+
+    def empty_table(self) -> BindingTable:
+        return {v: np.empty(0, dtype=np.uint32) for v in self.out_vars}
+
     def execute(self) -> BindingTable:
         """Run to completion with capacity validation; returns a host table."""
+        if not self.const_ok():
+            return self.empty_table()
         return self.to_table(*self.converge(self.run()))
 
 
@@ -1151,6 +1214,8 @@ def try_device_execute_aggregated(
             lowered = lower_plan(db, plan)
         except Unsupported:
             return None
+    if not lowered.const_ok():
+        return None  # empty result; let the host path aggregate nothing
     out_vars = lowered.out_vars
     gpos = []
     for g in q.group_by:
@@ -1327,6 +1392,8 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
         logical = build_logical_plan(resolved, list(w.filters), [], w.values)
         plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
         lowered = lower_plan(db, plan)
+        if not lowered.const_ok():
+            return []  # a failed constant guard empties the result
     except Unsupported:
         return None
     out_vars = lowered.out_vars
@@ -1411,6 +1478,10 @@ class PreparedQuery:
         planner = Streamertail(db.get_or_build_stats())
         self.plan = planner.find_best_plan(logical)
         self.lowered = lower_plan(db, self.plan)
+        if self.lowered.const_checks:
+            # run() is dispatch-only by contract; a store-dependent host
+            # guard between dispatches would break its timing semantics
+            raise Unsupported("prepared query with fully-constant pattern")
 
     def calibrate(self) -> None:
         """Converge join capacities via a host evaluation — zero device

@@ -21,9 +21,11 @@ capacity and re-enters the loop from the preserved state.  Readback happens
 once per ``while_loop`` exit, not per round.
 
 Rules whose shapes the device path cannot express (quoted-triple premises or
-conclusions, non-numeric filters, 3+-variable join keys) raise
-:class:`Unsupported`; callers fall back to the host strategies.  Agreement
-between both paths is tested in ``tests/test_device_fixpoint.py``.
+conclusions, non-numeric filters, cartesian premise joins) raise
+:class:`Unsupported`; callers fall back to the host strategies.  3-variable
+join keys ride the union dense-rank composition
+(``ops/device_join.py::pack_key_multi``).  Agreement between both paths is
+tested in ``tests/test_device_fixpoint.py``.
 """
 
 from __future__ import annotations
@@ -117,8 +119,8 @@ def _plan_rule(premises: List[LoweredPremise]) -> tuple:
                 raise Unsupported("cartesian premise join")
             jvars = {v for v, _ in premises[best].vars}
             shared = tuple(sorted(jvars & bound))
-            if len(shared) > 2:
-                raise Unsupported("3+ shared join variables")
+            # 1-2 keys pack exactly into u64; 3 keys (a premise has only
+            # three positions) ride the union dense-rank composition
             keys.append(shared)
             order.append(best)
             bound |= jvars
@@ -363,8 +365,18 @@ def _gen_candidates(
             for step, j in enumerate(order[1:]):
                 ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
                 kv = keys[step]
-                lkey = _pack([table[v] for v in kv], valid, _LPAD)
-                rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
+                if len(kv) > 2:
+                    from kolibrie_tpu.ops.device_join import pack_key_multi
+
+                    lkey, rkey = pack_key_multi(
+                        [table[v] for v in kv],
+                        [ptable[v] for v in kv],
+                        valid,
+                        pm,
+                    )
+                else:
+                    lkey = _pack([table[v] for v in kv], valid, _LPAD)
+                    rkey = _pack([ptable[v] for v in kv], pm, _RPAD)
                 if use_pallas:
                     li, ri, jvalid, total = ranked_merge_join_indices(
                         lkey, rkey, J

@@ -536,3 +536,100 @@ def test_device_query_fuzz():
         except Exception as e:
             raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
         assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
+
+
+def test_fully_constant_pattern_present():
+    """A fully-constant pattern that exists is a no-op guard — the rest of
+    the BGP runs on device (round 4: hoisted host membership check, no
+    fallback)."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        <http://example.org/e0> ex:dept "dept0" .
+    }"""
+    dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
+    assert len(dev) == 500
+
+
+def test_fully_constant_pattern_absent_empties_result():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        <http://example.org/e0> ex:dept "no-such-dept" .
+    }"""
+    dev, host = run_both(db, q)
+    assert dev == host == []
+
+
+def test_constant_pattern_lowers_without_fallback():
+    from kolibrie_tpu.optimizer.device_engine import lower_plan
+    from kolibrie_tpu.optimizer.engine import resolve_pattern
+    from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
+    from kolibrie_tpu.query.parser import parse_combined_query
+
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        <http://example.org/e0> ex:dept "dept0" .
+    }"""
+    db.register_prefixes_from_query(q)
+    cq = parse_combined_query(q, db.prefixes)
+    resolved = [resolve_pattern(db, p) for p in cq.select.where.patterns]
+    logical = build_logical_plan(resolved, [], [], None)
+    plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
+    lowered = lower_plan(db, plan)  # must NOT raise Unsupported
+    assert len(lowered.const_checks) == 1
+    assert lowered.const_ok()
+    table = lowered.execute()
+    assert len(next(iter(table.values()))) == 500
+
+
+def test_three_var_join_key_agreement():
+    """{?s ?p ?o . ?o ?p ?s} shares THREE variables — the union dense-rank
+    composition (round 4) runs it on device; host twin must agree."""
+    db = SparqlDatabase()
+    lines = []
+    # 40 symmetric pairs + 120 asymmetric edges + noise predicates
+    for i in range(40):
+        lines.append(f"<http://g/a{i}> <http://g/sym> <http://g/b{i}> .")
+        lines.append(f"<http://g/b{i}> <http://g/sym> <http://g/a{i}> .")
+    for i in range(120):
+        lines.append(f"<http://g/a{i}> <http://g/asym> <http://g/c{i}> .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+    q = "SELECT ?s ?p ?o WHERE { ?s ?p ?o . ?o ?p ?s }"
+    dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
+    assert len(dev) == 80  # both orientations of each symmetric pair
+
+
+def test_three_var_join_pallas_agreement(monkeypatch):
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    db = SparqlDatabase()
+    lines = []
+    for i in range(12):
+        lines.append(f"<http://g/a{i}> <http://g/sym> <http://g/b{i}> .")
+        lines.append(f"<http://g/b{i}> <http://g/sym> <http://g/a{i}> .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+    q = "SELECT ?s ?p ?o WHERE { ?s ?p ?o . ?o ?p ?s }"
+    dev, host = run_both(db, q)
+    assert sorted(dev) == sorted(host)
+    assert len(dev) == 24
+
+
+def test_constant_pattern_absent_with_order_limit():
+    """The ORDER BY + LIMIT device path must honor a failed constant guard
+    (review finding: it bypassed execute()'s guard and returned rows)."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        <http://example.org/e0> ex:dept "no-such-dept" .
+    } ORDER BY ?s LIMIT 5"""
+    dev, host = run_both(db, q)
+    assert dev == host == []

@@ -385,3 +385,49 @@ def test_device_fixpoint_fuzz():
             trial,
             specs,
         )
+
+
+def test_three_shared_var_premise_join_agreement():
+    """Premises {?x ?p ?y} ∧ {?y ?p ?x} share THREE variables: the union
+    dense-rank composition (round 4, ops/device_join.py::pack_key_multi)
+    lowers them instead of refusing; host strategy is the oracle."""
+
+    def build():
+        r = Reasoner()
+        for i in range(15):
+            r.add_abox_triple(f"a{i}", "sym", f"b{i}")
+            r.add_abox_triple(f"b{i}", "sym", f"a{i}")
+        for i in range(25):
+            r.add_abox_triple(f"a{i}", "asym", f"c{i}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "?p", "?y"), ("?y", "?p", "?x")],
+                [("?x", "mutual", "?y")],
+            )
+        )
+        return r
+
+    host, dev, derived = both_closures(build)
+    assert host == dev
+    assert derived == 30
+
+
+def test_three_shared_var_pallas_agreement(monkeypatch):
+    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+
+    def build():
+        r = Reasoner()
+        for i in range(6):
+            r.add_abox_triple(f"a{i}", "sym", f"b{i}")
+            r.add_abox_triple(f"b{i}", "sym", f"a{i}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "?p", "?y"), ("?y", "?p", "?x")],
+                [("?x", "mutual", "?y")],
+            )
+        )
+        return r
+
+    host, dev, derived = both_closures(build)
+    assert host == dev
+    assert derived == 12
