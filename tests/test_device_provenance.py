@@ -457,7 +457,192 @@ def test_addmult_initial_delta():
     _close_tags(ht, dt)
 
 
-def test_naf_rules_fall_back():
+def _naf_blocked_builder():
+    """Two candidates, one blocked: (?x p ?y), not (?y broken yes)."""
+
+    def build():
+        r = Reasoner()
+        r.add_abox_triple("a", "p", "b")
+        r.add_abox_triple("c", "p", "d")
+        r.add_abox_triple("b", "broken", "yes")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    return build
+
+
+def test_naf_boolean_agreement():
+    (hf, ht), (df, dt) = both_paths(_naf_blocked_builder(), BooleanProvenance())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_minmax_fuzzy_block_agreement():
+    """Probabilistic block: ⊖0.3 = 0.7 caps the derivation's tag."""
+
+    def tagged_build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.9)
+        r.add_tagged_triple("c", "p", "d", 0.8)
+        r.add_tagged_triple("b", "broken", "yes", 0.3)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(tagged_build, MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_derivations_feed_positive_stratum_device():
+    """Constant NAF premise is absent ⇒ one(); derived facts chain through
+    a positive rule (host test_naf_derivations_feed_positive_stratum twin)."""
+
+    def build():
+        r = Reasoner()
+        r.add_abox_triple("a", "p", "x")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?v", "p", "?w")],
+                [("?v", "q", "?w")],
+                negative=[("missing", "r", "z")],
+            )
+        )
+        r.add_rule(r.rule_from_strings([("?v", "q", "?w")], [("?v", "s", "?w")]))
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, BooleanProvenance())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_only_program_agreement():
+    """No positive stratum at all: the device driver skips straight to the
+    NAF pass."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "type", "P", 0.9)
+        r.add_tagged_triple("b", "type", "P", 0.8)
+        r.add_tagged_triple("b", "blocked", "y", 0.4)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "type", "P")],
+                [("?x", "ok", "y")],
+                negative=[("?x", "blocked", "y")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_expiration_agreement():
+    """Expiration ⊖: a live blocker kills the derivation (NEVER), an
+    expired one lifts it to FOREVER ∧ premise expiry."""
+    prov = ExpirationProvenance()
+
+    def run(device):
+        r = Reasoner()
+        r.add_abox_triple("a", "obs", "b")
+        r.add_abox_triple("c", "obs", "d")
+        r.add_abox_triple("b", "down", "yes")
+        r.add_abox_triple("d", "down", "yes")
+        store = seed_tag_store(r, prov)
+        s, p, o = r.facts.columns()
+        expiries = {
+            ("a", "obs", "b"): 5000,
+            ("c", "obs", "d"): 6000,
+            ("b", "down", "yes"): 4000,  # live blocker
+            ("d", "down", "yes"): prov.NEVER,  # expired blocker
+        }
+        d = r.dictionary
+        for (es, ep, eo), exp in expiries.items():
+            store.tags[
+                Triple(d.encode(es), d.encode(ep), d.encode(eo))
+            ] = exp
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "obs", "?y")],
+                [("?x", "live", "?y")],
+                negative=[("?y", "down", "yes")],
+            )
+        )
+        if device:
+            out = infer_provenance_device(r, prov, store)
+            assert out is not None
+        else:
+            infer_with_provenance(r, prov, store)
+        return r.facts.triples_set(), dict(store.tags)
+
+    hf, ht = run(device=False)
+    df, dt = run(device=True)
+    assert hf == df
+    assert ht == dt
+
+
+def test_three_shared_var_join_agreement():
+    """3 shared join variables must ride the dense-rank key composition —
+    a 2-column pack would silently join on (p, x) only and over-derive."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "sym", "b", 0.9)
+        r.add_tagged_triple("b", "sym", "a", 0.8)
+        r.add_tagged_triple("z", "sym", "a", 0.7)  # must NOT match (a,?,b)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "?p", "?y"), ("?y", "?p", "?x")],
+                [("?x", "mutual", "?y")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_cross_blocking_falls_back():
+    """A NAF rule whose conclusion unifies with another NAF rule's negated
+    premise depends on the host's sequential within-pass commits — the
+    snapshot-based device pass must refuse it."""
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?y", "blocked", "yes")],
+            negative=[("dummy", "d", "d")],
+        )
+    )
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?x", "ok", "?y")],
+            negative=[("?y", "blocked", "yes")],
+        )
+    )
+    prov = BooleanProvenance()
+    store = seed_tag_store(r, prov)
+    assert infer_provenance_device(r, prov, store) is None
+
+
+def test_naf_addmult_falls_back():
+    """Non-idempotent ⊕ keeps the host's exactly-once NAF accounting."""
     r = Reasoner()
     r.add_abox_triple("a", "p", "b")
     r.add_abox_triple("b", "broken", "yes")
@@ -468,6 +653,6 @@ def test_naf_rules_fall_back():
             negative=[("?y", "broken", "yes")],
         )
     )
-    prov = MinMaxProbability()
+    prov = AddMultProbability()
     store = seed_tag_store(r, prov)
     assert infer_provenance_device(r, prov, store) is None
