@@ -29,8 +29,14 @@ lands on its subject owner, so the local reduction is globally exact) —
 mirroring the single-chip :func:`_prov_round_addmult`.  Rule sets whose
 accumulation is evaluation-order-dependent (a rule's conclusions feed a
 later rule's premises) are refused, exactly like the single-chip path.
-Stratified NAF stays host-side (`Unsupported`), as do the structural
-semirings.
+Stratified NAF runs distributed for the idempotent family: after the
+positive stratum quiesces, a :func:`_naf_pass` mesh program evaluates each
+NAF rule's body over the full fact block and resolves negated premises
+with a two-hop exchange (ground keys to their subject owner, negated tags
+back), then the pass's delta re-enters the positive stratum — the same
+stratified alternation as the single-chip driver.  NAF over addmult and
+cross-blocking NAF programs stay host-side (`Unsupported`), as do the
+structural semirings.
 
 Parity: ``datalog/.../provenance_semi_naive.rs:26-34,134-197`` over
 ``semi_naive_parallel.rs``'s partitioning — redesigned as mesh-partitioned
@@ -71,6 +77,7 @@ from kolibrie_tpu.reasoner.device_provenance import (
     _ADDMULT_TAG_EQ,
     _addmult_order_sensitive,
     _decode_tags,
+    _naf_cross_blocking,
     _seed_tag_arrays,
     supports_idempotent,
 )
@@ -239,6 +246,55 @@ def _tagged_round(
                         cols.append(table[v])
                 parts.append((cols[0], cols[1], cols[2], tag, valid))
 
+    return _commit_candidates(
+        parts,
+        overflow,
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag,
+        gv,
+        kind=kind,
+        n=n,
+        axis=axis,
+        fact_cap=fact_cap,
+        delta_cap=delta_cap,
+        bucket_cap=bucket_cap,
+    )
+
+
+def _commit_candidates(
+    parts,
+    overflow,
+    fs,
+    fp,
+    fo,
+    ftag,
+    fv,
+    gs,
+    gp,
+    go,
+    gtag,
+    gv,
+    *,
+    kind,
+    n,
+    axis,
+    fact_cap,
+    delta_cap,
+    bucket_cap,
+):
+    """Shared commit tail of the distributed tagged round programs: route
+    candidate conclusions to their subject owner, segment-⊕ per (s,p,o)
+    group, merge into the subject-owned fact block, refresh the object-hash
+    mirror, and emit the next delta."""
+    fcols = (fs, fp, fo)
+
     cs = jnp.concatenate([p[0] for p in parts])
     cp = jnp.concatenate([p[1] for p in parts])
     co = jnp.concatenate([p[2] for p in parts])
@@ -400,6 +456,166 @@ def _tagged_round(
     return out_state, new_count[None], overflow[None]
 
 
+def _naf_pass(
+    state,
+    masks,
+    one_enc,
+    *,
+    rules,
+    neg_kind,
+    n,
+    axis,
+    fact_cap,
+    delta_cap,
+    join_cap,
+    bucket_cap,
+):
+    """One stratified NAF pass over the quiesced positive fixpoint, as a
+    mesh program (single-chip :func:`device_provenance._prov_naf_pass`
+    twin).  Each NAF rule's positive body is evaluated against the FULL
+    subject-owned fact block (idempotent ⊕ — re-derivation is harmless);
+    every negated premise is resolved with a two-hop exchange: ground
+    (s,p,o) keys ride to their hash(subject) owner for an exact lookup,
+    and the negated tag (absent ⇒ one(), present ⇒ ⊖tag) rides back to
+    the origin shard's row.  Commit tail shared with the round program.
+    """
+    from kolibrie_tpu.reasoner.device_provenance import _negate_enc
+
+    (
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag,
+        gv,
+        ds,
+        dp_,
+        do_,
+        dtag,
+        dv,
+    ) = (a[0] for a in state)
+    masks = tuple(m for m in masks)
+    one_enc = one_enc[0]
+
+    fcols = (fs, fp, fo)
+    eff_f = jnp.where(jnp.isnan(ftag), one_enc, ftag)
+    eff_g = jnp.where(jnp.isnan(gtag), one_enc, gtag)
+    overflow = jnp.int32(0)
+    parts: List[tuple] = []
+
+    for lr, plans in rules:
+        seed, steps = plans[0]  # one plan: the body runs over ALL facts
+        table, valid = _scan_premise(lr.premises[seed], fcols, fv)
+        tag = eff_f
+        for (j, kv, kpos, extra) in steps:
+            prem = lr.premises[j]
+            table, tag, valid, dropped = _exchange_tagged(
+                table, tag, valid, table[kv], n, axis, bucket_cap
+            )
+            overflow = overflow + dropped.astype(jnp.int32)
+            if kpos == 0:
+                side_cols, side_key, side_eff, side_valid = fcols, fs, eff_f, fv
+            else:
+                side_cols, side_key, side_eff, side_valid = (
+                    (gs, gp, go),
+                    go,
+                    eff_g,
+                    gv,
+                )
+            ptable, pmask = _scan_premise(prem, side_cols, side_valid)
+            li, ri, jvalid, total = local_join_u32(
+                table[kv], side_key, join_cap, valid, pmask
+            )
+            overflow = overflow + lax.psum(
+                jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+            )
+            new_table = {v: c[li] for v, c in table.items()}
+            for v, c in ptable.items():
+                if v not in new_table:
+                    new_table[v] = c[ri]
+                elif v in extra:
+                    jvalid = jvalid & (new_table[v] == c[ri])
+            tag = jnp.minimum(tag[li], side_eff[ri])
+            table, valid = new_table, jvalid
+        for f in lr.filters:
+            col = table[f.var]
+            if f.kind == "eq":
+                valid = valid & (col == np.uint32(f.const_id))
+            elif f.kind == "ne":
+                valid = valid & (col != np.uint32(f.const_id))
+            else:
+                m = masks[f.mask_idx]
+                valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+        L = valid.shape[0]
+        me = lax.axis_index(axis).astype(jnp.int32)
+        for neg in lr.negs:
+            term_map = _pos2var(neg)
+            qs, qp, qo = _instantiate(term_map, neg.consts, table, L)
+            rowid = jnp.arange(L, dtype=jnp.int32)
+            origin = jnp.full(L, 0, jnp.int32) + me
+            (rqs, rqp, rqo, rrow, rorig), rqv, d1 = exchange(
+                (qs, qp, qo, rowid, origin),
+                valid,
+                shard_of_dev(qs, n),
+                n,
+                axis,
+                bucket_cap,
+            )
+            overflow = overflow + d1.astype(jnp.int32)
+            idx, found = _index3(
+                (rqs, rqp, rqo), rqv, fcols, fv, fact_cap
+            )
+            t = eff_f[jnp.clip(idx, 0, fact_cap - 1)]
+            ntag = jnp.where(
+                found, _negate_enc(t, neg_kind, one_enc), one_enc
+            )
+            (brow, bnt), bv, d2 = exchange(
+                (rrow, ntag), rqv, rorig, n, axis, bucket_cap
+            )
+            overflow = overflow + d2.astype(jnp.int32)
+            ntag_buf = (
+                jnp.full(L, one_enc, jnp.float64)
+                .at[jnp.where(bv, brow, L)]
+                .set(bnt, mode="drop")
+            )
+            tag = jnp.minimum(tag, ntag_buf)
+        # zero-tag pruning
+        valid = valid & (tag > 0.0)
+        for concl in lr.concls:
+            cols = []
+            for tkind, v in concl:
+                if tkind == "const":
+                    cols.append(jnp.full(L, v, dtype=jnp.uint32))
+                else:
+                    cols.append(table[v])
+            parts.append((cols[0], cols[1], cols[2], tag, valid))
+
+    return _commit_candidates(
+        parts,
+        overflow,
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag,
+        gv,
+        kind="idem",
+        n=n,
+        axis=axis,
+        fact_cap=fact_cap,
+        delta_cap=delta_cap,
+        bucket_cap=bucket_cap,
+    )
+
+
 def _compact(flags, mask, dest, cap):
     """Compact ``flags`` (u32 0/1) through the same scatter that built the
     next-delta columns, so row i of the delta carries its fresh/changed
@@ -451,8 +667,12 @@ class DistProvenanceReasoner:
             raise Unsupported(
                 f"semiring {provenance.name!r} has no distributed tag algebra"
             )
-        if any(r.negative_premise for r in reasoner.rules):
-            raise Unsupported("stratified NAF stays host-side")
+        if self.kind == "addmult" and any(
+            r.negative_premise for r in reasoner.rules
+        ):
+            # non-idempotent ⊕: the host pass's exactly-once accounting
+            # (naf_seen) is load-bearing — stays host-side
+            raise Unsupported("stratified NAF over addmult stays host-side")
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n = mesh.devices.size
@@ -460,24 +680,29 @@ class DistProvenanceReasoner:
         self.provenance = provenance
         self.tag_store = tag_store
         self.rules, self.bank = lower_rules_dist(reasoner, reasoner.rules)
+        self.pos_rules = tuple(
+            (lr, pl) for lr, pl in self.rules if not lr.negs
+        )
+        self.naf_rules = tuple((lr, pl) for lr, pl in self.rules if lr.negs)
+        if self.naf_rules and _naf_cross_blocking(
+            [lr for lr, _ in self.naf_rules]
+        ):
+            raise Unsupported(
+                "a NAF conclusion unifies with a NAF negated premise: the"
+                " host's sequential within-pass commits are load-bearing"
+            )
+        self.neg_kind = (
+            "expiration"
+            if getattr(provenance, "name", None) == "expiration"
+            else "complement"
+        )
         n_local = max(1, -(-len(reasoner.facts) // self.n))
         self.fact_cap = fact_cap or round_cap(8 * n_local, 512)
         self.delta_cap = delta_cap or round_cap(4 * n_local, 256)
         self.join_cap = join_cap or round_cap(4 * n_local, 256)
         self.bucket_cap = bucket_cap or round_cap(4 * n_local, 256)
 
-    def _round_fn(self):
-        body = partial(
-            _tagged_round,
-            rules=self.rules,
-            n=self.n,
-            axis=self.axis,
-            fact_cap=self.fact_cap,
-            delta_cap=self.delta_cap,
-            join_cap=self.join_cap,
-            bucket_cap=self.bucket_cap,
-            kind=self.kind,
-        )
+    def _wrap_body(self, body):
         spec = P(self.axis, None)
         rep = P()
         n_masks = len(self.bank.exprs)
@@ -488,6 +713,36 @@ class DistProvenanceReasoner:
                 check_vma=_dist_check_vma(),
                 in_specs=((spec,) * 15, (rep,) * n_masks, P(self.axis)),
                 out_specs=((spec,) * 15, P(self.axis), P(self.axis)),
+            )
+        )
+
+    def _round_fn(self):
+        return self._wrap_body(
+            partial(
+                _tagged_round,
+                rules=self.pos_rules,
+                n=self.n,
+                axis=self.axis,
+                fact_cap=self.fact_cap,
+                delta_cap=self.delta_cap,
+                join_cap=self.join_cap,
+                bucket_cap=self.bucket_cap,
+                kind=self.kind,
+            )
+        )
+
+    def _naf_fn(self):
+        return self._wrap_body(
+            partial(
+                _naf_pass,
+                rules=self.naf_rules,
+                neg_kind=self.neg_kind,
+                n=self.n,
+                axis=self.axis,
+                fact_cap=self.fact_cap,
+                delta_cap=self.delta_cap,
+                join_cap=self.join_cap,
+                bucket_cap=self.bucket_cap,
             )
         )
 
@@ -580,18 +835,37 @@ class DistProvenanceReasoner:
             )
             masks = tuple(jnp.asarray(m) for m in self.bank.materialize())
             one_arr = put(np.full((n, 1), one_enc, np.float64))
-            round_fn = self._round_fn()
+            round_fn = self._round_fn() if self.pos_rules else None
+            naf_fn = self._naf_fn() if self.naf_rules else None
+
+            def extract(state):
+                fs = np.asarray(state[0]).reshape(-1)
+                fp = np.asarray(state[1]).reshape(-1)
+                fo = np.asarray(state[2]).reshape(-1)
+                ft = np.asarray(state[3]).reshape(-1)
+                fv = np.asarray(state[4]).reshape(-1)
+                return fs[fv], fp[fv], fo[fv], ft[fv]
+
+            quiesced = round_fn is None  # no positive stratum to drain
             for _ in range(max_rounds):
-                state, count, overflow = round_fn(state, masks, one_arr)
+                if not quiesced:
+                    state, count, overflow = round_fn(state, masks, one_arr)
+                    if int(overflow[0]) > 0:
+                        return None
+                    if int(count[0]) > 0:
+                        continue
+                    quiesced = True
+                # positive stratum drained: fire one NAF pass (host
+                # stratified-loop parity); its delta re-enters the
+                # positive stratum
+                if naf_fn is None:
+                    return extract(state)
+                state, count, overflow = naf_fn(state, masks, one_arr)
                 if int(overflow[0]) > 0:
                     return None
                 if int(count[0]) == 0:
-                    fs = np.asarray(state[0]).reshape(-1)
-                    fp = np.asarray(state[1]).reshape(-1)
-                    fo = np.asarray(state[2]).reshape(-1)
-                    ft = np.asarray(state[3]).reshape(-1)
-                    fv = np.asarray(state[4]).reshape(-1)
-                    return fs[fv], fp[fv], fo[fv], ft[fv]
+                    return extract(state)
+                quiesced = round_fn is None
             raise RuntimeError(
                 "distributed tagged fixpoint hit the round limit"
             )

@@ -170,7 +170,76 @@ def test_capacity_doubling_converges(mesh):
     assert host == dist
 
 
-def test_naf_unsupported(mesh):
+def test_naf_minmax_agreement(mesh):
+    """Fuzzy NAF over the mesh: the blocker's ⊖0.3 = 0.7 caps the tag;
+    ground negated keys ride the two-hop exchange to their owner shard."""
+
+    def build():
+        r = Reasoner()
+        for i in range(12):
+            r.add_tagged_triple(f"a{i}", "p", f"b{i}", 0.5 + 0.03 * i)
+        # block every third target, fuzzily
+        for i in range(0, 12, 3):
+            r.add_tagged_triple(f"b{i}", "broken", "yes", 0.3)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
+
+
+def test_naf_feeds_positive_stratum_agreement(mesh):
+    """NAF-derived facts re-enter the positive stratum (stratified
+    alternation over the mesh)."""
+
+    def build():
+        r = Reasoner()
+        for i in range(10):
+            r.add_abox_triple(f"v{i}", "p", f"w{i}")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?v", "p", "?w")],
+                [("?v", "q", "?w")],
+                negative=[("missing", "r", "z")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?v", "q", "?w")], [("?v", "s", "?w")])
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, BooleanProvenance())
+    assert host == dist
+
+
+def test_naf_only_program_agreement(mesh):
+    """No positive stratum: the driver goes straight to NAF passes."""
+
+    def build():
+        r = Reasoner()
+        for i in range(9):
+            r.add_tagged_triple(f"x{i}", "type", "P", 0.9)
+        r.add_tagged_triple("x4", "blocked", "y", 1.0)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "type", "P")],
+                [("?x", "ok", "y")],
+                negative=[("?x", "blocked", "y")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
+
+
+def test_naf_addmult_unsupported(mesh):
     r = Reasoner()
     r.add_abox_triple("a", "p", "b")
     r.add_rule(
@@ -180,7 +249,32 @@ def test_naf_unsupported(mesh):
             negative=[("?y", "broken", "yes")],
         )
     )
-    prov = MinMaxProbability()
+    prov = AddMultProbability()
+    store = seed_tag_store(r, prov)
+    with pytest.raises(Unsupported):
+        DistProvenanceReasoner(mesh, r, prov, store)
+
+
+def test_naf_cross_blocking_unsupported(mesh):
+    """A NAF conclusion unifying with a NAF negated premise depends on the
+    host's sequential within-pass commits — the mesh pass must refuse."""
+    r = Reasoner()
+    r.add_abox_triple("a", "p", "b")
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?y", "blocked", "yes")],
+            negative=[("dummy", "d", "d")],
+        )
+    )
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "p", "?y")],
+            [("?x", "ok", "?y")],
+            negative=[("?y", "blocked", "yes")],
+        )
+    )
+    prov = BooleanProvenance()
     store = seed_tag_store(r, prov)
     with pytest.raises(Unsupported):
         DistProvenanceReasoner(mesh, r, prov, store)
