@@ -1,0 +1,45 @@
+# kolibrie_tpu HTTP server + web playground container.
+#
+# Parity: the reference ships a Dockerfile with BASE_TAG / ENABLE_WEB_UI
+# build args and a docker-compose around its Rust http-server; this is the
+# TPU-native twin.  The compute path is JAX — the default image runs the
+# CPU backend (fine for the server/playground and host engine); on a TPU VM
+# build with BASE_PIP_EXTRAS="jax[tpu]" to pull the TPU-enabled jaxlib.
+#
+#   docker build -t kolibrie-tpu .
+#   docker run -p 7878:7878 kolibrie-tpu
+#   open http://localhost:7878/            <- playground (ENABLE_WEB_UI)
+
+ARG BASE_TAG=3.12-slim
+FROM python:${BASE_TAG}
+
+ARG ENABLE_WEB_UI=true
+ARG BASE_PIP_EXTRAS="jax"
+
+RUN pip install --no-cache-dir ${BASE_PIP_EXTRAS} numpy \
+    && pip install --no-cache-dir scikit-learn psutil || true
+
+WORKDIR /app
+COPY kolibrie_tpu /app/kolibrie_tpu
+COPY native /app/native
+COPY web /app/web.build
+COPY examples /app/examples
+
+# native tokenizers/SDD: build the C++ shared library when a toolchain
+# exists (the loader in kolibrie_tpu/native/__init__.py expects
+# native/libkolibrie_native.so next to the source and can also self-build
+# at runtime); the Python fallbacks keep every feature working without it
+RUN if command -v g++ >/dev/null 2>&1; then \
+        make -C /app/native 2>/dev/null || true; \
+    fi
+
+# ENABLE_WEB_UI=false ships a headless API-only server (the handler 404s
+# the playground when the file is absent)
+RUN if [ "$ENABLE_WEB_UI" = "true" ]; then mv /app/web.build /app/web; \
+    else rm -rf /app/web.build; fi
+
+ENV PYTHONPATH=/app
+ENV JAX_PLATFORMS=cpu
+EXPOSE 7878
+
+CMD ["python", "-m", "kolibrie_tpu.frontends.http_server", "0.0.0.0", "7878"]

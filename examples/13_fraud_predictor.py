@@ -1,0 +1,153 @@
+"""Fraud detection: datalog symbolic flags feeding sklearn predictors.
+
+Domain-predictor example (reference parity:
+``ml/examples/fraud_predictor.py`` + ``predictor.py``'s multi-model
+corpus, redesigned around this framework's own reasoner): a symbolic
+pass-1 runs datalog rules over the transaction graph to derive boolean
+risk flags, those flags join the raw features, and TWO sklearn models are
+trained by a generated predictor script that captures cpu/memory with
+psutil and exports MLSchema TTL sidecars.  ``MLHandler.generate_ml_models``
+runs the script, discovery loads the best resource-scoring model, and the
+loop closes with predictions over fresh transactions.
+
+Run: ``python examples/13_fraud_predictor.py``
+"""
+
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from kolibrie_tpu.ml.handler import MLHandler  # noqa: E402
+from kolibrie_tpu.reasoner.reasoner import Reasoner  # noqa: E402
+
+rng = np.random.default_rng(42)
+N = 600
+
+# ---- raw transaction features --------------------------------------------
+amount = rng.gamma(2.0, 120.0, N)                  # long-tailed amounts
+hour = rng.integers(0, 24, N).astype(float)
+account_age_days = rng.integers(1, 2000, N).astype(float)
+n_recent = rng.poisson(3, N).astype(float)          # txs in the last hour
+is_fraud = (
+    (amount > 400) & ((hour < 6) | (account_age_days < 30))
+    | (n_recent > 8)
+).astype(int)
+
+# ---- symbolic pass 1: datalog rules derive per-transaction risk flags ----
+r = Reasoner()
+for i in range(N):
+    t = f"tx{i}"
+    if amount[i] > 400:
+        r.add_abox_triple(t, ":amountBand", ":high")
+    if hour[i] < 6:
+        r.add_abox_triple(t, ":window", ":night")
+    if account_age_days[i] < 30:
+        r.add_abox_triple(t, ":account", ":fresh")
+    if n_recent[i] > 8:
+        r.add_abox_triple(t, ":velocity", ":burst")
+r.add_rule(
+    r.rule_from_strings(
+        [("?t", ":amountBand", ":high"), ("?t", ":window", ":night")],
+        [("?t", ":flag", ":nightHighValue")],
+    )
+)
+r.add_rule(
+    r.rule_from_strings(
+        [("?t", ":amountBand", ":high"), ("?t", ":account", ":fresh")],
+        [("?t", ":flag", ":freshAccountSpend")],
+    )
+)
+r.add_rule(
+    r.rule_from_strings(
+        [("?t", ":velocity", ":burst")],
+        [("?t", ":flag", ":rapidFire")],
+    )
+)
+r.infer_new_facts_semi_naive()
+
+d = r.dictionary
+flag_p = d.encode(":flag")
+flag_names = [":nightHighValue", ":freshAccountSpend", ":rapidFire"]
+flag_ids = [d.encode(f) for f in flag_names]
+flags = np.zeros((N, len(flag_ids)))
+fs, fp, fo = r.facts.columns()
+for s, p, o in zip(fs.tolist(), fp.tolist(), fo.tolist()):
+    if p == flag_p and o in flag_ids:
+        tx = d.decode(s)
+        flags[int(tx[2:]), flag_ids.index(o)] = 1.0
+print(f"symbolic pass: {int(flags.sum())} flags over {N} transactions")
+
+X = np.column_stack([amount, hour, account_age_days, n_recent, flags])
+workdir = Path(tempfile.mkdtemp(prefix="kolibrie_fraud_"))
+np.save(workdir / "features.npy", X)
+np.save(workdir / "labels.npy", is_fraud)
+
+# ---- the generated predictor script (what generate_ml_models runs) -------
+(workdir / "fraud_predictor.py").write_text(
+    textwrap.dedent(
+        '''
+        """Trains two fraud classifiers; exports pkl + MLSchema TTL."""
+        import pickle, sys, time
+        from pathlib import Path
+        import numpy as np
+        import psutil
+        from sklearn.ensemble import GradientBoostingClassifier
+        from sklearn.linear_model import LogisticRegression
+
+        sys.path.insert(0, {repo!r})
+        from kolibrie_tpu.ml.mlschema import model_to_mlschema_ttl
+
+        X = np.load("features.npy"); y = np.load("labels.npy")
+        n_train = int(0.75 * len(X))
+        Xtr, Xte, ytr, yte = X[:n_train], X[n_train:], y[:n_train], y[n_train:]
+        proc = psutil.Process()
+        for name, model in (
+            ("fraud_gbm", GradientBoostingClassifier(n_estimators=60)),
+            ("fraud_logreg", LogisticRegression(max_iter=500)),
+        ):
+            rss0 = proc.memory_info().rss
+            t0 = time.process_time()
+            model.fit(Xtr, ytr)
+            cpu = time.process_time() - t0
+            mem = max(proc.memory_info().rss - rss0, 0) / 1e6
+            t1 = time.perf_counter()
+            acc = float((model.predict(Xte) == yte).mean())
+            pred_ms = (time.perf_counter() - t1) * 1000 / len(Xte)
+            with open(f"{{name}}_predictor.pkl", "wb") as f:
+                pickle.dump(model, f)
+            Path(f"{{name}}_schema.ttl").write_text(model_to_mlschema_ttl(
+                name, algorithm=type(model).__name__,
+                metrics={{"accuracy": acc, "cpuUsage": cpu,
+                          "memoryUsage": mem, "predictionTime": pred_ms}}))
+            print(f"{{name}}: acc={{acc:.3f}} cpu={{cpu:.3f}}s mem={{mem:.1f}}MB")
+        '''.format(repo=str(Path(__file__).resolve().parent.parent))
+    )
+)
+
+handler = MLHandler()
+names = handler.generate_ml_models(str(workdir))
+print(f"generated models: {names}")
+loaded = handler.discover_and_load_models(str(workdir))
+print(f"best resource score -> loaded: {loaded}")
+for meta in handler.compare_models():
+    print(
+        f"  {meta.name}: acc={meta.accuracy:.3f} cpu={meta.cpu_usage:.3f}"
+        f" mem={meta.memory_usage:.1f} score={meta.resource_score():.3f}"
+    )
+
+# ---- fresh transactions through the loaded model -------------------------
+fresh = np.array(
+    [
+        [900.0, 3.0, 10.0, 2.0, 1.0, 1.0, 0.0],   # night high-value, fresh
+        [40.0, 14.0, 900.0, 1.0, 0.0, 0.0, 0.0],  # boring afternoon coffee
+    ]
+)
+result = handler.predict(loaded[0], fresh.tolist())
+print(f"fraud predictions [risky, benign]: {result.predictions}")
+assert result.predictions[0] >= result.predictions[1]
+print("ok")

@@ -1,0 +1,120 @@
+"""Temperature forecasting: regression predictors + MLSchema comparison.
+
+Domain-predictor example (reference parity:
+``ml/examples/temperature_predictor.py`` + ``saving_predictor.py`` —
+the regression half of the corpus, redesigned): a generated predictor
+script trains two regressors on a synthetic building-sensor series,
+captures cpu/memory with psutil and exports rmse/r2 (not accuracy) into
+the MLSchema sidecars; discovery scores on resources, the loaded model
+forecasts the next hours, and the ML.PREDICT timing harness breaks the
+cost down (data prep vs pure predict vs overhead).
+
+Run: ``python examples/15_temperature_predictor.py``
+"""
+
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from kolibrie_tpu.ml.handler import MLHandler  # noqa: E402
+
+rng = np.random.default_rng(11)
+N = 24 * 40  # 40 days of hourly readings
+
+hour = np.arange(N) % 24
+day = np.arange(N) // 24
+occupancy = ((hour >= 8) & (hour <= 18) & (day % 7 < 5)).astype(float)
+outdoor = 12 + 9 * np.sin(2 * np.pi * (hour - 14) / 24) + rng.normal(0, 1.2, N)
+hvac = np.clip(21.0 - outdoor, 0, None) * 0.35 * occupancy
+indoor = (
+    18.5
+    + 0.30 * outdoor
+    + 2.1 * occupancy
+    + 0.8 * hvac
+    + rng.normal(0, 0.35, N)
+)
+
+X = np.column_stack([hour.astype(float), occupancy, outdoor, hvac])
+workdir = Path(tempfile.mkdtemp(prefix="kolibrie_temp_"))
+np.save(workdir / "features.npy", X)
+np.save(workdir / "target.npy", indoor)
+
+(workdir / "temperature_predictor.py").write_text(
+    textwrap.dedent(
+        '''
+        """Trains two indoor-temperature regressors; pkl + MLSchema TTL."""
+        import pickle, sys, time
+        from pathlib import Path
+        import numpy as np
+        import psutil
+        from sklearn.ensemble import GradientBoostingRegressor
+        from sklearn.linear_model import Ridge
+
+        sys.path.insert(0, {repo!r})
+        from kolibrie_tpu.ml.mlschema import model_to_mlschema_ttl
+
+        X = np.load("features.npy"); y = np.load("target.npy")
+        n_train = int(0.8 * len(X))
+        Xtr, Xte, ytr, yte = X[:n_train], X[n_train:], y[:n_train], y[n_train:]
+        proc = psutil.Process()
+        for name, model in (
+            ("temp_ridge", Ridge(alpha=1.0)),
+            ("temp_gbr", GradientBoostingRegressor(n_estimators=80)),
+        ):
+            rss0 = proc.memory_info().rss
+            t0 = time.process_time()
+            model.fit(Xtr, ytr)
+            cpu = time.process_time() - t0
+            mem = max(proc.memory_info().rss - rss0, 0) / 1e6
+            t1 = time.perf_counter()
+            pred = model.predict(Xte)
+            pred_ms = (time.perf_counter() - t1) * 1000 / len(Xte)
+            rmse = float(np.sqrt(((pred - yte) ** 2).mean()))
+            ss_res = float(((pred - yte) ** 2).sum())
+            ss_tot = float(((yte - yte.mean()) ** 2).sum())
+            r2 = 1.0 - ss_res / ss_tot
+            with open(f"{{name}}_predictor.pkl", "wb") as f:
+                pickle.dump(model, f)
+            Path(f"{{name}}_schema.ttl").write_text(model_to_mlschema_ttl(
+                name, algorithm=type(model).__name__,
+                metrics={{"rmse": rmse, "r2": r2, "cpuUsage": cpu,
+                          "memoryUsage": mem, "predictionTime": pred_ms}}))
+            print(f"{{name}}: rmse={{rmse:.3f}} r2={{r2:.4f}} cpu={{cpu:.3f}}s")
+        '''.format(repo=str(Path(__file__).resolve().parent.parent))
+    )
+)
+
+handler = MLHandler()
+names = handler.generate_ml_models(str(workdir))
+print(f"generated models: {names}")
+loaded = handler.discover_and_load_models(str(workdir))
+print(f"resource-best model: {loaded}")
+for meta in handler.compare_models():
+    print(
+        f"  {meta.name}: cpu={meta.cpu_usage:.3f}s"
+        f" mem={meta.memory_usage:.1f}MB score={meta.resource_score():.3f}"
+    )
+
+# ---- forecast tomorrow's office hours ------------------------------------
+forecast_rows = []
+for h in (8, 12, 16, 22):
+    out_t = 12 + 9 * np.sin(2 * np.pi * (h - 14) / 24)
+    occ = 1.0 if 8 <= h <= 18 else 0.0
+    hv = max(21.0 - out_t, 0) * 0.35 * occ
+    forecast_rows.append([float(h), occ, out_t, hv])
+result = handler.predict(loaded[0], forecast_rows)
+for (h, *_), t in zip(forecast_rows, result.predictions):
+    print(f"  {int(h):02d}:00 -> {t:.1f}C")
+timing = result.timing
+print(
+    f"timing: total={timing.total_ms:.2f}ms prep={timing.data_prep_ms:.2f}"
+    f" predict={timing.pure_predict_ms:.2f} overhead={timing.overhead_ms:.2f}"
+)
+# occupied noon must read warmer than the empty late evening
+assert result.predictions[1] > result.predictions[3]
+print("ok")
