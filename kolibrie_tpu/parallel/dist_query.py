@@ -18,7 +18,12 @@ binding table IS the result (SPARQL bag semantics: no dedup unless
 
 Scope: BGP patterns (constants anywhere but joins keyed at subject/object
 position), numeric + term-equality FILTERs (AND-composed), projection,
-DISTINCT / ORDER BY / LIMIT (host post-pass on the gathered table).
+DISTINCT (mesh-side: projection tuples hash to an owner shard, shard-local
+sort-unique is globally exact), ORDER BY + LIMIT (mesh-side per-shard
+numeric-key top-k, O(k·n) readback, host re-orders the union; non-numeric
+sort keys re-run without the top-k stage and order on host; for rows tied
+at the k boundary the kept representative may differ from the host
+executor's stable order — both are valid SPARQL answers).
 Everything else (BIND, VALUES, OPTIONAL, UNION, subqueries, aggregates,
 windows) raises :class:`Unsupported` — callers fall back to the single-chip
 engine, mirroring the device engine's own fallback contract.
@@ -166,6 +171,7 @@ def _materialize_masks(db, exprs: Tuple[tuple, ...]) -> List[np.ndarray]:
 def _query_body(
     state,
     masks,
+    numf,
     *,
     premises,
     seed,
@@ -176,6 +182,8 @@ def _query_body(
     axis,
     join_cap,
     bucket_cap,
+    distinct=False,
+    topk=None,
 ):
     fs, fp, fo, fv, gs, gp, go, gv = (a[0] for a in state)
     masks = tuple(masks)
@@ -222,13 +230,83 @@ def _query_body(
             m = masks[f.mask_idx]
             valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
 
+    if distinct and out_vars:
+        # mesh-side DISTINCT: equal projection tuples hash to the same
+        # owner shard, so a shard-local sort + first-occurrence mask is a
+        # GLOBALLY exact dedup (readback carries only distinct rows)
+        from kolibrie_tpu.parallel.dist_join import mix32
+        from kolibrie_tpu.parallel.dist_join import exchange as _exchange
+
+        ocols = [table[v].astype(jnp.uint32) for v in out_vars]
+        if n > 1:
+            h = ocols[0]
+            for c in ocols[1:]:
+                h = mix32(h) ^ c
+            dest = (mix32(h) % jnp.uint32(n)).astype(jnp.int32)
+            routed, valid, dropped = _exchange(
+                tuple(ocols), valid, dest, n, axis, bucket_cap
+            )
+            overflow = overflow + dropped.astype(jnp.int32)
+            ocols = list(routed)
+        sent = jnp.uint32(0xFFFFFFFF)  # never a real dictionary ID
+        keyed = tuple(jnp.where(valid, c, sent) for c in ocols)
+        scols = (
+            lax.sort(keyed, num_keys=len(keyed))
+            if len(keyed) > 1
+            else (jnp.sort(keyed[0]),)
+        )
+        neq = jnp.zeros(scols[0].shape[0] - 1, dtype=bool)
+        for c in scols:
+            neq = neq | (c[1:] != c[:-1])
+        first = jnp.concatenate([jnp.ones(1, dtype=bool), neq])
+        valid = first & (scols[0] != sent)
+        table = dict(zip(out_vars, scols))
+
+    nan_seen = jnp.zeros((), dtype=bool)
+    if topk is not None:
+        # mesh-side ORDER BY + LIMIT: per-shard numeric-key top-k (device
+        # engine `_order_limit` twin) — the union of per-shard top-k
+        # contains the global top-k, so readback is O(k·n), and the host
+        # re-orders those k·n rows for the final slice.  A NaN sort key
+        # (non-numeric term) sets the replicated flag: the caller must
+        # re-run without topk and use host string-rank ordering.
+        k, opos, descs = topk
+        cols_t = tuple(table[v] for v in out_vars)
+        L = cols_t[0].shape[0] if cols_t else valid.shape[0]
+        perm = jnp.arange(L, dtype=jnp.int32)
+        keys = []
+        for pos, desc in zip(opos, descs):
+            vals = numf[jnp.minimum(cols_t[pos], numf.shape[0] - 1)]
+            nan_seen = nan_seen | jnp.any(jnp.isnan(vals) & valid)
+            keys.append(-vals if desc else vals)
+        for key in reversed(keys):
+            perm = perm[jnp.argsort(key[perm], stable=True)]
+        vkey = jnp.where(valid, 0, 1)
+        perm = perm[jnp.argsort(vkey[perm], stable=True)]
+        top = perm[:k]
+        table = {v: c[top] for v, c in zip(out_vars, cols_t)}
+        valid = valid[top]
+
     outs = tuple(jnp.where(valid, table[v], 0)[None] for v in out_vars)
     total_rows = lax.psum(jnp.sum(valid).astype(jnp.int32), axis)
-    return outs, valid[None], total_rows[None], overflow[None]
+    nan_any = lax.psum(nan_seen.astype(jnp.int32), axis)
+    return outs, valid[None], total_rows[None], overflow[None], nan_any[None]
 
 
 @lru_cache(maxsize=64)
-def _query_fn(mesh, premises, seed, steps, filters, out_vars, n_masks, join_cap, bucket_cap):
+def _query_fn(
+    mesh,
+    premises,
+    seed,
+    steps,
+    filters,
+    out_vars,
+    n_masks,
+    join_cap,
+    bucket_cap,
+    distinct=False,
+    topk=None,
+):
     axis = mesh.axis_names[0]
     n = mesh.devices.size
     body = partial(
@@ -242,17 +320,20 @@ def _query_fn(mesh, premises, seed, steps, filters, out_vars, n_masks, join_cap,
         axis=axis,
         join_cap=join_cap,
         bucket_cap=bucket_cap,
+        distinct=distinct,
+        topk=topk,
     )
     spec = P(axis, None)
     return jax.jit(
         jax.shard_map(
-            lambda state, masks: body(state, masks),
+            lambda state, masks, numf: body(state, masks, numf),
             mesh=mesh,
             check_vma=_dist_check_vma(),
-            in_specs=((spec,) * 8, (P(),) * n_masks),
+            in_specs=((spec,) * 8, (P(),) * n_masks, P()),
             out_specs=(
                 (spec,) * len(out_vars),
                 spec,
+                P(axis),
                 P(axis),
                 P(axis),
             ),
@@ -463,10 +544,14 @@ class DistQueryExecutor:
             self.store = ShardedTripleStore.from_columns(self.mesh, s, p, o)
         return self.store
 
-    def run_device(self, max_attempts: int = 8):
+    def run_device(self, max_attempts: int = 8, distinct=False, topk=None):
         """Dispatch the compiled program; returns the UN-read device arrays
-        ``(out_cols, valid, total, overflow)`` at the first capacity that
-        does not overflow (benchmarks time this, then read back)."""
+        ``(out_cols, valid, total, nan_flag)`` at the first capacity that
+        does not overflow (benchmarks time this, then read back).
+        ``distinct``/``topk`` enable the mesh-side DISTINCT and per-shard
+        ORDER BY+LIMIT stages (see :func:`_query_body`)."""
+        from kolibrie_tpu.optimizer.device_engine import device_numf
+
         store = self._ensure_store()
         state = (
             *store.by_subj,
@@ -475,6 +560,11 @@ class DistQueryExecutor:
             store.by_obj_valid,
         )
         masks = tuple(jnp.asarray(m) for m in _materialize_masks(self.db, self.mask_exprs))
+        numf = (
+            device_numf(self.db)
+            if topk is not None
+            else np.zeros(1, dtype=np.float64)
+        )
         for _attempt in range(max_attempts):
             fn = _query_fn(
                 self.mesh,
@@ -486,10 +576,15 @@ class DistQueryExecutor:
                 len(masks),
                 self.join_cap,
                 self.bucket_cap,
+                distinct,
+                topk,
             )
-            outs, valid, total, overflow = fn(state, masks)
+            with jax.enable_x64(True):
+                outs, valid, total, overflow, nan_flag = fn(
+                    state, masks, numf
+                )
             if int(overflow[0]) == 0:
-                return outs, valid, total
+                return outs, valid, total, nan_flag
             self.join_cap *= 2
             self.bucket_cap *= 2
         raise RuntimeError("distributed query capacities failed to converge")
@@ -507,7 +602,7 @@ class DistQueryExecutor:
         )
 
         q = self.query
-        outs, valid, _total = self.run_device()
+        outs, valid, _total, _nan = self.run_device()
         flat_cols = tuple(jnp.reshape(c, (-1,)) for c in outs)
         flat_valid = jnp.reshape(valid, (-1,))
         gpos = [self.out_vars.index(g) for g in q.group_by]
@@ -543,18 +638,40 @@ class DistQueryExecutor:
 
         if self.agg_items or self.query.group_by:
             return self._run_aggregated()
-        outs, valid, _total = self.run_device()
+        q = self.query
+        # mesh-side ORDER BY + LIMIT: per-shard numeric top-k when every
+        # sort key is a projected variable (host re-orders the k·n rows)
+        topk = None
+        if q.limit is not None and q.order_by:
+            opos, descs = [], []
+            for cond in q.order_by:
+                if (
+                    isinstance(cond.expr, A.Var)
+                    and cond.expr.name in self.out_vars
+                ):
+                    opos.append(self.out_vars.index(cond.expr.name))
+                    descs.append(bool(cond.descending))
+                else:
+                    opos = None
+                    break
+            if opos is not None:
+                k = round_cap((q.offset or 0) + q.limit, 8)
+                topk = (k, tuple(opos), tuple(descs))
+        outs, valid, _total, nan_flag = self.run_device(
+            distinct=bool(q.distinct), topk=topk
+        )
+        if topk is not None and int(nan_flag[0]) > 0:
+            # a non-numeric sort key: host string-rank ordering applies —
+            # re-run without the top-k stage on the full result
+            outs, valid, _total, _nan = self.run_device(
+                distinct=bool(q.distinct)
+            )
         v = np.asarray(valid).reshape(-1)
         table = {
             var: np.asarray(col).reshape(-1)[v].astype(np.uint32)
             for var, col in zip(self.out_vars, outs)
         }
-        if self.query.distinct and table:
-            stacked = np.stack([table[k] for k in self.out_vars], axis=1)
-            stacked = np.unique(stacked, axis=0)
-            table = {
-                k: stacked[:, i] for i, k in enumerate(self.out_vars)
-            }
+        # DISTINCT already happened on the mesh (owner-shard dedup)
         table = _order_table(self.db, table, self.query.order_by)
         rows = format_results(self.db, table, self.query)
         if not self.query.order_by:

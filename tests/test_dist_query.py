@@ -205,3 +205,83 @@ def test_repeated_variable_and_single_pattern(mesh):
     assert execute_query_distributed(q_one, db, mesh) == execute_query_volcano(
         q_one, db
     ) != []
+
+
+def test_order_by_limit_topk_agreement(mesh):
+    """Mesh-side per-shard numeric top-k: union of shard top-k re-ordered
+    on host must equal the host executor's full ordering (keys unique so
+    ties cannot make both answers differ)."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(200):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 7}> ."
+        )
+        lines.append(
+            f'{e} <http://example.org/salary> "{30000 + i * 13}" .'
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    for order in ("ASC(?s)", "DESC(?s)"):
+        q = f"""PREFIX ex: <http://example.org/>
+        SELECT ?e ?s WHERE {{
+            ?e ex:worksAt ?o .
+            ?e ex:salary ?s .
+        }} ORDER BY {order} LIMIT 7"""
+        host = execute_query_volcano(q, db)
+        dist = execute_query_distributed(q, db, mesh)
+        assert len(host) == 7
+        assert dist == host
+
+
+def test_order_by_offset_and_distinct_topk(mesh):
+    """DISTINCT + ORDER BY + LIMIT/OFFSET compose: mesh dedup feeds the
+    per-shard top-k, host applies the final offset slice."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(120):
+        e = f"<http://example.org/e{i}>"
+        # many employees per org -> DISTINCT ?o ?b collapses duplicates
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 10}> ."
+        )
+        lines.append(
+            f"<http://example.org/org{i % 10}> "
+            f'<http://example.org/budget> "{(i % 10) * 1000 + 500}" .'
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT DISTINCT ?o ?b WHERE {
+        ?e ex:worksAt ?o .
+        ?o ex:budget ?b .
+    } ORDER BY DESC(?b) LIMIT 4 OFFSET 2"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 4
+    assert dist == host
+
+
+def test_order_by_string_key_host_fallback(mesh):
+    """A non-numeric sort key sets the NaN flag: the driver re-runs without
+    the top-k stage and orders by decoded string rank on host."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(40):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 5}> ."
+        )
+        lines.append(f'{e} <http://example.org/name> "name{i:03d}" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?nm WHERE {
+        ?e ex:worksAt ?o .
+        ?e ex:name ?nm .
+    } ORDER BY DESC(?nm) LIMIT 5"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 5
+    assert dist == host
