@@ -78,6 +78,7 @@ from kolibrie_tpu.reasoner.device_provenance import (
     _addmult_order_sensitive,
     _decode_tags,
     _naf_cross_blocking,
+    _naf_premise_drift,
     _seed_tag_arrays,
     supports_idempotent,
 )
@@ -288,11 +289,13 @@ def _commit_candidates(
     fact_cap,
     delta_cap,
     bucket_cap,
+    fresh_delta_only=False,
 ):
     """Shared commit tail of the distributed tagged round programs: route
     candidate conclusions to their subject owner, segment-⊕ per (s,p,o)
     group, merge into the subject-owned fact block, refresh the object-hash
-    mirror, and emit the next delta."""
+    mirror, and emit the next delta (new ∪ changed — or new ONLY under
+    ``fresh_delta_only``, the NAF-pass/host-``naf_new`` contract)."""
     fcols = (fs, fp, fo)
 
     cs = jnp.concatenate([p[0] for p in parts])
@@ -431,6 +434,17 @@ def _commit_candidates(
         (ms_, mp_, mo_), mold_b, (gs, gp, go), gv, fact_cap
     )
     gtag = gtag.at[jnp.where(gfound, gidx, fact_cap)].set(mt_, mode="drop")
+
+    if fresh_delta_only:
+        # returned delta = NEW facts only (host naf_new parity); the
+        # mirror refresh above still covered tag-improved rows
+        n_dnext = jnp.sum(fresh)
+        fdest = jnp.where(fresh, jnp.cumsum(fresh) - 1, delta_cap)
+        nds = jnp.zeros(delta_cap, jnp.uint32).at[fdest].set(us, mode="drop")
+        ndp = jnp.zeros(delta_cap, jnp.uint32).at[fdest].set(up, mode="drop")
+        ndo = jnp.zeros(delta_cap, jnp.uint32).at[fdest].set(uo, mode="drop")
+        ndt = jnp.zeros(delta_cap, jnp.float64).at[fdest].set(ut, mode="drop")
+        ndv = jnp.arange(delta_cap) < n_dnext
 
     new_count = lax.psum(n_dnext.astype(jnp.int32), axis)
     out_state = tuple(
@@ -613,6 +627,7 @@ def _naf_pass(
         fact_cap=fact_cap,
         delta_cap=delta_cap,
         bucket_cap=bucket_cap,
+        fresh_delta_only=True,
     )
 
 
@@ -690,6 +705,13 @@ class DistProvenanceReasoner:
             raise Unsupported(
                 "a NAF conclusion unifies with a NAF negated premise: the"
                 " host's sequential within-pass commits are load-bearing"
+            )
+        if self.naf_rules and _naf_premise_drift(
+            [lr for lr, _ in self.rules], [lr for lr, _ in self.naf_rules]
+        ):
+            raise Unsupported(
+                "a NAF body reads derived predicates: the host's"
+                " exactly-once naf_seen tag freezing is load-bearing"
             )
         self.neg_kind = (
             "expiration"

@@ -289,11 +289,28 @@ def _fact_lookup(qs, qp, qo, qvalid, fs, fp, fo, fvalid, F):
     return found, fidx
 
 
-def _commit_parts(parts, caps, fs, fp, fo, ftag, n_facts, ds, dp, do, dtag, overflow):
+def _commit_parts(
+    parts,
+    caps,
+    fs,
+    fp,
+    fo,
+    ftag,
+    n_facts,
+    ds,
+    dp,
+    do,
+    dtag,
+    overflow,
+    fresh_delta_only=False,
+):
     """Shared commit tail of the idempotent round programs: dedup candidate
     conclusions by (s,p,o) keeping each group's ⊕-max tag, look them up
     against the fact columns, append new facts / improve tags in place, and
-    emit the next delta (new ∪ changed facts)."""
+    emit the next delta (new ∪ changed facts — or new ONLY under
+    ``fresh_delta_only``, the NAF-pass contract: the host stratified loop
+    feeds just ``naf_new`` KEYS back into the positive stratum, so a
+    tag-improved existing fact must NOT re-fire it)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -354,8 +371,9 @@ def _commit_parts(parts, caps, fs, fp, fo, ftag, n_facts, ds, dp, do, dtag, over
     # grown max (ut > old ⇒ max(old, ut) = ut in both cases)
     nftag = nftag.at[jnp.where(changed, fidx, F)].set(ut, mode="drop")
 
-    # next delta = new ∪ changed facts, with their stored tags
-    dmask = fresh | changed
+    # next delta = new ∪ changed facts, with their stored tags (NAF pass:
+    # new facts only — host `naf_new` parity)
+    dmask = fresh if fresh_delta_only else (fresh | changed)
     n_dnext = jnp.sum(dmask)
     ddest = jnp.where(dmask, jnp.cumsum(dmask) - 1, D)
     nds = jnp.zeros(D, jnp.uint32).at[ddest].set(us, mode="drop")
@@ -402,6 +420,25 @@ def _naf_cross_blocking(naf_rules) -> bool:
                     if all(
                         kind != "const" or c is None or c == v
                         for (kind, v), c in zip(concl, neg.consts)
+                    ):
+                        return True
+    return False
+
+
+def _naf_premise_drift(all_rules, naf_rules) -> bool:
+    """True when some rule's conclusion could unify with a NAF rule's
+    POSITIVE premise.  Then a premise tag read by a NAF body can improve
+    BETWEEN passes, and the host's exactly-once ``naf_seen`` skip (which
+    freezes each derivation's first-read tags) becomes load-bearing — a
+    snapshot recomputation would ⊕-merge the improved value.  Conservative
+    syntactic test; variables unify with anything."""
+    for ra in all_rules:
+        for concl in ra.concls:
+            for nb in naf_rules:
+                for prem in nb.premises:
+                    if all(
+                        kind != "const" or c is None or c == v
+                        for (kind, v), c in zip(concl, prem.consts)
                     ):
                         return True
     return False
@@ -516,7 +553,19 @@ def _prov_naf_pass(
             parts.append((out[0], out[1], out[2], tag, valid))
 
     return _commit_parts(
-        parts, caps, fs, fp, fo, ftag, n_facts, ds, dp, do, dtag, overflow
+        parts,
+        caps,
+        fs,
+        fp,
+        fo,
+        ftag,
+        n_facts,
+        ds,
+        dp,
+        do,
+        dtag,
+        overflow,
+        fresh_delta_only=True,
     )
 
 
@@ -766,6 +815,11 @@ def infer_provenance_device(
         # later in the same pass; the device pass evaluates all NAF rules
         # against one pre-pass snapshot and its later max-merge cannot
         # retract the stale derivation — keep those programs host-side
+        return None
+    if naf_rules and _naf_premise_drift(rules, naf_rules):
+        # a NAF body reading DERIVED predicates can see its premise tags
+        # improve between passes; host freezes each derivation's first
+        # read (naf_seen) — keep those programs host-side
         return None
 
     import jax.numpy as jnp

@@ -641,6 +641,127 @@ def test_naf_cross_blocking_falls_back():
     assert infer_provenance_device(r, prov, store) is None
 
 
+def test_naf_improves_existing_tag_without_refiring():
+    """Host parity corner: a NAF derivation that IMPROVES an existing
+    fact's tag does not re-enter the positive stratum (the host loop feeds
+    only naf_new KEYS back) — downstream tags must stay stale on BOTH
+    paths."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "strong", "b", 0.9)
+        r.add_tagged_triple("a", "q", "b", 0.3)  # pre-existing, weak
+        r.add_rule(r.rule_from_strings([("?x", "q", "?y")], [("?x", "s", "?y")]))
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "strong", "?y")],
+                [("?x", "q", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    prov = MinMaxProbability()
+    (hf, ht), (df, dt) = both_paths(build, prov)
+    assert hf == df
+    assert ht == dt
+    r = build()
+    d = r.dictionary
+    q_key = Triple(d.encode("a"), d.encode("q"), d.encode("b"))
+    s_key = Triple(d.encode("a"), d.encode("s"), d.encode("b"))
+    assert ht[q_key] == 0.9  # improved by the NAF pass
+    assert ht[s_key] == 0.3  # derived BEFORE the improvement, not re-fired
+
+
+def test_naf_derived_premise_falls_back():
+    """A NAF body reading a DERIVED predicate depends on the host's
+    exactly-once tag freezing (naf_seen) — the device driver must refuse."""
+    r = Reasoner()
+    r.add_tagged_triple("a", "p", "b", 0.5)
+    r.add_rule(r.rule_from_strings([("?x", "p", "?y")], [("?x", "q", "?y")]))
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", "q", "?y")],  # q is derived by the rule above
+            [("?x", "ok", "?y")],
+            negative=[("?y", "broken", "yes")],
+        )
+    )
+    prov = MinMaxProbability()
+    store = seed_tag_store(r, prov)
+    assert infer_provenance_device(r, prov, store) is None
+
+
+def test_naf_fuzz_agreement():
+    """Randomized stratified-NAF programs over random tagged graphs: the
+    device stratified driver must reproduce the host tag store exactly, or
+    decline (None -> skip).  Base predicates feed NAF bodies; conclusions
+    go to fresh predicates consumed by a positive rule; blockers are
+    randomly present/absent/fuzzy.  Seeded for reproducibility."""
+    import random
+
+    rng = random.Random(20260730)
+    provs = [MinMaxProbability, BooleanProvenance]
+    accepted = 0
+
+    for trial in range(10):
+        n_nodes = rng.randrange(6, 20)
+        base = [
+            (
+                f"n{rng.randrange(n_nodes)}",
+                rng.choice(["p", "r"]),
+                f"n{rng.randrange(n_nodes)}",
+                round(rng.uniform(0.2, 1.0), 2),
+            )
+            for _ in range(rng.randrange(10, 40))
+        ]
+        blockers = [
+            (f"n{rng.randrange(n_nodes)}", "broken", "yes",
+             round(rng.uniform(0.1, 1.0), 2))
+            for _ in range(rng.randrange(0, 6))
+        ]
+        two_premise = rng.random() < 0.5
+        neg_const = rng.random() < 0.3
+
+        def build():
+            r = Reasoner()
+            for s, p, o, t in base + blockers:
+                r.add_tagged_triple(s, p, o, t)
+            body = [("?x", "p", "?y")]
+            if two_premise:
+                body.append(("?y", "r", "?z"))
+                concl_v = ("?x", "derived", "?z")
+            else:
+                concl_v = ("?x", "derived", "?y")
+            neg = (
+                [("nowhere", "broken", "yes")]
+                if neg_const
+                else [(concl_v[2], "broken", "yes")]
+            )
+            r.add_rule(
+                r.rule_from_strings(body, [concl_v], negative=neg)
+            )
+            r.add_rule(
+                r.rule_from_strings(
+                    [("?a", "derived", "?b")], [("?a", "down", "?b")]
+                )
+            )
+            return r
+
+        prov_cls = provs[trial % len(provs)]
+        r_host = build()
+        host_store = seed_tag_store(r_host, prov_cls())
+        infer_with_provenance(r_host, prov_cls(), host_store)
+        r_dev = build()
+        dev_store = seed_tag_store(r_dev, prov_cls())
+        out = infer_provenance_device(r_dev, prov_cls(), dev_store)
+        if out is None:
+            continue
+        accepted += 1
+        assert r_host.facts.triples_set() == r_dev.facts.triples_set(), trial
+        assert dict(host_store.tags) == dict(dev_store.tags), trial
+    assert accepted >= 8, f"only {accepted} fuzz trials took the device path"
+
+
 def test_naf_addmult_falls_back():
     """Non-idempotent ⊕ keeps the host's exactly-once NAF accounting."""
     r = Reasoner()
