@@ -17,9 +17,12 @@ whole operator tree compiles to a single device program with static shapes
 "hard parts"), instead of a tuple/thread-parallel interpreter.
 
 Fully-constant patterns lower to host membership guards (zero device ops);
-3+-variable join keys ride a union dense-rank composition.  The remaining
-unsupported constructs (quoted-pattern scans, UDF/string functions,
-cartesian joins) raise :class:`Unsupported` at lowering time and the
+3+-variable join keys ride a union dense-rank composition; quoted patterns
+with inner variables scan their position as a synthetic qid column and
+expand it against the device-resident quoted table (a searchsorted gather
+— each qid names exactly one quoted row).  The remaining unsupported
+constructs (UDF/string functions, cartesian joins, doubly-nested quoted
+patterns) raise :class:`Unsupported` at lowering time and the
 caller falls back to the host numpy engine — agreement between the two
 paths is tested in ``tests/test_device_engine.py``.  (BINDs never reach
 the device plan: the executor applies them host-side to the readback
@@ -78,6 +81,22 @@ class ScanSpec:
     out_vars: tuple  # ((var, pos), ...) pos: 0=s 1=p 2=o canonical
     eq_pairs: tuple  # ((pos_a, pos_b), ...) repeated-variable constraints
     cap: int
+
+
+@dataclass(frozen=True)
+class QuotedExpandSpec:
+    """Expand a column of quoted-triple IDs against the device-resident
+    quoted table (qid-sorted): bind inner variables, enforce inner
+    constants / repeats / collisions with already-bound variables.  Each
+    qid maps to exactly one quoted row, so the expansion is a searchsorted
+    gather, not a join (host twin: ``optimizer/engine.py::_join_quoted``,
+    ref ``execution/engine.rs:1159``)."""
+
+    child: object
+    qvar: str  # synthetic column of qids produced by the scan
+    out_vars: tuple  # ((var, inner_pos 0..2), ...) fresh inner bindings
+    const_checks: tuple  # ((inner_pos, const_id), ...)
+    eq_checks: tuple  # ((inner_pos, bound_var), ...) incl. repeats
 
 
 @dataclass(frozen=True)
@@ -161,7 +180,14 @@ def _pack_key(cols: List, valid, pad_sentinel):
 
 
 def _plan_body(
-    spec: PlanSpec, order_arrays, scalars, masks, values, numf, use_pallas=False
+    spec: PlanSpec,
+    order_arrays,
+    scalars,
+    masks,
+    values,
+    numf,
+    quoted,
+    use_pallas=False,
 ):
     import jax.numpy as jnp
 
@@ -227,6 +253,27 @@ def _plan_body(
             for a, b in node.eq_pairs:
                 valid = valid & (raw[a] == raw[b])
             cols = {var: raw[pos] for var, pos in node.out_vars}
+            return cols, valid, jnp.sum(valid)
+        if isinstance(node, QuotedExpandSpec):
+            from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+            cols, valid, _ = eval_node(node.child)
+            qid_sorted, qs, qp, qo = quoted
+            qcol = cols.pop(node.qvar)
+            pos = jnp.searchsorted(qid_sorted, qcol)
+            posc = jnp.clip(pos, 0, qid_sorted.shape[0] - 1)
+            valid = (
+                valid
+                & (qid_sorted[posc] == qcol)
+                & ((qcol & jnp.uint32(QUOTED_BIT)) != 0)
+            )
+            inner = (qs[posc], qp[posc], qo[posc])
+            for ipos, cid in node.const_checks:
+                valid = valid & (inner[ipos] == jnp.uint32(cid))
+            for var, ipos in node.out_vars:
+                cols[var] = inner[ipos]
+            for ipos, var in node.eq_checks:
+                valid = valid & (inner[ipos] == cols[var])
             return cols, valid, jnp.sum(valid)
         if isinstance(node, ValuesSpec):
             cols = {v: values[node.values_idx][i] for i, v in enumerate(node.vars)}
@@ -307,10 +354,17 @@ def _plan_body(
 
 @partial(jax.jit, static_argnames=("spec", "use_pallas"))
 def _run_plan(
-    spec: PlanSpec, use_pallas: bool, order_arrays, scalars, masks, values, numf
+    spec: PlanSpec,
+    use_pallas: bool,
+    order_arrays,
+    scalars,
+    masks,
+    values,
+    numf,
+    quoted,
 ):
     return _plan_body(
-        spec, order_arrays, scalars, masks, values, numf, use_pallas
+        spec, order_arrays, scalars, masks, values, numf, quoted, use_pallas
     )
 
 
@@ -324,6 +378,7 @@ def _run_plan_k(
     masks,
     values,
     numf,
+    quoted,
 ):
     """Execute the SAME compiled plan body ``k`` times in one dispatch with a
     loop-carried dependency (benchmark amortization: the shared-TPU tunnel's
@@ -338,7 +393,7 @@ def _run_plan_k(
         # hoist the iteration body because scalars depends on the carry
         sc = scalars + (carry >> jnp.int64(62)).astype(scalars.dtype)
         out, valid, _counts = _plan_body(
-            spec, order_arrays, sc, masks, values, numf, use_pallas
+            spec, order_arrays, sc, masks, values, numf, quoted, use_pallas
         )
         checksum = sum(c.astype(jnp.uint64).sum() for c in out)
         nrows = jnp.sum(valid).astype(jnp.int64)
@@ -375,6 +430,8 @@ class LoweredPlan:
         self._order_idx: Dict[str, int] = {}
         self.join_count = 0
         self.need_numf = False
+        self.need_quoted = False
+        self.quoted_specs: List[str] = []  # synthetic qid column names
         # fully-constant patterns: hoisted out of the join tree as host
         # membership guards — a failed guard empties the whole result
         # (engine.rs:144-260 evaluates them as 0/1-row scans; here they
@@ -405,7 +462,7 @@ class LoweredPlan:
             elif isinstance(node, JoinSpec):
                 collect(node.left)
                 collect(node.right)
-            elif isinstance(node, FilterSpec):
+            elif isinstance(node, (FilterSpec, QuotedExpandSpec)):
                 collect(node.child)
 
         collect(self.root)
@@ -437,6 +494,14 @@ class LoweredPlan:
                 )
             if isinstance(node, FilterSpec):
                 return FilterSpec(rebuild(node.child), node.expr)
+            if isinstance(node, QuotedExpandSpec):
+                return QuotedExpandSpec(
+                    rebuild(node.child),
+                    node.qvar,
+                    node.out_vars,
+                    node.const_checks,
+                    node.eq_checks,
+                )
             return node
 
         self.root = rebuild(self.root)
@@ -527,7 +592,8 @@ class LoweredPlan:
     def _lower_scan(self, pattern: PatternTriple):
         terms = [pattern.subject, pattern.predicate, pattern.object]
         consts: List[Optional[int]] = []
-        for t in terms:
+        quoted_at: List[tuple] = []  # (outer_pos, synthetic var, inner terms)
+        for pos, t in enumerate(terms):
             if t.kind == "id":
                 if t.value is None:
                     raise Unsupported("unknown constant (empty scan)")
@@ -535,7 +601,13 @@ class LoweredPlan:
             elif t.kind == "var":
                 consts.append(None)
             else:
-                raise Unsupported("quoted pattern scan")
+                # quoted term with inner variables (ground quoted terms were
+                # folded to their qid by resolve_pattern); scan the position
+                # as a synthetic qid variable, then expand it against the
+                # device quoted table
+                qvar = f"__qt{len(self.quoted_specs)}{len(quoted_at)}"
+                quoted_at.append((pos, qvar, t.value))
+                consts.append(None)
         bound = frozenset(i for i, c in enumerate(consts) if c is not None)
         # fully-constant patterns never reach here: _lower hoists them into
         # const_checks before calling _lower_scan
@@ -547,9 +619,12 @@ class LoweredPlan:
         eq_pairs: List[tuple] = []
         seen: Dict[str, int] = {}
         for pos, t in enumerate(terms):
-            if t.kind != "var":
+            if t.kind == "var":
+                name = t.value
+            elif t.kind == "quoted":
+                name = next(q for p, q, _ in quoted_at if p == pos)
+            else:
                 continue
-            name = t.value
             if name in seen:
                 eq_pairs.append((seen[name], pos))
             else:
@@ -557,8 +632,44 @@ class LoweredPlan:
                 out_vars.append((name, pos))
         if not out_vars:
             raise Unsupported("pattern binds no variables")
-        spec = ScanSpec(order_idx, scan_idx, tuple(out_vars), tuple(eq_pairs), 0)
-        return spec, set(seen)
+        node: object = ScanSpec(
+            order_idx, scan_idx, tuple(out_vars), tuple(eq_pairs), 0
+        )
+        bound_vars = {v for v in seen if not v.startswith("__qt")}
+        for _pos, qvar, inner in quoted_at:
+            node, bound_vars = self._wrap_quoted(node, qvar, inner, bound_vars)
+        return node, bound_vars
+
+    def _wrap_quoted(self, node, qvar: str, inner, bound_vars: set):
+        """Wrap ``node`` with one :class:`QuotedExpandSpec` for the quoted
+        term ``inner`` scanned into synthetic column ``qvar``."""
+        q_out: List[tuple] = []
+        q_const: List[tuple] = []
+        q_eq: List[tuple] = []
+        newly: set = set()
+        for ipos, it in enumerate(inner):
+            if it.kind == "id":
+                if it.value is None:
+                    raise Unsupported("unknown constant in quoted pattern")
+                q_const.append((ipos, int(it.value)))
+            elif it.kind == "var":
+                name = it.value
+                if name in bound_vars or name in newly:
+                    q_eq.append((ipos, name))  # collision or repeat
+                else:
+                    q_out.append((name, ipos))
+                    newly.add(name)
+            else:
+                # host engine has the same limit (_join_quoted raises)
+                raise Unsupported("doubly-nested quoted pattern")
+        self.quoted_specs.append(qvar)
+        self.need_quoted = True
+        return (
+            QuotedExpandSpec(
+                node, qvar, tuple(q_out), tuple(q_const), tuple(q_eq)
+            ),
+            bound_vars | newly,
+        )
 
     def _try_presort_scan(self, node, key_var: str) -> Optional[ScanSpec]:
         """If ``node`` is a bare scan (prefix validity) re-pick its order so
@@ -756,6 +867,14 @@ class LoweredPlan:
             return FilterSpec(
                 self._with_caps(node.child, scan_caps, join_caps), node.expr
             )
+        if isinstance(node, QuotedExpandSpec):
+            return QuotedExpandSpec(
+                self._with_caps(node.child, scan_caps, join_caps),
+                node.qvar,
+                node.out_vars,
+                node.const_checks,
+                node.eq_checks,
+            )
         return node
 
     def _node_cap(self, node, scan_caps, join_caps) -> int:
@@ -763,7 +882,7 @@ class LoweredPlan:
             return scan_caps[node.scan_idx]
         if isinstance(node, JoinSpec):
             return join_caps[node.join_idx]
-        if isinstance(node, FilterSpec):
+        if isinstance(node, (FilterSpec, QuotedExpandSpec)):
             return self._node_cap(node.child, scan_caps, join_caps)
         if isinstance(node, ValuesSpec):
             return node.n
@@ -818,7 +937,12 @@ class LoweredPlan:
         else:
             numf = jnp.zeros(1, dtype=jnp.float32)
         scalars = jnp.asarray(self._scan_ranges_np)
-        return spec, (order_arrays, scalars, masks, values, numf)
+        quoted = (
+            device_quoted(self.db)
+            if self.need_quoted
+            else tuple(jnp.zeros(1, dtype=jnp.uint32) for _ in range(4))
+        )
+        return spec, (order_arrays, scalars, masks, values, numf, quoted)
 
     def _device_numf(self):
         return device_numf(self.db)
@@ -919,6 +1043,30 @@ class LoweredPlan:
             if isinstance(node, FilterSpec):
                 cols = eval_node(node.child)
                 mask = eval_expr(node.expr, cols)
+                return {k: v[mask] for k, v in cols.items()}
+            if isinstance(node, QuotedExpandSpec):
+                from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+                cols = eval_node(node.child)
+                qcol = cols.pop(node.qvar)
+                n_q = len(self.db.quoted)
+                qid = np.full(n_q + 1, 0xFFFFFFFF, dtype=np.uint32)
+                qrows = np.zeros((n_q + 1, 3), dtype=np.uint32)
+                for i, (q, spo) in enumerate(self.db.quoted.items()):
+                    qid[i] = q
+                    qrows[i] = spo
+                order_q = np.argsort(qid, kind="stable")
+                qid, qrows = qid[order_q], qrows[order_q]
+                pos = np.searchsorted(qid, qcol)
+                posc = np.minimum(pos, n_q)
+                mask = (qid[posc] == qcol) & ((qcol & QUOTED_BIT) != 0)
+                inner = [qrows[posc, i] for i in range(3)]
+                for ipos, cid in node.const_checks:
+                    mask = mask & (inner[ipos] == cid)
+                for var, ipos in node.out_vars:
+                    cols[var] = inner[ipos]
+                for ipos, var in node.eq_checks:
+                    mask = mask & (inner[ipos] == cols[var])
                 return {k: v[mask] for k, v in cols.items()}
             raise TypeError(node)
 
@@ -1238,6 +1386,31 @@ def try_device_execute_aggregated(
     return aggregate_table(
         db, tuple(out_cols), valid, q.group_by, agg_items, gpos, funcs, apos
     )
+
+
+def device_quoted(db):
+    """Per-database device copy of the quoted-triple table, sorted by qid
+    (``(qid_sorted, s, p, o)``), cached until the quoted store grows.  One
+    sentinel row (all-ones qid — never a real ID) keeps shapes non-empty
+    and unmatched when the store has no quoted triples."""
+    import jax.numpy as jnp
+
+    cache = db.__dict__.get("_device_qt_cache")
+    n = len(db.quoted)
+    if cache is not None and cache[0] == n:
+        return cache[1]
+    qid = np.full(n + 1, 0xFFFFFFFF, dtype=np.uint32)
+    qs = np.zeros(n + 1, dtype=np.uint32)
+    qp = np.zeros(n + 1, dtype=np.uint32)
+    qo = np.zeros(n + 1, dtype=np.uint32)
+    for i, (q, (s, p, o)) in enumerate(db.quoted.items()):
+        qid[i], qs[i], qp[i], qo[i] = q, s, p, o
+    order = np.argsort(qid[: n + 1], kind="stable")
+    arrs = tuple(
+        jnp.asarray(a[order]) for a in (qid, qs, qp, qo)
+    )
+    db.__dict__["_device_qt_cache"] = (n, arrs)
+    return arrs
 
 
 def device_numf(db):

@@ -187,9 +187,15 @@ class ExecutionEngine:
         for part, col in (("s", inner_s), ("p", inner_p), ("o", inner_o)):
             if col.kind == "id":
                 m &= qt[part] == col.value
+        inner_seen: Dict[str, str] = {}
         for part, col in (("s", inner_s), ("p", inner_p), ("o", inner_o)):
             if col.kind == "var":
-                qtab[col.value] = qt[part]
+                if col.value in inner_seen:
+                    # repeated inner variable (<< ?x p ?x >>): rows must agree
+                    m &= qt[part] == qt[inner_seen[col.value]]
+                else:
+                    inner_seen[col.value] = part
+                    qtab[col.value] = qt[part]
             elif col.kind == "quoted":
                 raise NotImplementedError(
                     "doubly-nested quoted variable patterns in scans"
