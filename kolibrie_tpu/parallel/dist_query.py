@@ -23,10 +23,12 @@ sort-unique is globally exact), ORDER BY + LIMIT (mesh-side per-shard
 numeric-key top-k, O(k·n) readback, host re-orders the union; non-numeric
 sort keys re-run without the top-k stage and order on host; for rows tied
 at the k boundary the kept representative may differ from the host
-executor's stable order — both are valid SPARQL answers).
-Everything else (BIND, VALUES, OPTIONAL, UNION, subqueries, aggregates,
-windows) raises :class:`Unsupported` — callers fall back to the single-chip
-engine, mirroring the device engine's own fallback contract.
+executor's stable order — both are valid SPARQL answers), and BIND (the
+mesh gathers all pattern variables; binds + bind-reading filters apply
+host-side to the small result table — the single-chip device split).
+Everything else (VALUES, OPTIONAL, UNION, subqueries, windows; BIND mixed
+with aggregates) raises :class:`Unsupported` — callers fall back to the
+single-chip engine, mirroring the device engine's own fallback contract.
 
 Parity: the reference has NO distributed execution (SURVEY §2.6) — this is
 the TPU-native axis it lacks.  Row agreement with the host volcano executor
@@ -376,8 +378,7 @@ class DistQueryExecutor:
             raise Unsupported("distributed path executes plain SELECT only")
         w = q.where
         if (
-            w.binds
-            or w.values is not None
+            w.values is not None
             or w.subqueries
             or w.not_blocks
             or w.window_blocks
@@ -391,6 +392,27 @@ class DistQueryExecutor:
         resolved = [resolve_pattern(db, p) for p in w.patterns]
         self.premises = tuple(_lower_query_pattern(p) for p in resolved)
         bound = {v for pr in self.premises for v, _ in pr.vars}
+        # BINDs: the mesh program computes the BGP; binds (and any filter
+        # that reads a bind output) apply HOST-side to the gathered table —
+        # the single-chip device split (results are small next to the
+        # store).  Bind inputs must be pattern variables (or earlier bind
+        # outputs, applied in order).
+        self.binds = list(w.binds)
+        bind_vars = {b.var for b in self.binds}
+        if self.binds and (
+            q.group_by or any(i.kind == "agg" for i in q.select)
+        ):
+            raise Unsupported("BIND with aggregates stays single-chip")
+        from kolibrie_tpu.query.executor import _filter_vars
+
+        plan_filters = [
+            f
+            for f in w.filters
+            if not (set(_filter_vars(f)) & bind_vars)
+        ]
+        self.post_bind_filters = [
+            f for f in w.filters if set(_filter_vars(f)) & bind_vars
+        ]
         # GROUP BY + aggregates (BASELINE config 2 distributed): the plan's
         # out columns stay mesh-resident and flow into the single-chip
         # segment aggregator (XLA all-gathers the post-join/post-filter
@@ -423,13 +445,21 @@ class DistQueryExecutor:
             raise Unsupported("expressions in SELECT")
         elif q.select_all():
             self.out_vars = tuple(sorted(bound))
+        elif self.binds:
+            # binds may reference any pattern variable: gather them ALL,
+            # apply binds host-side, project afterwards (run())
+            sel = tuple(item.var for item in q.select)
+            missing = set(sel) - bound - bind_vars
+            if missing:
+                raise Unsupported(f"projected variables unbound: {missing}")
+            self.out_vars = tuple(sorted(bound))
         else:
             self.out_vars = tuple(item.var for item in q.select)
             missing = set(self.out_vars) - bound
             if missing:
                 raise Unsupported(f"projected variables unbound: {missing}")
         self.filters, self.mask_exprs = _lower_query_filters(
-            w.filters, db, bound
+            plan_filters, db, bound
         )
         plans = _plan_rule_dist(self.premises)
         # seed at the most selective premise (most constant positions)
@@ -627,6 +657,46 @@ class DistQueryExecutor:
             rows.sort()
         return _apply_limit_offset(rows, q)
 
+    def _run_with_binds(self) -> List[List[str]]:
+        """BIND tail: the mesh program gathers ALL pattern variables, then
+        binds, post-bind filters, DISTINCT, ordering and the final
+        projection run host-side on the (small) result table — the same
+        split the single-chip device path uses.  Mesh DISTINCT/top-k
+        stages are disabled here: they would act on pre-bind tuples."""
+        from kolibrie_tpu.optimizer.engine import ExecutionEngine
+        from kolibrie_tpu.ops.unique import unique_table
+        from kolibrie_tpu.query.executor import (
+            _apply_limit_offset,
+            _order_table,
+            format_results,
+        )
+
+        q = self.query
+        outs, valid, _total, _nan = self.run_device()
+        v = np.asarray(valid).reshape(-1)
+        table = {
+            var: np.asarray(col).reshape(-1)[v].astype(np.uint32)
+            for var, col in zip(self.out_vars, outs)
+        }
+        engine = ExecutionEngine(self.db)
+        for b in self.binds:
+            col = engine.eval_arith_to_ids(b.expr, table)
+            table = dict(table)
+            table[b.var] = col
+        for f in self.post_bind_filters:
+            mask = engine.eval_filter(f, table)
+            table = {k: c[mask] for k, c in table.items()}
+        if not q.select_all():
+            sel = [item.var for item in q.select]
+            table = {k: table[k] for k in sel if k in table}
+        if q.distinct and table:
+            table = unique_table(table)
+        table = _order_table(self.db, table, q.order_by)
+        rows = format_results(self.db, table, q)
+        if not q.order_by:
+            rows.sort()
+        return _apply_limit_offset(rows, q)
+
     def run(self) -> List[List[str]]:
         """Execute and return decoded rows identical to the host volcano
         executor (same formatting, ordering, DISTINCT, LIMIT post-passes)."""
@@ -639,6 +709,8 @@ class DistQueryExecutor:
         if self.agg_items or self.query.group_by:
             return self._run_aggregated()
         q = self.query
+        if self.binds:
+            return self._run_with_binds()
         # mesh-side ORDER BY + LIMIT: per-shard numeric top-k when every
         # sort key is a projected variable (host re-orders the k·n rows)
         topk = None

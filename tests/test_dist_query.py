@@ -120,10 +120,12 @@ def test_constant_subject_and_limit(mesh):
 
 def test_unsupported_shapes_raise(mesh, lubm_db):
     with pytest.raises(Unsupported):
+        # VALUES stays single-chip (BIND is now a host tail — see
+        # test_bind_host_tail_agreement)
         DistQueryExecutor(
             mesh,
             lubm_db,
-            "SELECT ?x WHERE { ?x ?p ?y . BIND((1+1) AS ?b) }",
+            'SELECT ?x WHERE { ?x ?p ?y . VALUES ?y { "1" "2" } }',
         )
     with pytest.raises(Unsupported):
         # GROUP_CONCAT stays host-side (same contract as the single-chip
@@ -285,3 +287,42 @@ def test_order_by_string_key_host_fallback(mesh):
     dist = execute_query_distributed(q, db, mesh)
     assert len(host) == 5
     assert dist == host
+
+
+def test_bind_host_tail_agreement(mesh):
+    """BINDs apply host-side to the gathered table (single-chip split):
+    arithmetic bind, a filter reading the bind output, DISTINCT and
+    ORDER BY over the bind column all agree with the host executor."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(150):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 6}> ."
+        )
+        lines.append(
+            f'{e} <http://example.org/salary> "{30000 + (i % 25) * 1000}" .'
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?total WHERE {
+        ?e ex:worksAt ?o .
+        ?e ex:salary ?s .
+        BIND(?s * 1.1 AS ?total)
+        FILTER(?total > 40000)
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
+    q2 = """PREFIX ex: <http://example.org/>
+    SELECT DISTINCT ?o ?bonus WHERE {
+        ?e ex:worksAt ?o .
+        ?e ex:salary ?s .
+        BIND(?s + 500 AS ?bonus)
+    } ORDER BY DESC(?bonus) LIMIT 6"""
+    host2 = execute_query_volcano(q2, db)
+    dist2 = execute_query_distributed(q2, db, mesh)
+    assert len(host2) == 6
+    assert dist2 == host2
