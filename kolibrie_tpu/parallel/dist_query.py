@@ -26,9 +26,12 @@ at the k boundary the kept representative may differ from the host
 executor's stable order — both are valid SPARQL answers), and BIND (the
 mesh gathers all pattern variables; binds + bind-reading filters apply
 host-side to the small result table — the single-chip device split).
-Everything else (VALUES, OPTIONAL, UNION, subqueries, windows; BIND mixed
-with aggregates) raises :class:`Unsupported` — callers fall back to the
-single-chip engine, mirroring the device engine's own fallback contract.
+VALUES in its constraining form (one BGP-bound variable, distinct bound
+cells) lowers to a replicated membership mask inside the mesh program.
+Everything else (general VALUES, OPTIONAL, UNION, subqueries, windows;
+BIND mixed with aggregates) raises :class:`Unsupported` — callers fall
+back to the single-chip engine, mirroring the device engine's own
+fallback contract.
 
 Parity: the reference has NO distributed execution (SURVEY §2.6) — this is
 the TPU-native axis it lacks.  Row agreement with the host volcano executor
@@ -174,6 +177,7 @@ def _query_body(
     state,
     masks,
     numf,
+    vals,
     *,
     premises,
     seed,
@@ -186,6 +190,7 @@ def _query_body(
     bucket_cap,
     distinct=False,
     topk=None,
+    values_var=None,
 ):
     fs, fp, fo, fv, gs, gp, go, gv = (a[0] for a in state)
     masks = tuple(masks)
@@ -231,6 +236,12 @@ def _query_body(
         else:
             m = masks[f.mask_idx]
             valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+
+    if values_var is not None:
+        # replicated VALUES membership: sorted array + searchsorted per row
+        col = table[values_var]
+        vpos = jnp.clip(jnp.searchsorted(vals, col), 0, vals.shape[0] - 1)
+        valid = valid & (vals[vpos] == col)
 
     if distinct and out_vars:
         # mesh-side DISTINCT: equal projection tuples hash to the same
@@ -308,6 +319,7 @@ def _query_fn(
     bucket_cap,
     distinct=False,
     topk=None,
+    values_var=None,
 ):
     axis = mesh.axis_names[0]
     n = mesh.devices.size
@@ -324,14 +336,15 @@ def _query_fn(
         bucket_cap=bucket_cap,
         distinct=distinct,
         topk=topk,
+        values_var=values_var,
     )
     spec = P(axis, None)
     return jax.jit(
         jax.shard_map(
-            lambda state, masks, numf: body(state, masks, numf),
+            lambda state, masks, numf, vals: body(state, masks, numf, vals),
             mesh=mesh,
             check_vma=_dist_check_vma(),
-            in_specs=((spec,) * 8, (P(),) * n_masks, P()),
+            in_specs=((spec,) * 8, (P(),) * n_masks, P(), P()),
             out_specs=(
                 (spec,) * len(out_vars),
                 spec,
@@ -378,8 +391,7 @@ class DistQueryExecutor:
             raise Unsupported("distributed path executes plain SELECT only")
         w = q.where
         if (
-            w.values is not None
-            or w.subqueries
+            w.subqueries
             or w.not_blocks
             or w.window_blocks
             or w.optionals
@@ -392,6 +404,30 @@ class DistQueryExecutor:
         resolved = [resolve_pattern(db, p) for p in w.patterns]
         self.premises = tuple(_lower_query_pattern(p) for p in resolved)
         bound = {v for pr in self.premises for v, _ in pr.vars}
+        # VALUES in its constraining form — ONE variable that the BGP
+        # binds, all cells bound and distinct — lowers to a replicated
+        # membership mask inside the mesh program (a sorted array +
+        # searchsorted per row).  General VALUES (multi-var, UNBOUND
+        # wildcards, duplicate rows => bag multiplicity) stays single-chip.
+        self.values_var: Optional[str] = None
+        self.values_ids: Optional[np.ndarray] = None
+        if w.values is not None:
+            if len(w.values.variables) != 1:
+                raise Unsupported("multi-variable VALUES stays single-chip")
+            vvar = w.values.variables[0]
+            if vvar not in bound:
+                raise Unsupported("VALUES variable unbound in patterns")
+            ids = []
+            for row in w.values.rows:
+                term = row[0] if row else None
+                if term is None:
+                    raise Unsupported("UNBOUND VALUES cell stays single-chip")
+                ids.append(db.dictionary.encode(db.expand_term(term)))
+            if len(set(ids)) != len(ids):
+                # duplicate cells change bag multiplicity, not membership
+                raise Unsupported("duplicate VALUES cells stay single-chip")
+            self.values_var = vvar
+            self.values_ids = np.sort(np.asarray(ids, dtype=np.uint32))
         # BINDs: the mesh program computes the BGP; binds (and any filter
         # that reads a bind output) apply HOST-side to the gathered table —
         # the single-chip device split (results are small next to the
@@ -595,6 +631,11 @@ class DistQueryExecutor:
             if topk is not None
             else np.zeros(1, dtype=np.float64)
         )
+        vals = (
+            self.values_ids
+            if self.values_var is not None
+            else np.zeros(1, dtype=np.uint32)
+        )
         for _attempt in range(max_attempts):
             fn = _query_fn(
                 self.mesh,
@@ -608,10 +649,11 @@ class DistQueryExecutor:
                 self.bucket_cap,
                 distinct,
                 topk,
+                self.values_var,
             )
             with jax.enable_x64(True):
                 outs, valid, total, overflow, nan_flag = fn(
-                    state, masks, numf
+                    state, masks, numf, vals
                 )
             if int(overflow[0]) == 0:
                 return outs, valid, total, nan_flag

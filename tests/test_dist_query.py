@@ -120,12 +120,12 @@ def test_constant_subject_and_limit(mesh):
 
 def test_unsupported_shapes_raise(mesh, lubm_db):
     with pytest.raises(Unsupported):
-        # VALUES stays single-chip (BIND is now a host tail — see
-        # test_bind_host_tail_agreement)
+        # OPTIONAL stays single-chip (BIND is a host tail and constraining
+        # VALUES a mesh membership mask — see their agreement tests)
         DistQueryExecutor(
             mesh,
             lubm_db,
-            'SELECT ?x WHERE { ?x ?p ?y . VALUES ?y { "1" "2" } }',
+            "SELECT ?x WHERE { ?x ?p ?y . OPTIONAL { ?y ?q ?z } }",
         )
     with pytest.raises(Unsupported):
         # GROUP_CONCAT stays host-side (same contract as the single-chip
@@ -326,3 +326,36 @@ def test_bind_host_tail_agreement(mesh):
     dist2 = execute_query_distributed(q2, db, mesh)
     assert len(host2) == 6
     assert dist2 == host2
+
+
+def test_values_membership_agreement(mesh):
+    """Constraining VALUES lowers to a replicated membership mask in the
+    mesh program; general shapes still raise."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(90):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 9}> ."
+        )
+        lines.append(f'{e} <http://example.org/grade> "g{i % 4}" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?o WHERE {
+        ?e ex:worksAt ?o .
+        ?e ex:grade ?g .
+        VALUES ?g { "g1" "g3" }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
+    with pytest.raises(Unsupported):
+        # duplicate cells change bag multiplicity -> single-chip
+        DistQueryExecutor(
+            mesh,
+            db,
+            """PREFIX ex: <http://example.org/>
+            SELECT ?e WHERE { ?e ex:grade ?g . VALUES ?g { "g1" "g1" } }""",
+        )
