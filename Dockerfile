@@ -16,8 +16,10 @@ FROM python:${BASE_TAG}
 ARG ENABLE_WEB_UI=true
 ARG BASE_PIP_EXTRAS="jax"
 
-RUN pip install --no-cache-dir ${BASE_PIP_EXTRAS} numpy \
-    && pip install --no-cache-dir scikit-learn psutil || true
+# mandatory compute deps: a failure here must fail the build
+RUN pip install --no-cache-dir ${BASE_PIP_EXTRAS} numpy
+# optional ML-example deps: the framework degrades gracefully without them
+RUN pip install --no-cache-dir scikit-learn psutil || true
 
 WORKDIR /app
 COPY kolibrie_tpu /app/kolibrie_tpu

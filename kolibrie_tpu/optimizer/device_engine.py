@@ -1049,18 +1049,11 @@ class LoweredPlan:
 
                 cols = eval_node(node.child)
                 qcol = cols.pop(node.qvar)
-                n_q = len(self.db.quoted)
-                qid = np.full(n_q + 1, 0xFFFFFFFF, dtype=np.uint32)
-                qrows = np.zeros((n_q + 1, 3), dtype=np.uint32)
-                for i, (q, spo) in enumerate(self.db.quoted.items()):
-                    qid[i] = q
-                    qrows[i] = spo
-                order_q = np.argsort(qid, kind="stable")
-                qid, qrows = qid[order_q], qrows[order_q]
+                qid, qs_, qp_, qo_ = host_quoted_table(self.db)
                 pos = np.searchsorted(qid, qcol)
-                posc = np.minimum(pos, n_q)
+                posc = np.minimum(pos, len(qid) - 1)
                 mask = (qid[posc] == qcol) & ((qcol & QUOTED_BIT) != 0)
-                inner = [qrows[posc, i] for i in range(3)]
+                inner = [qs_[posc], qp_[posc], qo_[posc]]
                 for ipos, cid in node.const_checks:
                     mask = mask & (inner[ipos] == cid)
                 for var, ipos in node.out_vars:
@@ -1388,14 +1381,13 @@ def try_device_execute_aggregated(
     )
 
 
-def device_quoted(db):
-    """Per-database device copy of the quoted-triple table, sorted by qid
-    (``(qid_sorted, s, p, o)``), cached until the quoted store grows.  One
-    sentinel row (all-ones qid — never a real ID) keeps shapes non-empty
-    and unmatched when the store has no quoted triples."""
-    import jax.numpy as jnp
-
-    cache = db.__dict__.get("_device_qt_cache")
+def host_quoted_table(db):
+    """Per-database qid-sorted quoted table as numpy ``(qid, s, p, o)``,
+    cached until the quoted store grows.  One sentinel row (all-ones qid —
+    never a real ID) keeps shapes non-empty and unmatched when the store
+    has no quoted triples.  Shared by the device upload
+    (:func:`device_quoted`) and ``host_execute``'s oracle twin."""
+    cache = db.__dict__.get("_host_qt_cache")
     n = len(db.quoted)
     if cache is not None and cache[0] == n:
         return cache[1]
@@ -1405,10 +1397,21 @@ def device_quoted(db):
     qo = np.zeros(n + 1, dtype=np.uint32)
     for i, (q, (s, p, o)) in enumerate(db.quoted.items()):
         qid[i], qs[i], qp[i], qo[i] = q, s, p, o
-    order = np.argsort(qid[: n + 1], kind="stable")
-    arrs = tuple(
-        jnp.asarray(a[order]) for a in (qid, qs, qp, qo)
-    )
+    order = np.argsort(qid, kind="stable")
+    arrs = tuple(a[order] for a in (qid, qs, qp, qo))
+    db.__dict__["_host_qt_cache"] = (n, arrs)
+    return arrs
+
+
+def device_quoted(db):
+    """Device copy of :func:`host_quoted_table`, cached alongside it."""
+    import jax.numpy as jnp
+
+    cache = db.__dict__.get("_device_qt_cache")
+    n = len(db.quoted)
+    if cache is not None and cache[0] == n:
+        return cache[1]
+    arrs = tuple(jnp.asarray(a) for a in host_quoted_table(db))
     db.__dict__["_device_qt_cache"] = (n, arrs)
     return arrs
 

@@ -277,28 +277,21 @@ def _query_body(
 
     nan_seen = jnp.zeros((), dtype=bool)
     if topk is not None:
-        # mesh-side ORDER BY + LIMIT: per-shard numeric-key top-k (device
-        # engine `_order_limit` twin) — the union of per-shard top-k
-        # contains the global top-k, so readback is O(k·n), and the host
-        # re-orders those k·n rows for the final slice.  A NaN sort key
-        # (non-numeric term) sets the replicated flag: the caller must
-        # re-run without topk and use host string-rank ordering.
+        # mesh-side ORDER BY + LIMIT: per-shard numeric-key top-k through
+        # the device engine's `_order_limit` (one definition of the lexsort
+        # composition) — the union of per-shard top-k contains the global
+        # top-k, so readback is O(k·n), and the host re-orders those k·n
+        # rows for the final slice.  A NaN sort key (non-numeric term)
+        # sets the replicated flag: the caller must re-run without topk
+        # and use host string-rank ordering.
+        from kolibrie_tpu.optimizer.device_engine import _order_limit
+
         k, opos, descs = topk
         cols_t = tuple(table[v] for v in out_vars)
-        L = cols_t[0].shape[0] if cols_t else valid.shape[0]
-        perm = jnp.arange(L, dtype=jnp.int32)
-        keys = []
-        for pos, desc in zip(opos, descs):
-            vals = numf[jnp.minimum(cols_t[pos], numf.shape[0] - 1)]
-            nan_seen = nan_seen | jnp.any(jnp.isnan(vals) & valid)
-            keys.append(-vals if desc else vals)
-        for key in reversed(keys):
-            perm = perm[jnp.argsort(key[perm], stable=True)]
-        vkey = jnp.where(valid, 0, 1)
-        perm = perm[jnp.argsort(vkey[perm], stable=True)]
-        top = perm[:k]
-        table = {v: c[top] for v, c in zip(out_vars, cols_t)}
-        valid = valid[top]
+        top_cols, valid, _n_valid, nan_seen = _order_limit(
+            cols_t, valid, numf, opos, descs, k
+        )
+        table = dict(zip(out_vars, top_cols))
 
     outs = tuple(jnp.where(valid, table[v], 0)[None] for v in out_vars)
     total_rows = lax.psum(jnp.sum(valid).astype(jnp.int32), axis)
