@@ -695,6 +695,10 @@ class DistProvenanceReasoner:
         self.provenance = provenance
         self.tag_store = tag_store
         self.rules, self.bank = lower_rules_dist(reasoner, reasoner.rules)
+        if any(lr.guards for lr, _ in self.rules):
+            # a dropped ground guard premise still contributes its TAG to
+            # every derivation's ⊗ — the tagged rounds don't fold it
+            raise Unsupported("ground guard premise needs host tag folding")
         self.pos_rules = tuple(
             (lr, pl) for lr, pl in self.rules if not lr.negs
         )

@@ -20,9 +20,12 @@ pre-round state and an overflow code); the host driver doubles the failing
 capacity and re-enters the loop from the preserved state.  Readback happens
 once per ``while_loop`` exit, not per round.
 
-Rules whose shapes the device path cannot express (quoted-triple premises or
-conclusions, non-numeric filters, cartesian premise joins) raise
-:class:`Unsupported`; callers fall back to the host strategies.  3-variable
+GROUND quoted (RDF-star) terms lower to their qid constants — premises
+against never-interned triples become never-match scans, quoted
+conclusions intern eagerly at lowering.  Rules whose shapes the device
+path cannot express (quoted terms with INNER VARIABLES, non-numeric
+filters, cartesian premise joins) raise :class:`Unsupported`; callers
+fall back to the host strategies.  3-variable
 join keys ride the union dense-rank composition
 (``ops/device_join.py::pack_key_multi``).  Agreement between both paths is
 tested in ``tests/test_device_fixpoint.py``.
@@ -78,16 +81,53 @@ class LoweredRule:
     # per seed position: premise evaluation order (seed first) and the join
     # key variables for each subsequent step
     plans: tuple  # ((order: tuple[int], keys: tuple[tuple[str,...]]), ...)
+    # fully-ground GUARD premises dropped from the join plan after static
+    # satisfaction (see lower_rules: non-derivable + present in the initial
+    # facts — facts never retract, so the gate holds for the whole closure).
+    # Kept for the tagged drivers, whose ⊗ would need the guard's tag.
+    guards: tuple = ()
 
 
-def _lower_pattern(pattern, dictionary) -> LoweredPremise:
+def _ground_quoted_id(term, quoted) -> Optional[int]:
+    """qid of a GROUND quoted term (recursively constant inner triple), or
+    None when the triple is not interned — a premise against it can never
+    match.  Raises Unsupported for quoted terms with inner variables (the
+    host unification path covers those)."""
+    inner = term.value.terms()
+    ids = []
+    for t in inner:
+        if t.is_quoted:
+            qid = _ground_quoted_id(t, quoted)
+            if qid is None:
+                return None
+            ids.append(qid)
+        elif t.is_constant:
+            ids.append(int(t.value))
+        else:
+            raise Unsupported("quoted-triple pattern with inner variables")
+    if quoted is None:
+        raise Unsupported("quoted-triple pattern without a quoted store")
+    return quoted.lookup(*ids)
+
+
+# never a dictionary ID (bits 0..30 + quoted bit 31, not all-ones): a scan
+# constant that matches nothing — the lowering of a ground quoted premise
+# whose triple was never interned
+_NEVER_MATCH = 0xFFFFFFFF
+
+
+def _lower_pattern(pattern, dictionary, quoted=None) -> LoweredPremise:
     consts: List[Optional[int]] = []
     out_vars: List[tuple] = []
     eq_pairs: List[tuple] = []
     seen: Dict[str, int] = {}
     for pos, t in enumerate(pattern.terms()):
         if t.is_quoted:
-            raise Unsupported("quoted-triple pattern")
+            # ground quoted term → its qid constant (absent ⇒ never match);
+            # inner variables stay host-side (Unsupported from the helper)
+            qid = _ground_quoted_id(t, quoted)
+            consts.append(_NEVER_MATCH if qid is None else int(qid))
+            continue
         if t.is_constant:
             consts.append(int(t.value))
         else:
@@ -179,15 +219,54 @@ class _MaskBank:
         return out
 
 
+def _guard_derivable(guard: LoweredPremise, rules: List[Rule]) -> bool:
+    """Could any rule's conclusion unify with this fully-ground premise?
+    Conservative syntactic test (variables unify with anything; quoted
+    conclusion terms count as wildcards)."""
+    for r in rules:
+        for c in r.conclusion:
+            if all(
+                (not t.is_constant) or int(t.value) == g
+                for t, g in zip(c.terms(), guard.consts)
+            ):
+                return True
+    return False
+
+
 def lower_rules(reasoner, rules: List[Rule]) -> Tuple[tuple, _MaskBank]:
     bank = _MaskBank(reasoner)
     lowered: List[LoweredRule] = []
     for rule in rules:
-        prems = [_lower_pattern(p, reasoner.dictionary) for p in rule.premise]
+        quoted = getattr(reasoner, "quoted", None)
+        prems = [
+            _lower_pattern(p, reasoner.dictionary, quoted)
+            for p in rule.premise
+        ]
         if not prems:
             raise Unsupported("rule without positive premises")
+        # fully-ground GUARD premises (the RDF-star annotation-gate shape):
+        # facts never retract, so a guard that is non-derivable is STATIC —
+        # satisfied now ⇒ satisfied for the whole closure (drop the
+        # premise), absent now ⇒ the rule can never fire (drop the rule).
+        # A derivable guard can flip mid-closure, which the delta-seeded
+        # plans over the remaining premises would miss — host fallback.
+        guards = [p for p in prems if not p.vars]
+        if guards:
+            for g in guards:
+                if _guard_derivable(g, rules):
+                    raise Unsupported("derivable ground guard premise")
+            prems = [p for p in prems if p.vars]
+            if not prems:
+                raise Unsupported("fully ground rule")
+            if not all(
+                reasoner.facts.contains(*g.consts) for g in guards
+            ):
+                continue  # statically unsatisfiable: the rule never fires
         bound = {v for pr in prems for v, _ in pr.vars}
-        negs = [_lower_pattern(p, reasoner.dictionary) for p in rule.negative_premise]
+        negs = [
+            _lower_pattern(p, reasoner.dictionary, quoted)
+            for p in rule.negative_premise
+        ]
         for neg in negs:
             # the host path anti-joins on the SHARED variables only; a
             # negated variable outside the positive premises needs that
@@ -204,7 +283,22 @@ def lower_rules(reasoner, rules: List[Rule]) -> Tuple[tuple, _MaskBank]:
             terms = []
             for t in c.terms():
                 if t.is_quoted:
-                    raise Unsupported("quoted-triple conclusion")
+                    # a GROUND quoted conclusion is a constant qid; intern
+                    # eagerly (host interns on first derivation — the only
+                    # observable difference is the quoted-store entry
+                    # existing before the rule fires).  Inner variables
+                    # (constructing new quoted terms per binding) stay
+                    # host-side.
+                    inner = t.value.terms()
+                    if any(not it.is_constant for it in inner):
+                        raise Unsupported(
+                            "quoted-triple conclusion with inner variables"
+                        )
+                    if quoted is None:
+                        raise Unsupported("quoted conclusion without a store")
+                    qid = quoted.intern(*(int(it.value) for it in inner))
+                    terms.append(("const", int(qid)))
+                    continue
                 if t.is_constant:
                     terms.append(("const", int(t.value)))
                 else:
@@ -219,6 +313,7 @@ def lower_rules(reasoner, rules: List[Rule]) -> Tuple[tuple, _MaskBank]:
                 tuple(filters),
                 tuple(concls),
                 _plan_rule(prems),
+                tuple(guards),
             )
         )
     return tuple(lowered), bank
@@ -755,7 +850,8 @@ class DeviceFixpoint:
         r = self.reasoner
         s, p, o = r.facts.columns()
         n0 = len(s)
-        if n0 == 0:
+        if n0 == 0 or not self.rules:
+            # every rule was statically dead (unsatisfiable ground guards)
             return 0
         caps = initial_caps if initial_caps is not None else self._caps(n0)
         ofs, ofp, ofo, n_out, caps = self.infer_padded(
@@ -801,7 +897,7 @@ class DeviceFixpoint:
         r = self.reasoner
         s, p, o = r.facts.columns()
         n0 = len(s)
-        if n0 == 0:
+        if n0 == 0 or not self.rules:
             return 0
         masks = tuple(jnp.asarray(m) for m in self.bank.materialize()) or (
             jnp.zeros(1, dtype=bool),

@@ -807,6 +807,10 @@ def infer_provenance_device(
         return None
     if not rules:
         return None
+    if any(r.guards for r in rules):
+        # a dropped ground guard premise still contributes its TAG to every
+        # derivation's ⊗ — the tagged rounds don't fold it; host fallback
+        return None
     pos_rules = tuple(r for r in rules if not r.negs)
     naf_rules = tuple(r for r in rules if r.negs)
     if naf_rules and _naf_cross_blocking(naf_rules):

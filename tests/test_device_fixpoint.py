@@ -431,3 +431,231 @@ def test_three_shared_var_pallas_agreement(monkeypatch):
     host, dev, derived = both_closures(build)
     assert host == dev
     assert derived == 12
+
+
+def test_ground_quoted_premise_and_conclusion():
+    """Ground quoted (RDF-star) terms lower to qid constants (round 4):
+    annotation-gated derivation + a quoted conclusion, host oracle."""
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    def build():
+        r = Reasoner()
+        d = r.dictionary
+        a, p, b = d.encode(":a"), d.encode(":p"), d.encode(":b")
+        cert, high = d.encode(":certainty"), d.encode(":high")
+        ok, yes = d.encode(":ok"), d.encode(":yes")
+        qid = r.quoted.intern(a, p, b)
+        r.facts.add(qid, cert, high)
+        for i in range(6):
+            r.add_abox_triple(f"s{i}", ":edge", f"s{i + 1}")
+        C, V = Term.constant, Term.variable
+        ground_q = Term.quoted(TriplePattern(C(a), C(p), C(b)))
+        # premise gated on the annotation, quoted conclusion re-asserting it
+        r.add_rule(
+            Rule(
+                premise=[
+                    TriplePattern(ground_q, C(cert), C(high)),
+                    TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+                ],
+                conclusion=[
+                    TriplePattern(V("x"), C(ok), C(yes)),
+                    TriplePattern(ground_q, C(ok), C(yes)),
+                ],
+            )
+        )
+        return r
+
+    r_dev = build()
+    DeviceFixpoint(r_dev).infer()
+    r_host = build()
+    r_host.infer_new_facts_semi_naive()
+    assert r_dev.facts.triples_set() == r_host.facts.triples_set()
+    assert len(r_dev.facts.triples_set()) > 7  # derivations happened
+
+
+def test_never_interned_quoted_premise_matches_nothing():
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    r = Reasoner()
+    d = r.dictionary
+    C, V = Term.constant, Term.variable
+    r.add_abox_triple(":a", ":edge", ":b")
+    ghost = Term.quoted(
+        TriplePattern(
+            C(d.encode(":never")), C(d.encode(":was")), C(d.encode(":here"))
+        )
+    )
+    r.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(ghost, C(d.encode(":certainty")), V("c")),
+                TriplePattern(V("x"), C(d.encode(":edge")), V("c")),
+            ],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":bad")), V("c"))],
+        )
+    )
+    n0 = len(r.facts.triples_set())
+    DeviceFixpoint(r).infer()
+    assert len(r.facts.triples_set()) == n0  # nothing derived
+
+
+def test_variable_inner_quoted_falls_back():
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_fixpoint import (
+        DeviceFixpoint,
+        Unsupported,
+    )
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    r = Reasoner()
+    d = r.dictionary
+    C, V = Term.constant, Term.variable
+    a, p, b = d.encode(":a"), d.encode(":p"), d.encode(":b")
+    qid = r.quoted.intern(a, p, b)
+    r.facts.add(qid, d.encode(":certainty"), d.encode(":high"))
+    var_q = Term.quoted(TriplePattern(V("s"), V("pp"), V("o")))
+    r.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(var_q, C(d.encode(":certainty")), V("c"))
+            ],
+            conclusion=[TriplePattern(V("s"), V("pp"), V("o"))],
+        )
+    )
+    import pytest
+
+    with pytest.raises(Unsupported):
+        DeviceFixpoint(r)
+
+
+def test_ground_guard_premise_static_gating():
+    """A fully-ground (variable-free) premise is a STATIC guard: satisfied
+    => dropped from the join plan; absent => the rule is dropped; derivable
+    by some rule => host fallback."""
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_fixpoint import (
+        DeviceFixpoint,
+        Unsupported,
+    )
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    def base():
+        r = Reasoner()
+        d = r.dictionary
+        for i in range(5):
+            r.add_abox_triple(f"n{i}", ":edge", f"n{i + 1}")
+        return r, d, Term.constant, Term.variable
+
+    # satisfied guard: rule fires for every edge
+    r, d, C, V = base()
+    r.add_abox_triple(":mode", ":is", ":strict")
+    guard = TriplePattern(
+        C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
+    )
+    r.add_rule(
+        Rule(
+            premise=[guard, TriplePattern(V("x"), C(d.encode(":edge")), V("y"))],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":checked")), V("y"))],
+        )
+    )
+    h = Reasoner()  # host oracle twin
+    r_host, d2, C2, V2 = base()
+    r_host.add_abox_triple(":mode", ":is", ":strict")
+    r_host.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(
+                    C2(d2.encode(":mode")), C2(d2.encode(":is")), C2(d2.encode(":strict"))
+                ),
+                TriplePattern(V2("x"), C2(d2.encode(":edge")), V2("y")),
+            ],
+            conclusion=[TriplePattern(V2("x"), C2(d2.encode(":checked")), V2("y"))],
+        )
+    )
+    DeviceFixpoint(r).infer()
+    r_host.infer_new_facts_semi_naive()
+    assert r.facts.triples_set() == r_host.facts.triples_set()
+
+    # absent non-derivable guard: rule statically dead, derives nothing
+    r2, d, C, V = base()
+    r2.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(
+                    C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":loose"))
+                ),
+                TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+            ],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":skipped")), V("y"))],
+        )
+    )
+    n0 = len(r2.facts.triples_set())
+    DeviceFixpoint(r2).infer()
+    assert len(r2.facts.triples_set()) == n0
+
+    # derivable guard: host fallback
+    r3, d, C, V = base()
+    r3.add_rule(
+        Rule(
+            premise=[TriplePattern(V("x"), C(d.encode(":edge")), V("y"))],
+            conclusion=[
+                TriplePattern(
+                    C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
+                )
+            ],
+        )
+    )
+    r3.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(
+                    C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
+                ),
+                TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+            ],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":gated")), V("y"))],
+        )
+    )
+    import pytest
+
+    with pytest.raises(Unsupported):
+        DeviceFixpoint(r3)
+
+
+def test_tagged_guard_rule_falls_back():
+    """The tagged drivers refuse guard rules (the guard's TAG belongs in
+    every derivation's conjunction)."""
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_provenance import infer_provenance_device
+    from kolibrie_tpu.reasoner.provenance import MinMaxProbability
+    from kolibrie_tpu.reasoner.provenance_seminaive import seed_tag_store
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    r = Reasoner()
+    d = r.dictionary
+    C, V = Term.constant, Term.variable
+    r.add_tagged_triple(":mode", ":is", ":strict", 0.6)
+    r.add_tagged_triple(":a", ":edge", ":b", 0.9)
+    r.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(
+                    C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
+                ),
+                TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+            ],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":ok")), V("y"))],
+        )
+    )
+    prov = MinMaxProbability()
+    store = seed_tag_store(r, prov)
+    assert infer_provenance_device(r, prov, store) is None
