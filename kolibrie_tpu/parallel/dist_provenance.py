@@ -77,6 +77,7 @@ from kolibrie_tpu.reasoner.device_provenance import (
     _ADDMULT_TAG_EQ,
     _addmult_order_sensitive,
     _decode_tags,
+    _guard_tag_array,
     _naf_cross_blocking,
     _naf_premise_drift,
     _seed_tag_arrays,
@@ -126,6 +127,7 @@ def _tagged_round(
     state,
     masks,
     one_enc,
+    gtags,
     *,
     rules,
     n,
@@ -188,10 +190,15 @@ def _tagged_round(
     else:
         old_fv, old_gv = fv, gv  # idempotent ⊕: duplicates are harmless
 
-    for lr, plans in rules:
+    for r_idx, (lr, plans) in enumerate(rules):
         for seed, steps in plans:
             table, valid = _scan_premise(lr.premises[seed], (ds, dp_, do_), dv)
-            tag = dtag  # delta tags are EFFECTIVE values (never NaN)
+            # delta tags are EFFECTIVE values (never NaN); statically-
+            # satisfied ground guards fold their closure-constant tags in
+            if kind == "addmult":
+                tag = dtag * gtags[r_idx]
+            else:
+                tag = jnp.minimum(dtag, gtags[r_idx])
             for (j, kv, kpos, extra) in steps:
                 prem = lr.premises[j]
                 table, tag, valid, dropped = _exchange_tagged(
@@ -474,6 +481,7 @@ def _naf_pass(
     state,
     masks,
     one_enc,
+    gtags,
     *,
     rules,
     neg_kind,
@@ -521,10 +529,10 @@ def _naf_pass(
     overflow = jnp.int32(0)
     parts: List[tuple] = []
 
-    for lr, plans in rules:
+    for r_idx, (lr, plans) in enumerate(rules):
         seed, steps = plans[0]  # one plan: the body runs over ALL facts
         table, valid = _scan_premise(lr.premises[seed], fcols, fv)
-        tag = eff_f
+        tag = jnp.minimum(eff_f, gtags[r_idx])
         for (j, kv, kpos, extra) in steps:
             prem = lr.premises[j]
             table, tag, valid, dropped = _exchange_tagged(
@@ -695,10 +703,6 @@ class DistProvenanceReasoner:
         self.provenance = provenance
         self.tag_store = tag_store
         self.rules, self.bank = lower_rules_dist(reasoner, reasoner.rules)
-        if any(lr.guards for lr, _ in self.rules):
-            # a dropped ground guard premise still contributes its TAG to
-            # every derivation's ⊗ — the tagged rounds don't fold it
-            raise Unsupported("ground guard premise needs host tag folding")
         self.pos_rules = tuple(
             (lr, pl) for lr, pl in self.rules if not lr.negs
         )
@@ -734,10 +738,12 @@ class DistProvenanceReasoner:
         n_masks = len(self.bank.exprs)
         return jax.jit(
             jax.shard_map(
-                lambda state, masks, one: body(state, masks, one),
+                lambda state, masks, one, gtags: body(
+                    state, masks, one, gtags
+                ),
                 mesh=self.mesh,
                 check_vma=_dist_check_vma(),
-                in_specs=((spec,) * 15, (rep,) * n_masks, P(self.axis)),
+                in_specs=((spec,) * 15, (rep,) * n_masks, P(self.axis), rep),
                 out_specs=((spec,) * 15, P(self.axis), P(self.axis)),
             )
         )
@@ -863,6 +869,20 @@ class DistProvenanceReasoner:
             one_arr = put(np.full((n, 1), one_enc, np.float64))
             round_fn = self._round_fn() if self.pos_rules else None
             naf_fn = self._naf_fn() if self.naf_rules else None
+            gt_pos = jnp.asarray(
+                _guard_tag_array(
+                    [lr for lr, _ in self.pos_rules],
+                    self.provenance,
+                    self.tag_store,
+                )
+            )
+            gt_naf = jnp.asarray(
+                _guard_tag_array(
+                    [lr for lr, _ in self.naf_rules],
+                    self.provenance,
+                    self.tag_store,
+                )
+            )
 
             def extract(state):
                 fs = np.asarray(state[0]).reshape(-1)
@@ -875,7 +895,9 @@ class DistProvenanceReasoner:
             quiesced = round_fn is None  # no positive stratum to drain
             for _ in range(max_rounds):
                 if not quiesced:
-                    state, count, overflow = round_fn(state, masks, one_arr)
+                    state, count, overflow = round_fn(
+                        state, masks, one_arr, gt_pos
+                    )
                     if int(overflow[0]) > 0:
                         return None
                     if int(count[0]) > 0:
@@ -886,7 +908,9 @@ class DistProvenanceReasoner:
                 # positive stratum
                 if naf_fn is None:
                     return extract(state)
-                state, count, overflow = naf_fn(state, masks, one_arr)
+                state, count, overflow = naf_fn(
+                    state, masks, one_arr, gt_naf
+                )
                 if int(overflow[0]) > 0:
                     return None
                 if int(count[0]) == 0:

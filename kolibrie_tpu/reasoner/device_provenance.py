@@ -158,6 +158,22 @@ def _seed_tag_arrays(provenance, tag_store, keys) -> Tuple[np.ndarray, float]:
     return tags0, float(_encode_tags(provenance, [one])[0])
 
 
+def _guard_tag_array(rules, provenance, tag_store) -> np.ndarray:
+    """Per-rule encoded ⊗ of the rule's ground-guard tags (one() when the
+    rule has no guards).  Guards are non-derivable by construction
+    (lower_rules), so these values are CONSTANT through the closure —
+    one dynamic operand, no recompile per tag value."""
+    out = []
+    for r in rules:
+        t = provenance.one()
+        for g in r.guards:
+            gt = tag_store.tags.get(tuple(g.consts))
+            if gt is not None:
+                t = provenance.conjunction(t, gt)
+        out.append(t)
+    return _encode_tags(provenance, out) if out else np.zeros(0, np.float64)
+
+
 # ---------------------------------------------------------------------------
 # Jitted round
 # ---------------------------------------------------------------------------
@@ -196,6 +212,7 @@ def _prov_round(
     n_delta,
     one_enc,
     masks,
+    gtags,
 ):
     """One tagged semi-naive round.  Returns the updated fact columns/tags,
     the next delta (new ∪ changed facts, with their stored tags), the count
@@ -221,12 +238,14 @@ def _prov_round(
 
     overflow = np.int32(0)
     parts: List[tuple] = []  # (s, p, o, tag, valid) static-cap blocks
-    for rule in rules:
+    for r_idx, rule in enumerate(rules):
         for order, keys in rule.plans:
             seed = order[0]
             table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
             valid = m
-            tag = dtag
+            # statically-satisfied ground guards contribute their (closure-
+            # constant) tags to every derivation's ⊗ — one() when no guards
+            tag = jnp.minimum(dtag, gtags[r_idx])
             for step, j in enumerate(order[1:]):
                 ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
                 kv = keys[step]
@@ -472,6 +491,7 @@ def _prov_naf_pass(
     one_enc,
     masks,
     neg_kind,
+    gtags,
 ):
     """One stratified NAF pass over the QUIESCED positive fixpoint: each
     NAF rule's positive body is evaluated against ALL facts (no delta
@@ -502,11 +522,11 @@ def _prov_naf_pass(
 
     overflow = np.int32(0)
     parts: List[tuple] = []
-    for rule in rules:
+    for r_idx, rule in enumerate(rules):
         # one plan suffices: the body runs against the full fact store
         order, keys = rule.plans[0]
         table, valid = _scan_premise(rule.premises[order[0]], fcols, fvalid)
-        tag = eff
+        tag = jnp.minimum(eff, gtags[r_idx])
         for step, j in enumerate(order[1:]):
             ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
             kv = keys[step]
@@ -586,6 +606,7 @@ def _prov_round_addmult(
     didx,
     n_delta,
     masks,
+    gtags,
 ):
     """One EXACTLY-ONCE tagged semi-naive round for the addmult semiring.
 
@@ -635,12 +656,14 @@ def _prov_round_addmult(
 
     overflow = np.int32(0)
     parts: List[tuple] = []  # (s, p, o, tag, valid) static-cap blocks
-    for rule in rules:
+    for r_idx, rule in enumerate(rules):
         for order, keys in rule.plans:
             seed = order[0]
             table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
             valid = m
-            tag = dtag_eff
+            # statically-satisfied ground guards contribute their (closure-
+            # constant) tags to every derivation's ⊗ — one() when no guards
+            tag = dtag_eff * gtags[r_idx]
             for step, j in enumerate(order[1:]):
                 pvalid = old_valid if j < seed else fvalid
                 ptable, pm = _scan_premise(rule.premises[j], fcols, pvalid)
@@ -807,10 +830,6 @@ def infer_provenance_device(
         return None
     if not rules:
         return None
-    if any(r.guards for r in rules):
-        # a dropped ground guard premise still contributes its TAG to every
-        # derivation's ⊗ — the tagged rounds don't fold it; host fallback
-        return None
     pos_rules = tuple(r for r in rules if not r.negs)
     naf_rules = tuple(r for r in rules if r.negs)
     if naf_rules and _naf_cross_blocking(naf_rules):
@@ -888,6 +907,10 @@ def infer_provenance_device(
             "n_delta": nd0,
         }
 
+        gtags_pos = jnp.asarray(
+            _guard_tag_array(pos_rules, provenance, tag_store)
+        )
+
         def round_fn(caps, st):
             out = _prov_round(
                 pos_rules,
@@ -904,6 +927,7 @@ def infer_provenance_device(
                 jnp.int32(st["n_delta"]),
                 jnp.float64(one_enc),
                 masks,
+                gtags_pos,
             )
             code = int(out[10])  # one sync per round
             if code != 0:
@@ -950,6 +974,7 @@ def infer_provenance_device(
                 provenance,
                 one_enc,
                 masks,
+                jnp.asarray(_guard_tag_array(naf_rules, provenance, tag_store)),
                 n0,
                 nd0,
                 max_attempts,
@@ -1056,6 +1081,7 @@ def _drive_naf(
     provenance,
     one_enc,
     masks,
+    gtags,
     n0,
     nd0,
     max_attempts,
@@ -1089,6 +1115,7 @@ def _drive_naf(
             jnp.float64(one_enc),
             masks,
             neg_kind,
+            gtags,
         )
         code = int(out[10])  # one sync per pass
         if code != 0:
@@ -1189,6 +1216,7 @@ def _drive_addmult(
             "didx": _pad_i32(didx0, 0),
             "n_delta": nd0,
         }
+        gtags = jnp.asarray(_guard_tag_array(rules, provenance, tag_store))
 
         def round_fn(caps, st):
             out = _prov_round_addmult(
@@ -1202,6 +1230,7 @@ def _drive_addmult(
                 st["didx"],
                 jnp.int32(st["n_delta"]),
                 masks,
+                gtags,
             )
             code = int(out[7])  # one sync per round
             if code != 0:

@@ -630,32 +630,63 @@ def test_ground_guard_premise_static_gating():
         DeviceFixpoint(r3)
 
 
-def test_tagged_guard_rule_falls_back():
-    """The tagged drivers refuse guard rules (the guard's TAG belongs in
-    every derivation's conjunction)."""
+def test_tagged_guard_rule_agreement():
+    """Tagged guard rules fold the guard's closure-constant TAG into every
+    derivation's conjunction (min for idempotent, product for addmult) —
+    host oracle agreement, entry-for-entry."""
     from kolibrie_tpu.core.rule import Rule
     from kolibrie_tpu.core.terms import Term, TriplePattern
     from kolibrie_tpu.reasoner.device_provenance import infer_provenance_device
-    from kolibrie_tpu.reasoner.provenance import MinMaxProbability
-    from kolibrie_tpu.reasoner.provenance_seminaive import seed_tag_store
+    from kolibrie_tpu.reasoner.provenance import (
+        AddMultProbability,
+        MinMaxProbability,
+    )
+    from kolibrie_tpu.reasoner.provenance_seminaive import (
+        infer_with_provenance,
+        seed_tag_store,
+    )
     from kolibrie_tpu.reasoner.reasoner import Reasoner
 
-    r = Reasoner()
-    d = r.dictionary
-    C, V = Term.constant, Term.variable
-    r.add_tagged_triple(":mode", ":is", ":strict", 0.6)
-    r.add_tagged_triple(":a", ":edge", ":b", 0.9)
-    r.add_rule(
-        Rule(
-            premise=[
-                TriplePattern(
-                    C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
-                ),
-                TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
-            ],
-            conclusion=[TriplePattern(V("x"), C(d.encode(":ok")), V("y"))],
+    def build():
+        r = Reasoner()
+        d = r.dictionary
+        C, V = Term.constant, Term.variable
+        r.add_tagged_triple(":mode", ":is", ":strict", 0.6)
+        for i in range(5):
+            r.add_tagged_triple(f":a{i}", ":edge", f":b{i}", 0.9 - 0.1 * i)
+        r.add_rule(
+            Rule(
+                premise=[
+                    TriplePattern(
+                        C(d.encode(":mode")), C(d.encode(":is")), C(d.encode(":strict"))
+                    ),
+                    TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+                ],
+                conclusion=[TriplePattern(V("x"), C(d.encode(":ok")), V("y"))],
+            )
         )
-    )
-    prov = MinMaxProbability()
-    store = seed_tag_store(r, prov)
-    assert infer_provenance_device(r, prov, store) is None
+        return r
+
+    for prov_cls in (MinMaxProbability, AddMultProbability):
+        prov = prov_cls()
+        r_h = build()
+        st_h = seed_tag_store(r_h, prov)
+        infer_with_provenance(r_h, prov, st_h)
+        r_d = build()
+        st_d = seed_tag_store(r_d, prov)
+        out = infer_provenance_device(r_d, prov, st_d)
+        assert out is not None, f"device refused guard rule ({prov.name})"
+        assert r_h.facts.triples_set() == r_d.facts.triples_set()
+        if prov.name == "addmult":
+            assert set(st_h.tags) == set(st_d.tags)
+            for k, v in st_h.tags.items():
+                assert abs(st_d.tags[k] - v) < 1e-9, (k, st_d.tags[k], v)
+        else:
+            assert dict(st_h.tags) == dict(st_d.tags)
+        # the guard tag 0.6 caps/multiplies into every derivation
+        d = r_h.dictionary
+        from kolibrie_tpu.core.triple import Triple
+
+        k0 = Triple(d.encode(":a0"), d.encode(":ok"), d.encode(":b0"))
+        expected = 0.6 if prov.name == "minmax" else 0.6 * 0.9
+        assert abs(st_h.tags[k0] - expected) < 1e-9

@@ -369,3 +369,35 @@ def test_structural_semiring_unsupported(mesh):
     store = seed_tag_store(r, prov)
     with pytest.raises(Unsupported):
         DistProvenanceReasoner(mesh, r, prov, store)
+
+
+def test_guard_rule_tag_folding_agreement(mesh):
+    """A statically-satisfied ground guard premise folds its closure-
+    constant tag into every derivation over the mesh."""
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+
+    def build():
+        r = Reasoner()
+        d = r.dictionary
+        C, V = Term.constant, Term.variable
+        r.add_tagged_triple(":mode", ":is", ":strict", 0.6)
+        for i in range(10):
+            r.add_tagged_triple(f":a{i}", ":edge", f":b{i}", 0.9 - 0.05 * i)
+        r.add_rule(
+            Rule(
+                premise=[
+                    TriplePattern(
+                        C(d.encode(":mode")),
+                        C(d.encode(":is")),
+                        C(d.encode(":strict")),
+                    ),
+                    TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+                ],
+                conclusion=[TriplePattern(V("x"), C(d.encode(":ok")), V("y"))],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
