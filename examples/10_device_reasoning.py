@@ -106,3 +106,64 @@ assert r4.facts.triples_set() == r3.facts.triples_set()
 assert store4.tags == store.tags
 print(f"distributed tagged fixpoint ({mesh.devices.size} devices): "
       f"{n_dist} derived, tags identical to the single-chip run")
+
+# --------------------------------------------------------------------------
+# 4. RDF-star on device (round 4): a ground quoted ANNOTATION GATE —
+#    << :sensorNet :mode :strict >> is a fully-ground guard premise whose
+#    closure-constant tag caps every derivation's confidence, and the
+#    stratified NAF pass runs on device too.
+# --------------------------------------------------------------------------
+from kolibrie_tpu.core.rule import Rule
+from kolibrie_tpu.core.terms import Term, TriplePattern
+from kolibrie_tpu.reasoner.provenance import MinMaxProbability
+from kolibrie_tpu.reasoner.provenance_seminaive import infer_with_provenance
+
+mm = MinMaxProbability()
+
+
+def build_star():
+    r = Reasoner()
+    d = r.dictionary
+    C, V = Term.constant, Term.variable
+    # the gate itself: asserted with confidence 0.8
+    r.add_tagged_triple(":net", ":mode", ":strict", 0.8)
+    for i in range(12):
+        r.add_tagged_triple(f":s{i}", ":reading", f":v{i}", 0.95)
+    r.add_tagged_triple(":s5", ":faulty", ":yes", 1.0)
+    r.add_rule(
+        Rule(
+            premise=[
+                TriplePattern(  # ground guard: drops from the join plan,
+                    C(d.encode(":net")),  # its 0.8 tag caps every ⊗
+                    C(d.encode(":mode")),
+                    C(d.encode(":strict")),
+                ),
+                TriplePattern(V("x"), C(d.encode(":reading")), V("v")),
+            ],
+            conclusion=[TriplePattern(V("x"), C(d.encode(":valid")), V("v"))],
+        )
+    )
+    # NAF: a faulty sensor blocks its validation
+    r.add_rule(
+        r.rule_from_strings(
+            [("?x", ":valid", "?v")],
+            [("?x", ":trusted", "?v")],
+            negative=[("?x", ":faulty", ":yes")],
+        )
+    )
+    return r
+
+r_host = build_star()
+st_host = seed_tag_store(r_host, mm)
+infer_with_provenance(r_host, mm, st_host)
+r_dev = build_star()
+st_dev = seed_tag_store(r_dev, mm)
+out = infer_provenance_device(r_dev, mm, st_dev)
+assert out is not None, "device refused the RDF-star/NAF program"
+assert dict(st_host.tags) == dict(st_dev.tags)
+d = r_dev.dictionary
+from kolibrie_tpu.core.triple import Triple
+t0 = Triple(d.encode(":s0"), d.encode(":trusted"), d.encode(":v0"))
+print(f"RDF-star gate + NAF on device: trusted(:s0)={st_dev.tags[t0]} "
+      f"(capped by the 0.8 gate), faulty :s5 blocked, "
+      f"{len(st_dev.tags)} tags identical to host")

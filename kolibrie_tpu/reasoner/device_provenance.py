@@ -445,21 +445,51 @@ def _naf_cross_blocking(naf_rules) -> bool:
 
 
 def _naf_premise_drift(all_rules, naf_rules) -> bool:
-    """True when some rule's conclusion could unify with a NAF rule's
-    POSITIVE premise.  Then a premise tag read by a NAF body can improve
-    BETWEEN passes, and the host's exactly-once ``naf_seen`` skip (which
-    freezes each derivation's first-read tags) becomes load-bearing — a
-    snapshot recomputation would ⊕-merge the improved value.  Conservative
-    syntactic test; variables unify with anything."""
-    for ra in all_rules:
-        for concl in ra.concls:
-            for nb in naf_rules:
-                for prem in nb.premises:
-                    if all(
-                        kind != "const" or c is None or c == v
-                        for (kind, v), c in zip(concl, prem.consts)
-                    ):
-                        return True
+    """True when a NAF pass's output can REACH a NAF rule's positive
+    premise through the rule graph.  Then a premise tag read by a NAF body
+    can improve BETWEEN passes, and the host's exactly-once ``naf_seen``
+    skip (which freezes each derivation's first-read tags) becomes
+    load-bearing — a snapshot recomputation would ⊕-merge the improved
+    value.  NAF bodies over predicates that are derived but FINAL before
+    the first pass (no feedback from NAF conclusions) are safe.
+
+    Predicate-level reachability, conservative: variable predicates are
+    wildcards; guard premises are excluded (non-derivable by
+    construction)."""
+    reach: Set[int] = set()  # predicate ids reachable from NAF conclusions
+    wild = False  # a variable-predicate conclusion reaches everything
+
+    def add_concls(r) -> bool:
+        nonlocal wild
+        changed = False
+        for c in r.concls:
+            kind, v = c[1]
+            if kind == "const":
+                if v not in reach:
+                    reach.add(v)
+                    changed = True
+            elif not wild:
+                wild = True
+                changed = True
+        return changed
+
+    for nr in naf_rules:
+        add_concls(nr)
+    changed = True
+    while changed:
+        changed = False
+        for r in all_rules:
+            prem_preds = [p.consts[1] for p in r.premises]
+            fires = wild or any(
+                (pp is None and reach) or (pp in reach) for pp in prem_preds
+            )
+            if fires and add_concls(r):
+                changed = True
+    for nr in naf_rules:
+        for p in nr.premises:
+            pp = p.consts[1]
+            if wild or (pp is None and reach) or pp in reach:
+                return True
     return False
 
 

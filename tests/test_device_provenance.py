@@ -673,19 +673,46 @@ def test_naf_improves_existing_tag_without_refiring():
     assert ht[s_key] == 0.3  # derived BEFORE the improvement, not re-fired
 
 
-def test_naf_derived_premise_falls_back():
-    """A NAF body reading a DERIVED predicate depends on the host's
-    exactly-once tag freezing (naf_seen) — the device driver must refuse."""
+def test_naf_derived_but_final_premise_agreement():
+    """A NAF body reading a DERIVED predicate is safe when NAF conclusions
+    cannot reach it (the predicate is final before the first pass) — the
+    reachability gate lets it on device."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.5)
+        r.add_tagged_triple("c", "p", "d", 0.9)
+        r.add_tagged_triple("d", "broken", "yes", 0.4)
+        r.add_rule(r.rule_from_strings([("?x", "p", "?y")], [("?x", "q", "?y")]))
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "q", "?y")],  # derived by the rule above, but FINAL
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    (hf, ht), (df, dt) = both_paths(build, MinMaxProbability())
+    assert hf == df
+    assert ht == dt
+
+
+def test_naf_feedback_drift_falls_back():
+    """A NAF conclusion that REACHES a NAF body premise through the rule
+    graph can improve the body's tags between passes — host naf_seen
+    semantics are load-bearing, the device must refuse."""
     r = Reasoner()
     r.add_tagged_triple("a", "p", "b", 0.5)
-    r.add_rule(r.rule_from_strings([("?x", "p", "?y")], [("?x", "q", "?y")]))
     r.add_rule(
         r.rule_from_strings(
-            [("?x", "q", "?y")],  # q is derived by the rule above
-            [("?x", "ok", "?y")],
-            negative=[("?y", "broken", "yes")],
+            [("?x", "p", "?y")],
+            [("?x", "q", "?y")],  # NAF concl q ...
+            negative=[("nowhere", "broken", "yes")],
         )
     )
+    r.add_rule(r.rule_from_strings([("?x", "q", "?y")], [("?x", "p", "?y")]))
+    # ... reaches p (the NAF rule's own body premise) via the second rule
     prov = MinMaxProbability()
     store = seed_tag_store(r, prov)
     assert infer_provenance_device(r, prov, store) is None
