@@ -706,3 +706,58 @@ def test_quoted_lowering_accepts_and_marks():
     dev_table = lowered.to_table(out_cols, valid)
     for v in lowered.out_vars:
         assert sorted(table[v].tolist()) == sorted(dev_table[v].tolist())
+
+
+def test_quoted_query_fuzz():
+    """Randomized RDF-star queries: quoted annotation patterns (inner
+    variables, inner constants, joins with plain patterns) through the
+    auto-routing engine must agree with the host on every query."""
+    import random
+
+    rng = random.Random(20260804)
+    db = SparqlDatabase()
+    lines = ["@prefix f: <http://f.e/> ."]
+    n_subj, n_pred = 30, 3
+    for i in range(120):
+        s = f"f:s{rng.randrange(n_subj)}"
+        p = f"f:p{rng.randrange(n_pred)}"
+        o = f"f:s{rng.randrange(n_subj)}"
+        ann = rng.choice(["f:certainty", "f:saidBy"])
+        val = (
+            f'"{rng.randrange(1, 100) / 100}"'
+            if ann == "f:certainty"
+            else f"f:src{rng.randrange(4)}"
+        )
+        lines.append(f"<< {s} {p} {o} >> {ann} {val} .")
+        if rng.random() < 0.5:
+            lines.append(f"{s} f:knows {o} .")
+    db.parse_turtle("\n".join(lines))
+    db.execution_mode = "device"
+
+    for trial in range(20):
+        p = f"f:p{rng.randrange(n_pred)}"
+        shape = rng.randrange(4)
+        if shape == 0:
+            body = f"<< ?x {p} ?y >> f:certainty ?c ."
+            sel = "?x ?y ?c"
+        elif shape == 1:
+            s_const = f"f:s{rng.randrange(n_subj)}"
+            body = f"<< {s_const} ?p ?y >> f:saidBy ?w ."
+            sel = "?p ?y ?w"
+        elif shape == 2:
+            body = (
+                f"<< ?x {p} ?y >> f:certainty ?c . ?x f:knows ?y ."
+            )
+            sel = "?x ?y ?c"
+        else:
+            body = f"<< ?x {p} ?x >> f:certainty ?c ."
+            sel = "?x ?c"
+        q = (
+            "PREFIX f: <http://f.e/> "
+            f"SELECT {sel} WHERE {{ {body} }}"
+        )
+        try:
+            dev, host = run_both(db, q)
+        except Exception as e:
+            raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
+        assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
