@@ -1126,6 +1126,64 @@ class LoweredPlan:
             for var, col in zip(self.out_vars, out_cols)
         }
 
+    def describe(self, counts: Optional[List[int]] = None) -> str:
+        """Readable physical-plan tree for EXPLAIN surfaces: scans with
+        their sorted order + bound constants + live range size, joins with
+        key variables, capacities and (when provided) exact match counts,
+        filters, and quoted expansions.  ``counts`` is the per-join exact
+        count list from :meth:`host_execute`/calibration."""
+        scan_ranges = self._scan_ranges()
+        lines: List[str] = []
+
+        def term(c):
+            return "?" if c is None else str(c)
+
+        def walk(node, depth):
+            pad = "  " * depth
+            if isinstance(node, ScanSpec):
+                order_name, consts = self.scan_descs[node.scan_idx]
+                lo, n = (int(x) for x in scan_ranges[node.scan_idx])
+                vars_ = " ".join(f"?{v}@{p}" for v, p in node.out_vars)
+                lines.append(
+                    f"{pad}scan[{order_name}] ({term(consts[0])} "
+                    f"{term(consts[1])} {term(consts[2])}) rows={n}"
+                    f" binds {vars_}"
+                )
+            elif isinstance(node, JoinSpec):
+                cnt = (
+                    f" matched={counts[node.join_idx]}"
+                    if counts is not None and node.join_idx < len(counts)
+                    else ""
+                )
+                jcaps = getattr(self, "_join_caps", None)
+                cap = jcaps[node.join_idx] if jcaps else "?"
+                kind = "merge(rsorted)" if node.rsorted else "sort"
+                lines.append(
+                    f"{pad}{kind}-join on ({', '.join(node.key_vars)})"
+                    f" cap={cap}{cnt}"
+                )
+                walk(node.left, depth + 1)
+                walk(node.right, depth + 1)
+            elif isinstance(node, FilterSpec):
+                lines.append(f"{pad}filter {node.expr}")
+                walk(node.child, depth + 1)
+            elif isinstance(node, QuotedExpandSpec):
+                vars_ = " ".join(f"?{v}@{p}" for v, p in node.out_vars)
+                lines.append(
+                    f"{pad}quoted-expand {node.qvar} -> {vars_ or '(checks only)'}"
+                )
+                walk(node.child, depth + 1)
+            elif isinstance(node, ValuesSpec):
+                lines.append(f"{pad}values({', '.join(node.vars)}) rows={node.n}")
+            else:
+                lines.append(f"{pad}{type(node).__name__}")
+
+        walk(self.root, 0)
+        for s, p, o in self.const_checks:
+            lines.append(f"const-guard ({s} {p} {o})")
+        lines.append(f"project -> {' '.join('?' + v for v in self.out_vars)}")
+        return "\n".join(lines)
+
     def const_ok(self) -> bool:
         """Evaluate the hoisted fully-constant pattern guards against the
         CURRENT store (host binary searches; no device op).  False ⇒ the

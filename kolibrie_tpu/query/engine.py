@@ -123,3 +123,43 @@ class QueryEngine:
 
     def stats(self) -> QueryEngineStats:
         return QueryEngineStats(memory_triple_count=len(self.db))
+
+    def explain_device(self, sparql: str, exact_counts: bool = True) -> str:
+        """Physical-plan EXPLAIN for the device engine: the Streamertail
+        plan lowered to its device IR, rendered as a tree with scan orders
+        + live range sizes, join keys + capacities, filters, quoted
+        expansions and the final projection.  ``exact_counts`` also runs
+        the host-oracle pass to annotate each join with its true match
+        count (no device I/O).  Returns a 'host path: <reason>' line when
+        the plan is not device-expressible."""
+        from kolibrie_tpu.optimizer.device_engine import (
+            Unsupported,
+            lower_plan,
+        )
+        from kolibrie_tpu.optimizer.engine import resolve_pattern
+        from kolibrie_tpu.optimizer.planner import (
+            Streamertail,
+            build_logical_plan,
+        )
+        from kolibrie_tpu.query.parser import parse_sparql_query
+
+        self.db.register_prefixes_from_query(sparql)
+        q = parse_sparql_query(sparql, self.db.prefixes)
+        w = q.where
+        resolved = [resolve_pattern(self.db, p) for p in w.patterns]
+        logical = build_logical_plan(
+            resolved, list(w.filters), [], w.values
+        )
+        plan = Streamertail(self.db.get_or_build_stats()).find_best_plan(
+            logical
+        )
+        try:
+            lowered = lower_plan(self.db, plan)
+        except Unsupported as e:
+            return f"host path: {e}"
+        counts = None
+        if exact_counts:
+            lowered._scan_ranges_np = lowered._scan_ranges()
+            _table, counts = lowered.host_execute()
+            lowered._join_caps = [max(c, 1) for c in counts]
+        return lowered.describe(counts)

@@ -381,3 +381,40 @@ def test_union_preserves_registries_and_quoted_seeds():
     assert prob == 0.9
     # the quoted subject id must resolve in u's quoted store
     assert u.decode_term(k[0]).startswith("<<")
+
+
+def test_explain_device_plan_tree():
+    """Physical-plan EXPLAIN: scan orders + row counts, join keys with
+    exact match counts, quoted expansions, and the honest host-path line
+    for non-expressible shapes."""
+    from kolibrie_tpu.query.engine import QueryEngine
+
+    e = QueryEngine()
+    e.load_turtle_to_memory(
+        """
+    @prefix ex: <http://example.org/> .
+    << ex:alice ex:age 30 >> ex:certainty "0.9" .
+    ex:alice ex:knows ex:bob .
+    ex:bob ex:knows ex:carol .
+    ex:bob ex:salary "50000" .
+    """
+    )
+    out = e.explain_device(
+        """PREFIX ex: <http://example.org/>
+        SELECT ?a ?c ?s WHERE {
+            ?a ex:knows ?b . ?b ex:knows ?c . ?b ex:salary ?s .
+            FILTER(?s > 10000)
+        }"""
+    )
+    assert "-join on" in out and "matched=" in out
+    assert "scan[" in out and "filter" in out
+    assert out.strip().endswith("project -> ?a ?b ?c ?s")
+    star = e.explain_device(
+        """PREFIX ex: <http://example.org/>
+        SELECT ?s ?v ?c WHERE { << ?s ex:age ?v >> ex:certainty ?c }"""
+    )
+    assert "quoted-expand" in star
+    fallback = e.explain_device(
+        "SELECT ?a WHERE { ?a <http://e/p> ?b . ?c <http://e/q> ?d }"
+    )
+    assert fallback.startswith("host path:")
