@@ -60,6 +60,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "format instead of running a query",
     )
     ap.add_argument("--time", action="store_true", help="print execution time")
+    ap.add_argument(
+        "--explain",
+        action="store_true",
+        help="print the device physical plan (scan orders, join keys +"
+        " exact counts) for --query instead of executing it",
+    )
     ap.add_argument("--serve", action="store_true", help="start the HTTP server")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=7878)
@@ -103,6 +109,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     sparql = _read_arg(args.query)
+    if args.explain:
+        from kolibrie_tpu.query.engine import QueryEngine
+
+        print(QueryEngine(db).explain_device(sparql))
+        return 0
     start = time.perf_counter()
     run = execute_query if args.legacy else execute_query_volcano
     rows = run(sparql, db)

@@ -249,6 +249,8 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/query":
             self._handle_query()
+        elif self.path == "/explain":
+            self._handle_explain()
         elif self.path == "/rsp-query":
             self._handle_rsp_query()
         elif self.path == "/rsp/register":
@@ -261,6 +263,36 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self._handle_rsp_restore()
         else:
             self._send_error_json("not found", 404)
+
+    # -------------------------------------------------------------- /explain
+
+    def _handle_explain(self):
+        """Device physical-plan EXPLAIN: {"sparql": ..., "rdf"?: ...,
+        "format"?: ...} → {"plan": tree string} (scan orders, join keys +
+        exact counts, or an honest 'host path: <reason>' line)."""
+        from kolibrie_tpu.query.engine import QueryEngine
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        req = self._read_json()
+        if req is None:
+            return
+        if not req.get("sparql"):
+            self._send_error_json("No query provided")
+            return
+        db = SparqlDatabase()
+        try:
+            _load_rdf_into(db, req.get("rdf") or "", req.get("format", "rdfxml"))
+        except Exception as e:
+            self._send_error_json(f"RDF parse error: {e}")
+            return
+        try:
+            plan = QueryEngine(db).explain_device(
+                strip_hash_comments(req["sparql"])
+            )
+        except Exception as e:
+            self._send_error_json(f"Explain failed: {e}")
+            return
+        self._send_json({"plan": plan})
 
     # ---------------------------------------------------------------- /query
 

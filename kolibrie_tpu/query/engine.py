@@ -90,8 +90,8 @@ def extract_window_clauses(query: str) -> List[str]:
 class QueryEngine:
     """Simple facade: an in-memory database plus the Volcano query path."""
 
-    def __init__(self) -> None:
-        self.db = SparqlDatabase()
+    def __init__(self, db: SparqlDatabase | None = None) -> None:
+        self.db = db if db is not None else SparqlDatabase()
 
     def load_ntriples_to_memory(self, data: str) -> int:
         return self.db.parse_ntriples(data)

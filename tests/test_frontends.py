@@ -382,3 +382,38 @@ def test_http_rsp_checkpoint_restore(server):
         # a window covering ts<=2 content only exists if restored state
         # carried the pre-snapshot events
         assert any("/a" in s for s in subjects), subjects
+
+
+def test_http_explain_endpoint(server):
+    body = post(
+        server,
+        "/explain",
+        {
+            "rdf": TTL,
+            "format": "turtle",
+            "sparql": "PREFIX ex: <http://example.org/> "
+            "SELECT ?a ?c WHERE { ?a ex:knows ?b . ?b ex:knows ?c }",
+        },
+    )
+    assert "scan[" in body["plan"] and "-join on" in body["plan"]
+    assert "matched=" in body["plan"]
+
+
+def test_cli_explain_flag(tmp_path, capsys):
+    from kolibrie_tpu.frontends.cli import main as cli_main
+
+    data = tmp_path / "d.ttl"
+    data.write_text(TTL)
+    rc = cli_main(
+        [
+            "--file",
+            str(data),
+            "--explain",
+            "--query",
+            "PREFIX ex: <http://example.org/> "
+            "SELECT ?a ?c WHERE { ?a ex:knows ?b . ?b ex:knows ?c }",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "scan[" in out and "project ->" in out
