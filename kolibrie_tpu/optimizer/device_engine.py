@@ -1066,14 +1066,17 @@ class LoweredPlan:
         table = eval_node(self.root)
         return table, counts
 
-    def calibrate_host(self) -> None:
-        """Set exact join capacities from a host evaluation (no device I/O)."""
+    def calibrate_host(self) -> List[int]:
+        """Set exact join capacities from a host evaluation (no device I/O);
+        returns the exact per-join match counts (EXPLAIN annotates with
+        them)."""
         self._scan_ranges_np = self._scan_ranges()
         _table, counts = self.host_execute()
         self._join_caps = [_round_cap(c) for c in counts]
         self.db.__dict__.setdefault("_device_cap_cache", {})[self.cap_key] = tuple(
             self._join_caps
         )
+        return counts
 
     # ------------------------------------------------------------ execution
 

@@ -184,8 +184,18 @@ def _general_round(
     parts: List[tuple] = []
 
     for lr, plans in rules:
+        # ground-guard gate: shard-local membership in the subject-owned
+        # block, psum'd — non-derivable (lowering gate), so constant
+        # through the closure
+        guard_ok = None
+        for g in lr.guards:
+            _t, gm = _scan_premise(g, fcols, fv)
+            hit = lax.psum(jnp.any(gm).astype(jnp.int32), axis) > 0
+            guard_ok = hit if guard_ok is None else (guard_ok & hit)
         for seed, steps in plans:
             table, valid = _scan_premise(lr.premises[seed], (ds, dp_, do_), dv)
+            if guard_ok is not None:
+                valid = valid & guard_ok
             for (j, kv, kpos, extra) in steps:
                 prem = lr.premises[j]
                 # route bindings to the shard owning the join key

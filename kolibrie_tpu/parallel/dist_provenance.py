@@ -703,6 +703,13 @@ class DistProvenanceReasoner:
         self.provenance = provenance
         self.tag_store = tag_store
         self.rules, self.bank = lower_rules_dist(reasoner, reasoner.rules)
+        # ground-guard satisfaction at driver time (facts are real here;
+        # guards are non-derivable, so absence is final for this closure)
+        self.rules = tuple(
+            (lr, pl)
+            for lr, pl in self.rules
+            if all(reasoner.facts.contains(*g.consts) for g in lr.guards)
+        )
         self.pos_rules = tuple(
             (lr, pl) for lr, pl in self.rules if not lr.negs
         )

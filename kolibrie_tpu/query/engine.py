@@ -157,9 +157,5 @@ class QueryEngine:
             lowered = lower_plan(self.db, plan)
         except Unsupported as e:
             return f"host path: {e}"
-        counts = None
-        if exact_counts:
-            lowered._scan_ranges_np = lowered._scan_ranges()
-            _table, counts = lowered.host_execute()
-            lowered._join_caps = [max(c, 1) for c in counts]
+        counts = lowered.calibrate_host() if exact_counts else None
         return lowered.describe(counts)

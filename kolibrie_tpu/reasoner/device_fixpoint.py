@@ -245,10 +245,12 @@ def lower_rules(reasoner, rules: List[Rule]) -> Tuple[tuple, _MaskBank]:
         if not prems:
             raise Unsupported("rule without positive premises")
         # fully-ground GUARD premises (the RDF-star annotation-gate shape):
-        # facts never retract, so a guard that is non-derivable is STATIC —
-        # satisfied now ⇒ satisfied for the whole closure (drop the
-        # premise), absent now ⇒ the rule can never fire (drop the rule).
-        # A derivable guard can flip mid-closure, which the delta-seeded
+        # facts never retract, so a non-derivable guard's truth is CONSTANT
+        # through any one closure — it drops out of the JOIN PLAN and is
+        # evaluated as a whole-rule membership gate at RUN time (the same
+        # lowered rules must stay correct for callers like DeviceR2R that
+        # lower once and supply different fact columns per window).  A
+        # derivable guard can flip mid-closure, which the delta-seeded
         # plans over the remaining premises would miss — host fallback.
         guards = [p for p in prems if not p.vars]
         if guards:
@@ -258,10 +260,6 @@ def lower_rules(reasoner, rules: List[Rule]) -> Tuple[tuple, _MaskBank]:
             prems = [p for p in prems if p.vars]
             if not prems:
                 raise Unsupported("fully ground rule")
-            if not all(
-                reasoner.facts.contains(*g.consts) for g in guards
-            ):
-                continue  # statically unsatisfiable: the rule never fires
         bound = {v for pr in prems for v, _ in pr.vars}
         negs = [
             _lower_pattern(p, reasoner.dictionary, quoted)
@@ -453,10 +451,19 @@ def _gen_candidates(
     cand_parts: List[tuple] = []  # (s, p, o, valid) static-cap blocks
 
     for rule in rules:
+        # ground-guard gate: a whole-rule membership test against the fact
+        # snapshot (non-derivable by the lowering gate, so its value is
+        # constant through the closure — per-window callers like DeviceR2R
+        # get the right value for THEIR facts)
+        guard_ok = None
+        for g in rule.guards:
+            _t, gm = _scan_premise(g, fcols, fvalid)
+            hit = jnp.any(gm)
+            guard_ok = hit if guard_ok is None else (guard_ok & hit)
         for order, keys in rule.plans:
             seed = order[0]
             table, m = _scan_premise(rule.premises[seed], dcols, dvalid)
-            valid = m
+            valid = m if guard_ok is None else (m & guard_ok)
             for step, j in enumerate(order[1:]):
                 ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
                 kv = keys[step]
@@ -753,6 +760,15 @@ class DeviceFixpoint:
         s, p, o = self.reasoner.facts.columns()
         n0 = len(s)
         caps = caps if caps is not None else self._caps(n0)
+        if not self.rules:
+            return (
+                jnp.asarray(s),
+                jnp.asarray(p),
+                jnp.asarray(o),
+                jnp.int32(n0),
+                jnp.int32(0),
+                jnp.int32(0),
+            )
         masks = tuple(jnp.asarray(m) for m in self.bank.materialize()) or (
             jnp.zeros(1, dtype=bool),
         )
@@ -794,6 +810,9 @@ class DeviceFixpoint:
         columns, one compiled program per capacity configuration.
         """
         import jax.numpy as jnp
+
+        if not self.rules:
+            return fs, fp, fo, int(n_facts), caps
 
         masks = tuple(jnp.asarray(m) for m in self.bank.materialize()) or (
             jnp.zeros(1, dtype=bool),

@@ -860,6 +860,18 @@ def infer_provenance_device(
         return None
     if not rules:
         return None
+    # ground-guard satisfaction at DRIVER time (this driver always lowers
+    # against the real facts, unlike DeviceR2R's per-window reuse — the
+    # untagged rounds evaluate guards at run time instead): facts never
+    # retract and guards are non-derivable, so an absent guard makes its
+    # rule dead for this whole closure
+    rules = tuple(
+        r
+        for r in rules
+        if all(reasoner.facts.contains(*g.consts) for g in r.guards)
+    )
+    if not rules:
+        return {}  # every rule statically dead: nothing to derive
     pos_rules = tuple(r for r in rules if not r.negs)
     naf_rules = tuple(r for r in rules if r.negs)
     if naf_rules and _naf_cross_blocking(naf_rules):

@@ -566,7 +566,6 @@ def test_ground_guard_premise_static_gating():
             conclusion=[TriplePattern(V("x"), C(d.encode(":checked")), V("y"))],
         )
     )
-    h = Reasoner()  # host oracle twin
     r_host, d2, C2, V2 = base()
     r_host.add_abox_triple(":mode", ":is", ":strict")
     r_host.add_rule(

@@ -962,3 +962,62 @@ WHERE {{ WINDOW <http://e/w> {{ ?a ex:reach ?c }} }}"""
             tuple(dec(x) for x in k) for k in r.db.store.triples_set()
         }
         assert ("http://e/a", "http://e/knows", "http://e/b") in triples
+
+
+class TestDeviceR2RGroundGuard:
+    """Regression (round-4 review): DeviceR2R lowers rules against a
+    facts-EMPTY twin, so ground-guard satisfaction must be evaluated at
+    RUN time against each window's facts — a static lowering-time check
+    silently dropped every annotation-gate rule."""
+
+    RULES = """@prefix ex: <http://ex/> .
+{ ex:net ex:mode ex:strict . ?x ex:reading ?v . } => { ?x ex:valid ?v . } .
+"""
+
+    def _mk(self, cls):
+        r = cls()
+        r.load_rules(self.RULES)
+        return r
+
+    @staticmethod
+    def _decode(r, triples):
+        d = r.db.dictionary
+        return sorted(
+            (d.decode(t.subject), d.decode(t.predicate), d.decode(t.object))
+            for t in triples
+        )
+
+    def test_guard_present_in_window_fires(self):
+        from kolibrie_tpu.rsp.r2r import DeviceR2R, SimpleR2R
+        from kolibrie_tpu.rsp.s2r import WindowTriple
+
+        host, dev = self._mk(SimpleR2R), self._mk(DeviceR2R)
+        for r in (host, dev):
+            r.add(WindowTriple("http://ex/net", "http://ex/mode", "http://ex/strict"))
+            for i in range(4):
+                r.add(
+                    WindowTriple(
+                        f"http://ex/s{i}", "http://ex/reading", f"http://ex/v{i}"
+                    )
+                )
+        h, v = host.materialize(), dev.materialize()
+        assert self._decode(host, h) == self._decode(dev, v)
+        assert any("valid" in p for _s, p, _o in self._decode(dev, v))
+        assert dev._device_ok  # the device path actually ran
+
+    def test_guard_absent_from_window_blocks(self):
+        from kolibrie_tpu.rsp.r2r import DeviceR2R, SimpleR2R
+        from kolibrie_tpu.rsp.s2r import WindowTriple
+
+        host, dev = self._mk(SimpleR2R), self._mk(DeviceR2R)
+        for r in (host, dev):
+            for i in range(4):
+                r.add(
+                    WindowTriple(
+                        f"http://ex/s{i}", "http://ex/reading", f"http://ex/v{i}"
+                    )
+                )
+        h, v = host.materialize(), dev.materialize()
+        assert self._decode(host, h) == self._decode(dev, v)
+        assert not any("valid" in p for _s, p, _o in self._decode(dev, v))
+        assert dev._device_ok
