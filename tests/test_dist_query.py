@@ -359,3 +359,26 @@ def test_values_membership_agreement(mesh):
             """PREFIX ex: <http://example.org/>
             SELECT ?e WHERE { ?e ex:grade ?g . VALUES ?g { "g1" "g1" } }""",
         )
+
+
+def test_distinct_bucket_overflow_retry(mesh):
+    """Tiny bucket capacity forces the DISTINCT stage's exchange to drop
+    rows; the driver's doubling protocol must converge to the exact
+    distinct set."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(400):
+        e = f"<http://example.org/e{i}>"
+        # only 5 distinct orgs, heavily duplicated -> hash concentration
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 5}> ."
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT DISTINCT ?o WHERE { ?e ex:worksAt ?o }"""
+    host = execute_query_volcano(q, db)
+    ex = DistQueryExecutor(mesh, db, q, join_cap=512, bucket_cap=8)
+    dist = ex.run()
+    assert sorted(dist) == sorted(host)
+    assert len(dist) == 5
