@@ -689,3 +689,106 @@ def test_tagged_guard_rule_agreement():
         k0 = Triple(d.encode(":a0"), d.encode(":ok"), d.encode(":b0"))
         expected = 0.6 if prov.name == "minmax" else 0.6 * 0.9
         assert abs(st_h.tags[k0] - expected) < 1e-9
+
+
+def test_guard_quoted_fuzz_agreement():
+    """Randomized annotation-gate programs: ground quoted / plain ground
+    guards (present or absent), gated chains, quoted conclusions — device
+    closure must equal the host oracle on every trial."""
+    import random
+
+    from kolibrie_tpu.core.rule import Rule
+    from kolibrie_tpu.core.terms import Term, TriplePattern
+    from kolibrie_tpu.reasoner.device_fixpoint import (
+        DeviceFixpoint,
+        Unsupported,
+    )
+    from kolibrie_tpu.reasoner.reasoner import Reasoner
+
+    rng = random.Random(20260806)
+    accepted = 0
+    for trial in range(12):
+        n_nodes = rng.randrange(6, 16)
+        edges = [
+            (rng.randrange(n_nodes), rng.randrange(n_nodes))
+            for _ in range(rng.randrange(8, 25))
+        ]
+        guard_present = rng.random() < 0.6
+        guard_quoted = rng.random() < 0.5
+        quoted_concl = rng.random() < 0.4
+
+        def build():
+            r = Reasoner()
+            d = r.dictionary
+            C, V = Term.constant, Term.variable
+            for a, b in edges:
+                r.add_abox_triple(f"n{a}", ":edge", f"n{b}")
+            mode, is_, strict = (
+                d.encode(":mode"),
+                d.encode(":is"),
+                d.encode(":strict"),
+            )
+            if guard_quoted:
+                qid = r.quoted.intern(mode, is_, strict)
+                if guard_present:
+                    r.facts.add(qid, d.encode(":cert"), d.encode(":high"))
+                guard = TriplePattern(
+                    Term.quoted(TriplePattern(C(mode), C(is_), C(strict))),
+                    C(d.encode(":cert")),
+                    C(d.encode(":high")),
+                )
+            else:
+                if guard_present:
+                    r.add_abox_triple(":mode", ":is", ":strict")
+                guard = TriplePattern(C(mode), C(is_), C(strict))
+            concls = [
+                TriplePattern(V("x"), C(d.encode(":ok")), V("y"))
+            ]
+            if quoted_concl:
+                concls.append(
+                    TriplePattern(
+                        Term.quoted(
+                            TriplePattern(C(mode), C(is_), C(strict))
+                        ),
+                        C(d.encode(":checked")),
+                        C(d.encode(":yes")),
+                    )
+                )
+            r.add_rule(
+                Rule(
+                    premise=[
+                        guard,
+                        TriplePattern(V("x"), C(d.encode(":edge")), V("y")),
+                    ],
+                    conclusion=concls,
+                )
+            )
+            # a follow-on rule consuming the gated conclusions
+            r.add_rule(
+                Rule(
+                    premise=[
+                        TriplePattern(V("a"), C(d.encode(":ok")), V("b"))
+                    ],
+                    conclusion=[
+                        TriplePattern(V("a"), C(d.encode(":seen")), V("b"))
+                    ],
+                )
+            )
+            return r
+
+        r_dev = build()
+        try:
+            fx = DeviceFixpoint(r_dev)
+        except Unsupported:
+            continue
+        fx.infer()
+        accepted += 1
+        r_host = build()
+        r_host.infer_new_facts_semi_naive()
+        assert r_dev.facts.triples_set() == r_host.facts.triples_set(), (
+            trial,
+            guard_present,
+            guard_quoted,
+            quoted_concl,
+        )
+    assert accepted >= 10, f"only {accepted} trials took the device path"
