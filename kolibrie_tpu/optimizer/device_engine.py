@@ -50,6 +50,7 @@ from kolibrie_tpu.optimizer import plan as P
 from kolibrie_tpu.ops.join import BindingTable
 from kolibrie_tpu.query.ast import (
     Comparison,
+    FunctionCall,
     IriRef,
     LogicalAnd,
     LogicalNot,
@@ -131,6 +132,26 @@ class MaskRef:
 
 
 @dataclass(frozen=True)
+class StrMaskRef:
+    """String-predicate verdict gathers (REGEX/CONTAINS/STRSTARTS/STRENDS
+    against a constant pattern): dictionary IDs read one host-precomputed
+    mask, quoted IDs (bit 31) a second one built over the quoted store —
+    matching the host's decode-then-test semantics for every reachable
+    ID."""
+
+    dict_idx: int
+    quoted_idx: int
+    var: str
+
+
+@dataclass(frozen=True)
+class QuotedCheck:
+    """ISTRIPLE(?v): bit-31 test on the ID column."""
+
+    var: str
+
+
+@dataclass(frozen=True)
 class IdCmp:
     op: str  # '=' | '!='
     var: str
@@ -200,6 +221,21 @@ def _plan_body(
             m = masks[expr.mask_idx]
             ids = cols[expr.var]
             return m[jnp.minimum(ids, m.shape[0] - 1)]
+        if isinstance(expr, StrMaskRef):
+            from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+            ids = cols[expr.var]
+            dm = masks[expr.dict_idx]
+            qm = masks[expr.quoted_idx]
+            isq = (ids & jnp.uint32(QUOTED_BIT)) != 0
+            dv = dm[jnp.minimum(ids, dm.shape[0] - 1)]
+            qidx = ids & jnp.uint32(~QUOTED_BIT & 0xFFFFFFFF)
+            qv = qm[jnp.minimum(qidx, qm.shape[0] - 1)]
+            return jnp.where(isq, qv, dv)
+        if isinstance(expr, QuotedCheck):
+            from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+            return (cols[expr.var] & jnp.uint32(QUOTED_BIT)) != 0
         if isinstance(expr, IdCmp):
             eq = cols[expr.var] == jnp.uint32(expr.const_id)
             return eq if expr.op == "=" else ~eq
@@ -424,7 +460,7 @@ class LoweredPlan:
         self.mask_arrays: List[np.ndarray] = []
         self.mask_exprs: List[tuple] = []  # (op, const) per mask
         self._mask_keys: Dict[tuple, int] = {}
-        self._mask_dict_len = 0
+        self._mask_dict_len: tuple = (0, 0)
         self.values_tables: List[tuple] = []
         self.order_names: List[str] = []
         self._order_idx: Dict[str, int] = {}
@@ -738,32 +774,42 @@ class LoweredPlan:
 
     # ---------------------------------------------------------- filter lowering
 
-    def _compute_mask(self, op: str, const: float) -> np.ndarray:
+    def _compute_mask(self, key: tuple) -> np.ndarray:
+        if key[0] == "str":
+            _tag, name, pattern, which = key
+            return string_filter_mask(self.db, name, pattern, which)
+        op, const = key
         return numeric_filter_mask(self.db.numeric_values(), op, const)
+
+    def _mask_index(self, key: tuple) -> int:
+        idx = self._mask_keys.get(key)
+        if idx is None:
+            idx = len(self.mask_arrays)
+            self.mask_arrays.append(self._compute_mask(key))
+            self.mask_exprs.append(key)
+            self._mask_keys[key] = idx
+            self._mask_dict_len = self._store_sizes()
+        return idx
+
+    def _store_sizes(self) -> tuple:
+        return (len(self.db.dictionary.id_to_str), len(self.db.quoted))
 
     def _numeric_mask(self, op: str, const: float, flip: bool) -> MaskRef:
         """Host-precomputed per-ID mask for ``var op const`` (exact f64)."""
         if flip:
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
-        key = (op, const)
-        idx = self._mask_keys.get(key)
-        if idx is None:
-            idx = len(self.mask_arrays)
-            self.mask_arrays.append(self._compute_mask(op, const))
-            self.mask_exprs.append(key)
-            self._mask_keys[key] = idx
-            self._mask_dict_len = len(self.db.dictionary.id_to_str)
-        return MaskRef(idx, "")  # var filled by caller
+        return MaskRef(self._mask_index((op, const)), "")  # var by caller
 
     def _refresh_masks(self) -> None:
-        """Rebuild per-ID filter masks if the dictionary grew since lowering
-        (new IDs would otherwise clamp onto the last old ID's verdict)."""
-        n = len(self.db.dictionary.id_to_str)
-        if self.mask_arrays and n != self._mask_dict_len:
+        """Rebuild per-ID filter masks if the dictionary (or quoted store —
+        string masks cover it) grew since lowering: new IDs would otherwise
+        clamp onto the last old ID's verdict."""
+        sizes = self._store_sizes()
+        if self.mask_arrays and sizes != self._mask_dict_len:
             self.mask_arrays = [
-                self._compute_mask(op, const) for op, const in self.mask_exprs
+                self._compute_mask(k) for k in self.mask_exprs
             ]
-            self._mask_dict_len = n
+            self._mask_dict_len = sizes
 
     def _lower_filter(self, expr, vars_: set):
         if isinstance(expr, LogicalAnd):
@@ -780,7 +826,43 @@ class LoweredPlan:
             return BoolNode("not", (self._lower_filter(expr.inner, vars_),))
         if isinstance(expr, Comparison):
             return self._lower_comparison(expr, vars_)
+        if isinstance(expr, FunctionCall):
+            return self._lower_function(expr, vars_)
         raise Unsupported(f"filter expression {type(expr).__name__}")
+
+    _STR_FUNCS = ("REGEX", "CONTAINS", "STRSTARTS", "STRENDS")
+
+    def _lower_function(self, expr, vars_: set):
+        """Builtin boolean functions: BOUND/ISTRIPLE as ID tests; the
+        constant-pattern string predicates as per-ID verdict masks (one
+        over dictionary IDs, one over quoted IDs).  UDFs and variable
+        patterns stay host-side."""
+        name = expr.name.upper()
+        args = expr.args
+        if (
+            name in ("BOUND", "ISTRIPLE")
+            and len(args) == 1
+            and isinstance(args[0], Var)
+            and args[0].name in vars_
+        ):
+            if name == "BOUND":
+                from kolibrie_tpu.ops.join import UNBOUND
+
+                return IdCmp("!=", args[0].name, int(UNBOUND))
+            return QuotedCheck(args[0].name)
+        if (
+            name in self._STR_FUNCS
+            and len(args) == 2
+            and isinstance(args[0], Var)
+            and args[0].name in vars_
+            and isinstance(args[1], StringLit)
+        ):
+            lex = args[1].value
+            pattern = lex[1:].split('"')[0] if lex.startswith('"') else lex
+            didx = self._mask_index(("str", name, pattern, "dict"))
+            qidx = self._mask_index(("str", name, pattern, "quoted"))
+            return StrMaskRef(didx, qidx, args[0].name)
+        raise Unsupported(f"filter function {expr.name}")
 
     @staticmethod
     def _as_number(e) -> Optional[float]:
@@ -970,6 +1052,21 @@ class LoweredPlan:
                 m = self.mask_arrays[expr.mask_idx]
                 ids = np.minimum(cols[expr.var], len(m) - 1)
                 return m[ids]
+            if isinstance(expr, StrMaskRef):
+                from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+                ids = cols[expr.var]
+                dm = self.mask_arrays[expr.dict_idx]
+                qm = self.mask_arrays[expr.quoted_idx]
+                isq = (ids & np.uint32(QUOTED_BIT)) != 0
+                dv = dm[np.minimum(ids, len(dm) - 1)]
+                qidx = ids & np.uint32(~QUOTED_BIT & 0xFFFFFFFF)
+                qv = qm[np.minimum(qidx, len(qm) - 1)]
+                return np.where(isq, qv, dv)
+            if isinstance(expr, QuotedCheck):
+                from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+                return (cols[expr.var] & np.uint32(QUOTED_BIT)) != 0
             if isinstance(expr, IdCmp):
                 eq = cols[expr.var] == np.uint32(expr.const_id)
                 return eq if expr.op == "=" else ~eq
@@ -1210,6 +1307,54 @@ class LoweredPlan:
         if not self.const_ok():
             return self.empty_table()
         return self.to_table(*self.converge(self.run()))
+
+
+def _strip_literal_str(s):
+    """Module twin of ExecutionEngine._strip_literal (host string-function
+    semantics: lexical form of quoted literals, raw term otherwise)."""
+    if s is None:
+        return None
+    if s.startswith('"'):
+        end = s.find('"', 1)
+        while end != -1 and s[end - 1] == "\\":
+            end = s.find('"', end + 1)
+        if end > 0:
+            return s[1:end]
+    return s
+
+
+def string_filter_mask(db, name: str, pattern: str, which: str) -> np.ndarray:
+    """Per-ID verdicts for a constant-pattern string predicate: ``which`` =
+    'dict' evaluates over every dictionary term, 'quoted' over every quoted
+    ID's decoded RDF-star form (so quoted-valued variables keep host
+    semantics).  One sentinel False entry keeps empty stores shaped."""
+    from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+    if which == "dict":
+        strs = [_strip_literal_str(s) for s in db.dictionary.id_to_str]
+    else:
+        strs = [
+            _strip_literal_str(db.decode_term(QUOTED_BIT | i))
+            for i in range(len(db.quoted))
+        ]
+    if not strs:
+        strs = [None]
+    if name == "REGEX":
+        import re
+
+        rx = re.compile(pattern or "")
+        return np.array([bool(rx.search(s or "")) for s in strs], dtype=bool)
+    if name == "CONTAINS":
+        return np.array(
+            [(s or "").find(pattern or "") >= 0 for s in strs], dtype=bool
+        )
+    if name == "STRSTARTS":
+        return np.array(
+            [(s or "").startswith(pattern or "") for s in strs], dtype=bool
+        )
+    return np.array(
+        [(s or "").endswith(pattern or "") for s in strs], dtype=bool
+    )
 
 
 def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:

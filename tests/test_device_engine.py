@@ -761,3 +761,65 @@ def test_quoted_query_fuzz():
         except Exception as e:
             raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
         assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
+
+
+def test_string_function_filters_device():
+    """REGEX/CONTAINS/STRSTARTS/STRENDS with constant patterns lower to
+    per-ID verdict masks (round 4); ISTRIPLE is a bit test; BOUND an ID
+    compare. Host agreement on every shape, including quoted-ID columns."""
+    db = SparqlDatabase()
+    db.parse_turtle(
+        """
+    @prefix ex: <http://example.org/> .
+    ex:alice ex:name "Alice Smith" . ex:alice ex:dept "engineering" .
+    ex:bob ex:name "Bob Stone" .     ex:bob ex:dept "marketing" .
+    ex:carol ex:name "Carol Quinn" . ex:carol ex:dept "engineering" .
+    << ex:alice ex:age 30 >> ex:note "approximate estimate" .
+    """
+    )
+    db.execution_mode = "device"
+    for q, n in (
+        ('SELECT ?e ?n WHERE { ?e ex:name ?n . FILTER(CONTAINS(?n, "o")) }', 2),
+        ('SELECT ?e WHERE { ?e ex:name ?n . FILTER(STRSTARTS(?n, "Car")) }', 1),
+        ('SELECT ?e WHERE { ?e ex:dept ?d . FILTER(REGEX(?d, "eng.*ing")) }', 2),
+        (
+            'SELECT ?e WHERE { ?e ex:name ?n . '
+            'FILTER(STRENDS(?n, "ne") && CONTAINS(?n, "B")) }',
+            1,
+        ),
+        ("SELECT ?t WHERE { ?t ex:note ?x . FILTER(ISTRIPLE(?t)) }", 1),
+        (
+            'SELECT ?e WHERE { ?e ex:name ?n . FILTER(!CONTAINS(?n, "o")) }',
+            1,
+        ),
+    ):
+        full = "PREFIX ex: <http://example.org/> " + q
+        dev, host = run_both(db, full)
+        assert sorted(dev) == sorted(host), q
+        assert len(host) == n, (q, host)
+
+
+def test_string_mask_refreshes_after_growth():
+    """A prepared string-filter plan must rebuild its masks when the
+    dictionary (or quoted store) grows — new IDs would otherwise clamp."""
+    db = SparqlDatabase()
+    db.parse_ntriples(
+        '<http://e/a> <http://e/name> "anchor match" .'
+    )
+    db.execution_mode = "device"
+    q = (
+        'SELECT ?s WHERE { ?s <http://e/name> ?n . '
+        'FILTER(CONTAINS(?n, "match")) }'
+    )
+    first = execute_query_volcano(q, db)
+    assert len(first) == 1
+    db.parse_ntriples(
+        '<http://e/b> <http://e/name> "late match arrival" .\n'
+        '<http://e/c> <http://e/name> "no hit" .'
+    )
+    db.execution_mode = "host"
+    host = execute_query_volcano(q, db)
+    db.execution_mode = "device"
+    dev = execute_query_volcano(q, db)
+    assert sorted(dev) == sorted(host)
+    assert len(dev) == 2
