@@ -20,9 +20,12 @@ Fully-constant patterns lower to host membership guards (zero device ops);
 3+-variable join keys ride a union dense-rank composition; quoted patterns
 with inner variables scan their position as a synthetic qid column and
 expand it against the device-resident quoted table (a searchsorted gather
-— each qid names exactly one quoted row).  The remaining unsupported
-constructs (UDF/string functions, cartesian joins, doubly-nested quoted
-patterns) raise :class:`Unsupported` at lowering time and the
+— each qid names exactly one quoted row); constant-pattern string
+predicates (REGEX/CONTAINS/STRSTARTS/STRENDS) become per-ID verdict-mask
+gathers, BOUND/ISTRIPLE become ID tests.  The remaining unsupported
+constructs (UDFs, variable string patterns, cartesian joins,
+doubly-nested quoted patterns) raise :class:`Unsupported` at lowering
+time and the
 caller falls back to the host numpy engine — agreement between the two
 paths is tested in ``tests/test_device_engine.py``.  (BINDs never reach
 the device plan: the executor applies them host-side to the readback
