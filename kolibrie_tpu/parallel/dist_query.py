@@ -113,18 +113,46 @@ def _lower_query_filters(
     exprs: List[tuple] = []
     keys: Dict[tuple, int] = {}
 
-    def mask_idx(op: str, const: float) -> int:
-        k = (op, const)
+    def mask_key(k: tuple) -> int:
         if k not in keys:
             keys[k] = len(exprs)
             exprs.append(k)
         return keys[k]
+
+    def mask_idx(op: str, const: float) -> int:
+        return mask_key((op, const))
 
     def walk(f) -> None:
         if isinstance(f, A.LogicalAnd):
             walk(f.left)
             walk(f.right)
             return
+        if isinstance(f, A.FunctionCall):
+            # constant-pattern string predicates: per-ID verdict masks
+            # (dict + quoted), the single-chip StrMaskRef scheme with the
+            # quoted index riding const_id
+            name = f.name.upper()
+            args = f.args
+            if (
+                name in ("REGEX", "CONTAINS", "STRSTARTS", "STRENDS")
+                and len(args) == 2
+                and isinstance(args[0], A.Var)
+                and args[0].name in bound
+                and isinstance(args[1], A.StringLit)
+            ):
+                lex = args[1].value
+                pattern = (
+                    lex[1:].split('"')[0] if lex.startswith('"') else lex
+                )
+                didx = mask_key(("str", name, pattern, "dict"))
+                qidx = mask_key(("str", name, pattern, "quoted"))
+                lowered.append(
+                    LoweredFilter(
+                        "strmask", args[0].name, mask_idx=didx, const_id=qidx
+                    )
+                )
+                return
+            raise Unsupported(f"filter function {f.name}")
         if not isinstance(f, A.Comparison):
             raise Unsupported(f"filter {type(f).__name__}")
         left, op, right = f.left, f.op, f.right
@@ -158,14 +186,37 @@ def _lower_query_filters(
 
 
 def _materialize_masks(db, exprs: Tuple[tuple, ...]) -> List[np.ndarray]:
-    """Per-ID boolean masks from the db's numeric-literal table — the SAME
-    semantics as the single-chip engine (one shared definition)."""
+    """Per-ID boolean masks — the SAME builders as the single-chip engine
+    (numeric-literal comparisons and constant-pattern string predicates,
+    one shared definition each)."""
     if not exprs:
         return []
-    from kolibrie_tpu.optimizer.device_engine import numeric_filter_mask
+    from kolibrie_tpu.optimizer.device_engine import (
+        numeric_filter_mask,
+        string_filter_mask,
+    )
 
     vals = db.numeric_values()
-    return [numeric_filter_mask(vals, op, const) for op, const in exprs]
+    out = []
+    for key in exprs:
+        if key[0] == "str":
+            out.append(string_filter_mask(db, key[1], key[2], key[3]))
+        else:
+            out.append(numeric_filter_mask(vals, key[0], key[1]))
+    return out
+
+
+def _strmask_verdict(col, masks, f):
+    """Two-level string-predicate gather: dictionary IDs from masks[f.mask_idx],
+    quoted IDs (bit 31) from masks[f.const_id] (single-chip StrMaskRef twin)."""
+    from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+    dm = masks[f.mask_idx]
+    qm = masks[f.const_id]
+    isq = (col & jnp.uint32(QUOTED_BIT)) != 0
+    dv = dm[jnp.minimum(col, dm.shape[0] - 1)]
+    qv = qm[jnp.minimum(col & jnp.uint32(~QUOTED_BIT & 0xFFFFFFFF), qm.shape[0] - 1)]
+    return jnp.where(isq, qv, dv)
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +284,8 @@ def _query_body(
             valid = valid & (col == jnp.uint32(f.const_id))
         elif f.kind == "ne":
             valid = valid & (col != jnp.uint32(f.const_id))
+        elif f.kind == "strmask":
+            valid = valid & _strmask_verdict(col, masks, f)
         else:
             m = masks[f.mask_idx]
             valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]

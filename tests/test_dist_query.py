@@ -382,3 +382,33 @@ def test_distinct_bucket_overflow_retry(mesh):
     dist = ex.run()
     assert sorted(dist) == sorted(host)
     assert len(dist) == 5
+
+
+def test_string_function_filter_agreement(mesh):
+    """Constant-pattern string predicates lower to replicated verdict
+    masks in the mesh program (single-chip StrMaskRef twin)."""
+    db = SparqlDatabase()
+    lines = []
+    names = ["Alice Smith", "Bob Stone", "Carol Quinn", "Dan Smithers"]
+    for i in range(120):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 4}> ."
+        )
+        lines.append(f'{e} <http://example.org/name> "{names[i % 4]} {i}" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    for flt in (
+        'CONTAINS(?n, "Smith")',
+        'STRSTARTS(?n, "Bob")',
+        'REGEX(?n, "S(mith|tone)")',
+        'STRENDS(?n, "7") && CONTAINS(?n, "o")',
+    ):
+        q = f"""PREFIX ex: <http://example.org/>
+        SELECT ?e ?n WHERE {{
+            ?e ex:worksAt ?o . ?e ex:name ?n . FILTER({flt})
+        }}"""
+        host = execute_query_volcano(q, db)
+        dist = execute_query_distributed(q, db, mesh)
+        assert len(host) > 0, flt
+        assert dist == host, flt
