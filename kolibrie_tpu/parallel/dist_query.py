@@ -17,7 +17,9 @@ binding table IS the result (SPARQL bag semantics: no dedup unless
 ``DISTINCT``).
 
 Scope: BGP patterns (constants anywhere but joins keyed at subject/object
-position), numeric + term-equality FILTERs (AND-composed), projection,
+position), numeric + term-equality + constant-pattern string FILTERs
+(AND-composed; string predicates as replicated per-ID verdict masks),
+projection,
 DISTINCT (mesh-side: projection tuples hash to an owner shard, shard-local
 sort-unique is globally exact), ORDER BY + LIMIT (mesh-side per-shard
 numeric-key top-k, O(k·n) readback, host re-orders the union; non-numeric
