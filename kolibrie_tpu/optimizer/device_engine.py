@@ -1625,6 +1625,39 @@ def device_quoted(db):
     return arrs
 
 
+def device_string_ranks(db):
+    """Per-ID global string ranks (f64) for device ORDER BY over
+    non-numeric keys: every dictionary ID and quoted ID ranked by its RAW
+    decoded term (host ``_order_table`` ranks the result subset the same
+    way — subset ranks are order-isomorphic to these global ones).
+    Returns ``(dict_ranks, quoted_ranks)`` (quoted padded to >= 1), cached
+    until either store grows."""
+    import jax.numpy as jnp
+
+    from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+    n_d = len(db.dictionary.id_to_str)
+    n_q = len(db.quoted)
+    cache = db.__dict__.get("_device_strrank_cache")
+    if cache is not None and cache[0] == (n_d, n_q):
+        return cache[1]
+    dec = db.decode_term
+    strs = [dec(i) or "" for i in range(n_d)] + [
+        dec(QUOTED_BIT | i) or "" for i in range(n_q)
+    ]
+    _, inv = np.unique(np.array(strs), return_inverse=True)
+    ranks = inv.astype(np.float64)
+    with jax.enable_x64(True):
+        arrs = (
+            jnp.asarray(ranks[:n_d]),
+            jnp.asarray(
+                ranks[n_d:] if n_q else np.zeros(1, dtype=np.float64)
+            ),
+        )
+    db.__dict__["_device_strrank_cache"] = ((n_d, n_q), arrs)
+    return arrs
+
+
 def device_numf(db):
     """Per-database device copy of the numeric-literal table (f64), cached
     until the dictionary grows — the one cache both the single-chip plan
@@ -1689,13 +1722,15 @@ def aggregate_table(
 
 
 @partial(jax.jit, static_argnames=("opos", "descs", "k"))
-def _order_limit(cols, valid, numf, opos, descs, k):
-    """ORDER BY + LIMIT on device: numeric sort keys gathered from the
-    per-ID numeric table, lexsort-composed stable argsorts (host
-    ``np.lexsort`` parity), first-``k`` slice.  Readback is O(k), not
+def _order_limit(cols, valid, numf, opos, descs, k, dranks=None, qranks=None):
+    """ORDER BY + LIMIT on device: sort keys gathered from the per-ID
+    numeric table — or, when a key column holds ANY non-numeric value
+    (the host ``_order_table`` per-column rule), from the global string
+    RANKS (``device_string_ranks``; two-level for quoted IDs) — composed
+    as lexsort-stable argsorts, first-``k`` slice.  Readback is O(k), not
     O(rows).  Returns ``(sliced cols, sliced valid, n_valid, nan_seen)``;
-    ``nan_seen`` means a non-numeric key value exists and the caller must
-    fall back to the host string-rank ordering."""
+    with no rank arrays supplied, ``nan_seen`` tells the caller to fall
+    back to host string ordering (legacy contract)."""
     import jax.numpy as jnp
 
     n = valid.shape[0]
@@ -1703,8 +1738,22 @@ def _order_limit(cols, valid, numf, opos, descs, k):
     nan_seen = jnp.zeros((), bool)
     keys = []
     for pos, desc in zip(opos, descs):
-        vals = numf[jnp.minimum(cols[pos], numf.shape[0] - 1)]
-        nan_seen = nan_seen | jnp.any(jnp.isnan(vals) & valid)
+        col = cols[pos]
+        vals = numf[jnp.minimum(col, numf.shape[0] - 1)]
+        col_nan = jnp.any(jnp.isnan(vals) & valid)
+        if dranks is not None:
+            from kolibrie_tpu.core.dictionary import QUOTED_BIT
+
+            isq = (col & jnp.uint32(QUOTED_BIT)) != 0
+            dr = dranks[jnp.minimum(col, dranks.shape[0] - 1)]
+            qi = col & jnp.uint32(~QUOTED_BIT & 0xFFFFFFFF)
+            qr = qranks[jnp.minimum(qi, qranks.shape[0] - 1)]
+            srank = jnp.where(isq, qr, dr)
+            # host rule: a single non-numeric value switches the WHOLE
+            # column to string-rank ordering
+            vals = jnp.where(col_nan, srank, vals)
+        else:
+            nan_seen = nan_seen | col_nan
         keys.append(-vals if desc else vals)
     # lexsort composition: secondary keys first, primary key last, then
     # validity as the outermost key so invalid rows sink to the end
@@ -1791,27 +1840,18 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
     k = _round_cap((q.offset or 0) + q.limit, 8)
     with jax.enable_x64(True):
         numf_dev = lowered._device_numf()
+        dranks, qranks = device_string_ranks(db)
         out_cols, valid = lowered.converge(lowered.run())
-        top_cols, top_valid, _n_valid, nan_seen = _order_limit(
+        top_cols, top_valid, _n_valid, _nan = _order_limit(
             tuple(out_cols),
             valid,
             numf_dev,
             tuple(opos),
             tuple(descs),
             k,
+            dranks,
+            qranks,
         )
-        if bool(nan_seen):
-            # non-numeric key: host string-rank ordering applies — but the
-            # device result is already converged, so reuse it instead of
-            # letting execute_select re-plan and re-execute the whole query
-            from kolibrie_tpu.query.executor import _order_table
-
-            table = lowered.to_table(out_cols, valid)
-            table = {v: table[v] for v in out_vars if v in sel_vars}
-            table = _order_table(db, table, q.order_by)
-            rows = format_results(db, table, q)
-            start = q.offset or 0
-            return rows[start : start + q.limit]
     tv = np.asarray(top_valid)
     table: BindingTable = {
         v: np.asarray(c)[tv].astype(np.uint32)

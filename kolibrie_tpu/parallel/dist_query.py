@@ -231,6 +231,8 @@ def _query_body(
     masks,
     numf,
     vals,
+    dranks,
+    qranks,
     *,
     premises,
     seed,
@@ -344,7 +346,7 @@ def _query_body(
         k, opos, descs = topk
         cols_t = tuple(table[v] for v in out_vars)
         top_cols, valid, _n_valid, nan_seen = _order_limit(
-            cols_t, valid, numf, opos, descs, k
+            cols_t, valid, numf, opos, descs, k, dranks, qranks
         )
         table = dict(zip(out_vars, top_cols))
 
@@ -389,10 +391,12 @@ def _query_fn(
     spec = P(axis, None)
     return jax.jit(
         jax.shard_map(
-            lambda state, masks, numf, vals: body(state, masks, numf, vals),
+            lambda state, masks, numf, vals, dranks, qranks: body(
+                state, masks, numf, vals, dranks, qranks
+            ),
             mesh=mesh,
             check_vma=_dist_check_vma(),
-            in_specs=((spec,) * 8, (P(),) * n_masks, P(), P()),
+            in_specs=((spec,) * 8, (P(),) * n_masks, P(), P(), P(), P()),
             out_specs=(
                 (spec,) * len(out_vars),
                 spec,
@@ -679,6 +683,15 @@ class DistQueryExecutor:
             if topk is not None
             else np.zeros(1, dtype=np.float64)
         )
+        if topk is not None:
+            from kolibrie_tpu.optimizer.device_engine import (
+                device_string_ranks,
+            )
+
+            dranks, qranks = device_string_ranks(self.db)
+        else:
+            dranks = np.zeros(1, dtype=np.float64)
+            qranks = np.zeros(1, dtype=np.float64)
         vals = (
             self.values_ids
             if self.values_var is not None
@@ -701,7 +714,7 @@ class DistQueryExecutor:
             )
             with jax.enable_x64(True):
                 outs, valid, total, overflow, nan_flag = fn(
-                    state, masks, numf, vals
+                    state, masks, numf, vals, dranks, qranks
                 )
             if int(overflow[0]) == 0:
                 return outs, valid, total, nan_flag
@@ -819,15 +832,9 @@ class DistQueryExecutor:
             if opos is not None:
                 k = round_cap((q.offset or 0) + q.limit, 8)
                 topk = (k, tuple(opos), tuple(descs))
-        outs, valid, _total, nan_flag = self.run_device(
+        outs, valid, _total, _nan = self.run_device(
             distinct=bool(q.distinct), topk=topk
         )
-        if topk is not None and int(nan_flag[0]) > 0:
-            # a non-numeric sort key: host string-rank ordering applies —
-            # re-run without the top-k stage on the full result
-            outs, valid, _total, _nan = self.run_device(
-                distinct=bool(q.distinct)
-            )
         v = np.asarray(valid).reshape(-1)
         table = {
             var: np.asarray(col).reshape(-1)[v].astype(np.uint32)

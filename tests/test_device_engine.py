@@ -823,3 +823,35 @@ def test_string_mask_refreshes_after_growth():
     dev = execute_query_volcano(q, db)
     assert sorted(dev) == sorted(host)
     assert len(dev) == 2
+
+
+def test_string_order_by_device_topk():
+    """Non-numeric ORDER BY keys ride the global per-ID string ranks
+    (round 4) — the device top-k no longer falls back to host ordering;
+    exact host agreement with unique keys, mixed key directions."""
+    from kolibrie_tpu.optimizer.device_engine import (
+        try_device_execute_ordered,
+    )
+
+    db = SparqlDatabase()
+    lines = []
+    for i in range(150):
+        lines.append(f'<http://e/p{i}> <http://e/name> "person {i:03d}" .')
+        lines.append(f'<http://e/p{i}> <http://e/dept> "d{i % 7}" .')
+        lines.append(f'<http://e/p{i}> <http://e/salary> "{1000 + i * 3}" .')
+    db.parse_ntriples("\n".join(lines))
+    for q in (
+        "SELECT ?p ?n WHERE { ?p <http://e/name> ?n . ?p <http://e/dept> ?d }"
+        " ORDER BY DESC(?n) LIMIT 9",
+        "SELECT ?p ?n ?s WHERE { ?p <http://e/name> ?n . "
+        "?p <http://e/salary> ?s } ORDER BY ?n LIMIT 6",
+        # string primary + numeric secondary
+        "SELECT ?p ?d ?s WHERE { ?p <http://e/dept> ?d . "
+        "?p <http://e/salary> ?s } ORDER BY ?d DESC(?s) LIMIT 8",
+    ):
+        db.execution_mode = "host"
+        host = execute_query_volcano(q, db)
+        db.execution_mode = "device"
+        dev = try_device_execute_ordered(db, parse_sparql_query(q))
+        assert dev is not None, q
+        assert dev == host, q

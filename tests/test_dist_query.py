@@ -265,9 +265,9 @@ def test_order_by_offset_and_distinct_topk(mesh):
     assert dist == host
 
 
-def test_order_by_string_key_host_fallback(mesh):
-    """A non-numeric sort key sets the NaN flag: the driver re-runs without
-    the top-k stage and orders by decoded string rank on host."""
+def test_order_by_string_key_mesh_ranked(mesh):
+    """Non-numeric sort keys ride the global per-ID string ranks inside
+    the mesh top-k (round 4) — no host re-run, exact agreement."""
     db = SparqlDatabase()
     lines = []
     for i in range(40):
