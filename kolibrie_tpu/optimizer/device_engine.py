@@ -1312,20 +1312,6 @@ class LoweredPlan:
         return self.to_table(*self.converge(self.run()))
 
 
-def _strip_literal_str(s):
-    """Module twin of ExecutionEngine._strip_literal (host string-function
-    semantics: lexical form of quoted literals, raw term otherwise)."""
-    if s is None:
-        return None
-    if s.startswith('"'):
-        end = s.find('"', 1)
-        while end != -1 and s[end - 1] == "\\":
-            end = s.find('"', end + 1)
-        if end > 0:
-            return s[1:end]
-    return s
-
-
 def string_filter_mask(db, name: str, pattern: str, which: str) -> np.ndarray:
     """Per-ID verdicts for a constant-pattern string predicate: ``which`` =
     'dict' evaluates over every dictionary term, 'quoted' over every quoted
@@ -1333,11 +1319,13 @@ def string_filter_mask(db, name: str, pattern: str, which: str) -> np.ndarray:
     semantics).  One sentinel False entry keeps empty stores shaped."""
     from kolibrie_tpu.core.dictionary import QUOTED_BIT
 
+    from kolibrie_tpu.optimizer.engine import strip_literal
+
     if which == "dict":
-        strs = [_strip_literal_str(s) for s in db.dictionary.id_to_str]
+        strs = [strip_literal(s) for s in db.dictionary.id_to_str]
     else:
         strs = [
-            _strip_literal_str(db.decode_term(QUOTED_BIT | i))
+            strip_literal(db.decode_term(QUOTED_BIT | i))
             for i in range(len(db.quoted))
         ]
     if not strs:
@@ -1722,25 +1710,43 @@ def aggregate_table(
 
 
 @partial(jax.jit, static_argnames=("opos", "descs", "k"))
-def _order_limit(cols, valid, numf, opos, descs, k, dranks=None, qranks=None):
+def _order_limit(
+    cols,
+    valid,
+    numf,
+    opos,
+    descs,
+    k,
+    dranks=None,
+    qranks=None,
+    nan_overrides=None,
+):
     """ORDER BY + LIMIT on device: sort keys gathered from the per-ID
     numeric table — or, when a key column holds ANY non-numeric value
     (the host ``_order_table`` per-column rule), from the global string
     RANKS (``device_string_ranks``; two-level for quoted IDs) — composed
     as lexsort-stable argsorts, first-``k`` slice.  Readback is O(k), not
-    O(rows).  Returns ``(sliced cols, sliced valid, n_valid, nan_seen)``;
-    with no rank arrays supplied, ``nan_seen`` tells the caller to fall
-    back to host string ordering (legacy contract)."""
+    O(rows).  Returns ``(sliced cols, sliced valid, n_valid, nan_seen)``.
+    Callers run WITHOUT ranks first (numeric ordering pays no host rank
+    build); a truthy ``nan_seen`` means re-run with ranks.  Under
+    ``shard_map`` the per-key decision must be GLOBAL — pass psum'd
+    ``nan_overrides`` (one traced bool per key), or a shard could sort
+    numerically while another holds the non-numeric value that switches
+    the whole column to string ranks."""
     import jax.numpy as jnp
 
     n = valid.shape[0]
     perm = jnp.arange(n, dtype=jnp.int32)
     nan_seen = jnp.zeros((), bool)
     keys = []
-    for pos, desc in zip(opos, descs):
+    for i, (pos, desc) in enumerate(zip(opos, descs)):
         col = cols[pos]
         vals = numf[jnp.minimum(col, numf.shape[0] - 1)]
-        col_nan = jnp.any(jnp.isnan(vals) & valid)
+        if nan_overrides is not None:
+            col_nan = nan_overrides[i]
+        else:
+            col_nan = jnp.any(jnp.isnan(vals) & valid)
+        nan_seen = nan_seen | col_nan
         if dranks is not None:
             from kolibrie_tpu.core.dictionary import QUOTED_BIT
 
@@ -1752,8 +1758,6 @@ def _order_limit(cols, valid, numf, opos, descs, k, dranks=None, qranks=None):
             # host rule: a single non-numeric value switches the WHOLE
             # column to string-rank ordering
             vals = jnp.where(col_nan, srank, vals)
-        else:
-            nan_seen = nan_seen | col_nan
         keys.append(-vals if desc else vals)
     # lexsort composition: secondary keys first, primary key last, then
     # validity as the outermost key so invalid rows sink to the end
@@ -1840,18 +1844,31 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
     k = _round_cap((q.offset or 0) + q.limit, 8)
     with jax.enable_x64(True):
         numf_dev = lowered._device_numf()
-        dranks, qranks = device_string_ranks(db)
         out_cols, valid = lowered.converge(lowered.run())
-        top_cols, top_valid, _n_valid, _nan = _order_limit(
+        # phase 1: numeric keys only — no host rank build
+        top_cols, top_valid, _n_valid, nan_seen = _order_limit(
             tuple(out_cols),
             valid,
             numf_dev,
             tuple(opos),
             tuple(descs),
             k,
-            dranks,
-            qranks,
         )
+        if bool(nan_seen):
+            # phase 2: a key column holds non-numeric values — build the
+            # global string ranks once (cached per store version) and
+            # re-sort the already-device-resident columns
+            dranks, qranks = device_string_ranks(db)
+            top_cols, top_valid, _n_valid, _nan = _order_limit(
+                tuple(out_cols),
+                valid,
+                numf_dev,
+                tuple(opos),
+                tuple(descs),
+                k,
+                dranks,
+                qranks,
+            )
     tv = np.asarray(top_valid)
     table: BindingTable = {
         v: np.asarray(c)[tv].astype(np.uint32)

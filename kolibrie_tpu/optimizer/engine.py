@@ -67,6 +67,21 @@ def resolve_pattern(db, pattern: PatternTriple) -> PatternTriple:
     return PatternTriple(rt(pattern.subject), rt(pattern.predicate), rt(pattern.object))
 
 
+def strip_literal(s: Optional[str]) -> Optional[str]:
+    """Lexical form of a quoted literal (escaped-quote aware), raw term
+    otherwise — THE string-function stripping rule, shared by the host
+    engine and the device string-predicate masks."""
+    if s is None:
+        return None
+    if s.startswith('"'):
+        end = s.find('"', 1)
+        while end != -1 and s[end - 1] == "\\":
+            end = s.find('"', end + 1)
+        if end > 0:
+            return s[1:end]
+    return s
+
+
 class ExecutionEngine:
     def __init__(self, db, subquery_eval: Optional[Callable] = None):
         self.db = db
@@ -396,15 +411,7 @@ class ExecutionEngine:
         return [None] * n
 
     def _strip_literal(self, s: Optional[str]) -> Optional[str]:
-        if s is None:
-            return None
-        if s.startswith('"'):
-            end = s.find('"', 1)
-            while end != -1 and s[end - 1] == "\\":
-                end = s.find('"', end + 1)
-            if end > 0:
-                return s[1:end]
-        return s
+        return strip_literal(s)
 
     def _eval_string_function(self, expr: FuncExpr, table: BindingTable) -> List[Optional[str]]:
         name = expr.name

@@ -334,19 +334,39 @@ def _query_body(
 
     nan_seen = jnp.zeros((), dtype=bool)
     if topk is not None:
-        # mesh-side ORDER BY + LIMIT: per-shard numeric-key top-k through
-        # the device engine's `_order_limit` (one definition of the lexsort
+        # mesh-side ORDER BY + LIMIT: per-shard top-k through the device
+        # engine's `_order_limit` (one definition of the lexsort
         # composition) — the union of per-shard top-k contains the global
         # top-k, so readback is O(k·n), and the host re-orders those k·n
-        # rows for the final slice.  A NaN sort key (non-numeric term)
-        # sets the replicated flag: the caller must re-run without topk
-        # and use host string-rank ordering.
+        # rows for the final slice.  The numeric-vs-string decision per
+        # key column must be GLOBAL (host rule: one non-numeric value
+        # anywhere switches the whole column), so each key's flag is
+        # psum'd before the sort.  Phase 1 runs with placeholder ranks;
+        # a truthy flag makes the driver build the real ranks and re-run.
         from kolibrie_tpu.optimizer.device_engine import _order_limit
 
         k, opos, descs = topk
         cols_t = tuple(table[v] for v in out_vars)
+        overrides = []
+        for pos in opos:
+            vals_k = numf[jnp.minimum(cols_t[pos], numf.shape[0] - 1)]
+            overrides.append(
+                lax.psum(
+                    jnp.any(jnp.isnan(vals_k) & valid).astype(jnp.int32),
+                    axis,
+                )
+                > 0
+            )
         top_cols, valid, _n_valid, nan_seen = _order_limit(
-            cols_t, valid, numf, opos, descs, k, dranks, qranks
+            cols_t,
+            valid,
+            numf,
+            opos,
+            descs,
+            k,
+            dranks,
+            qranks,
+            tuple(overrides),
         )
         table = dict(zip(out_vars, top_cols))
 
@@ -662,7 +682,9 @@ class DistQueryExecutor:
             self.store = ShardedTripleStore.from_columns(self.mesh, s, p, o)
         return self.store
 
-    def run_device(self, max_attempts: int = 8, distinct=False, topk=None):
+    def run_device(
+        self, max_attempts: int = 8, distinct=False, topk=None, with_ranks=False
+    ):
         """Dispatch the compiled program; returns the UN-read device arrays
         ``(out_cols, valid, total, nan_flag)`` at the first capacity that
         does not overflow (benchmarks time this, then read back).
@@ -683,13 +705,15 @@ class DistQueryExecutor:
             if topk is not None
             else np.zeros(1, dtype=np.float64)
         )
-        if topk is not None:
+        if topk is not None and with_ranks:
             from kolibrie_tpu.optimizer.device_engine import (
                 device_string_ranks,
             )
 
             dranks, qranks = device_string_ranks(self.db)
         else:
+            # phase-1 placeholders: unused unless a psum'd per-key flag
+            # fires, in which case the driver re-runs with real ranks
             dranks = np.zeros(1, dtype=np.float64)
             qranks = np.zeros(1, dtype=np.float64)
         vals = (
@@ -832,9 +856,15 @@ class DistQueryExecutor:
             if opos is not None:
                 k = round_cap((q.offset or 0) + q.limit, 8)
                 topk = (k, tuple(opos), tuple(descs))
-        outs, valid, _total, _nan = self.run_device(
+        outs, valid, _total, nan_flag = self.run_device(
             distinct=bool(q.distinct), topk=topk
         )
+        if topk is not None and int(nan_flag[0]) > 0:
+            # a non-numeric sort key somewhere on the mesh: build the
+            # global string ranks and re-run the SAME top-k with them
+            outs, valid, _total, _nan = self.run_device(
+                distinct=bool(q.distinct), topk=topk, with_ranks=True
+            )
         v = np.asarray(valid).reshape(-1)
         table = {
             var: np.asarray(col).reshape(-1)[v].astype(np.uint32)
