@@ -412,3 +412,31 @@ def test_string_function_filter_agreement(mesh):
         dist = execute_query_distributed(q, db, mesh)
         assert len(host) > 0, flt
         assert dist == host, flt
+
+
+def test_order_by_mixed_key_types_global_decision(mesh):
+    """One non-numeric value ANYWHERE switches the whole sort column to
+    string ranks (host rule) — the mesh top-k must psum the per-key
+    decision, or shards holding only numeric values would sort numerically
+    and drop rows from the global top-k."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(1, 51):
+        e = f"<http://example.org/e{i}>"
+        lines.append(f"{e} <http://example.org/worksAt> <http://example.org/org> .")
+        lines.append(f'{e} <http://example.org/v> "{i}" .')
+    # the single non-numeric value: most shards never see it
+    lines.append(
+        "<http://example.org/odd> <http://example.org/worksAt> <http://example.org/org> ."
+    )
+    lines.append('<http://example.org/odd> <http://example.org/v> "apple" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?v WHERE {
+        ?e ex:worksAt ?o . ?e ex:v ?v .
+    } ORDER BY ?v LIMIT 8"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 8
+    assert dist == host
