@@ -79,6 +79,23 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _pallas_call(*args, **kwargs):
+    """``pl.pallas_call`` with x64 promotion OFF at trace time (kernel body
+    and index maps alike).  Callers (the device engine, the fixpoint) trace
+    whole plans under ``jax.enable_x64``, where ``jnp.sum`` accumulates i32
+    in i64 — and Mosaic's i64→i32 convert lowering recurses without
+    terminating.  Operands are concretely i32/f32, so only Python-literal
+    promotion changes.  Every kernel in this module must launch through
+    this wrapper."""
+    inner = pl.pallas_call(*args, **kwargs)
+
+    def launch(*operands):
+        with jax.enable_x64(False):
+            return inner(*operands)
+
+    return launch
+
+
 def pallas_join_enabled() -> bool:
     """Should the engine route eligible joins through the Pallas kernel?
 
@@ -292,7 +309,7 @@ def _pallas_join_core(
         jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32, **kwargs)
         for _ in range(4)
     ]
-    key_o, lval_o, pos_o, valid_o = pl.pallas_call(
+    key_o, lval_o, pos_o, valid_o = _pallas_call(
         _merge_join_kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -396,10 +413,12 @@ def _pallas_join_core_chunked(
         pref = jnp.concatenate(
             [rs_local, total[None], (c * chunk_out)[None].astype(jnp.int32)]
         )
+        # Both slice indices must share a dtype: a bare Python 0 promotes
+        # to i64 under the callers' jax.enable_x64 traces and fails.
         rows_loc = lax.dynamic_slice(
-            rows_p, (row_base, 0), (l_win, _NCOLS)
+            rows_p, (row_base, jnp.int32(0)), (l_win, _NCOLS)
         ).reshape(nb_loc, BW, _NCOLS)
-        outs = pl.pallas_call(
+        outs = _pallas_call(
             _merge_join_kernel,
             grid_spec=grid_spec,
             out_shape=out_shape,
@@ -700,7 +719,7 @@ def _filter_mask_jit(consts, s, p, o) -> jnp.ndarray:
         return x.reshape(rows, TILE)
 
     block = pl.BlockSpec((_CHUNK_ROWS, TILE), lambda i, *_: (i, 0))
-    mask2d = pl.pallas_call(
+    mask2d = _pallas_call(
         _filter_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -758,7 +777,7 @@ def tag_combine(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
         return x.reshape(rows, TILE)
 
     block = pl.BlockSpec((_CHUNK_ROWS, TILE), lambda i: (i, 0))
-    out = pl.pallas_call(
+    out = _pallas_call(
         _tag_kernel_factory(op),
         grid=(n_chunks,),
         out_shape=jax.ShapeDtypeStruct((rows, TILE), jnp.float32),

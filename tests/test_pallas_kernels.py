@@ -302,3 +302,75 @@ class TestTagCombine:
         a = jnp.zeros(4)
         with pytest.raises(ValueError):
             tag_combine(a, a, "xor")
+
+
+class TestX64TraceSafety:
+    """Regression: callers (device engine, fixpoint) trace whole plans under
+    ``jax.enable_x64``; with x64 promotion live inside a kernel body,
+    ``jnp.sum`` accumulates i32 in i64 and Mosaic's i64→i32 convert lowering
+    recurses without terminating (RecursionError at compile time on real
+    TPU — invisible to the CPU interpreter, so assert on the jaxpr: no
+    64-bit dtype may appear inside any pallas_call sub-jaxpr)."""
+
+    @staticmethod
+    def _assert_no_i64_in_pallas(jaxpr):
+        def subjaxprs(params):
+            def scan(v):
+                if hasattr(v, "eqns"):  # Jaxpr
+                    yield v
+                elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+                    yield v.jaxpr
+                elif isinstance(v, (tuple, list)):
+                    for item in v:
+                        yield from scan(item)
+                elif hasattr(v, "block_mappings"):  # pallas GridMapping:
+                    # index-map jaxprs ride the dataclass, not params
+                    for bm in v.block_mappings:
+                        yield from scan(bm.index_map_jaxpr)
+
+            for v in params.values():
+                yield from scan(v)
+
+        def walk(j, inside_pallas):
+            for eqn in j.eqns:
+                inside = inside_pallas or eqn.primitive.name == "pallas_call"
+                if inside_pallas:
+                    for v in [*eqn.invars, *eqn.outvars]:
+                        aval = getattr(v, "aval", None)
+                        dt = getattr(aval, "dtype", None)
+                        if dt is not None:
+                            assert dt.itemsize < 8, (
+                                f"64-bit {dt} inside pallas kernel: {eqn}"
+                            )
+                for sub in subjaxprs(eqn.params):
+                    walk(sub, inside)
+
+        walk(jaxpr.jaxpr, False)
+
+    @pytest.mark.parametrize("chunk_out", [None, 1024])
+    def test_merge_join_traces_x64_clean(self, chunk_out):
+        import jax
+        from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
+
+        lkey = jnp.arange(256, dtype=jnp.uint32)
+        rkey = jnp.arange(256, dtype=jnp.uint32)
+        with jax.enable_x64(True):
+            jaxpr = jax.make_jaxpr(
+                lambda a, b: merge_join_indices(
+                    a, b, cap=2048, chunk_out=chunk_out
+                )
+            )(lkey, rkey)
+        self._assert_no_i64_in_pallas(jaxpr)
+
+    def test_filter_and_tag_trace_x64_clean(self):
+        import jax
+
+        s = jnp.arange(256, dtype=jnp.uint32)
+        t = jnp.ones(256, jnp.float32)
+        with jax.enable_x64(True):
+            j1 = jax.make_jaxpr(
+                lambda a: filter_mask(a, a, a, o_op=2, o_cmp=7)
+            )(s)
+            j2 = jax.make_jaxpr(lambda a: tag_combine(a, a, "min"))(t)
+        self._assert_no_i64_in_pallas(j1)
+        self._assert_no_i64_in_pallas(j2)
