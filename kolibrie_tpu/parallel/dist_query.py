@@ -30,10 +30,13 @@ mesh gathers all pattern variables; binds + bind-reading filters apply
 host-side to the small result table — the single-chip device split).
 VALUES in its constraining form (one BGP-bound variable, distinct bound
 cells) lowers to a replicated membership mask inside the mesh program.
-Everything else (general VALUES, OPTIONAL, UNION, subqueries, windows;
-BIND mixed with aggregates) raises :class:`Unsupported` — callers fall
-back to the single-chip engine, mirroring the device engine's own
-fallback contract.
+Plain sub-SELECTs (no aggregation/modifiers) fold into the BGP before
+lowering (:mod:`kolibrie_tpu.query.subquery_inline` — the same rewrite
+the single-chip paths apply), so nested selects distribute too.
+Everything else (general VALUES, OPTIONAL, UNION, non-inlinable
+subqueries, windows; BIND mixed with aggregates) raises
+:class:`Unsupported` — callers fall back to the single-chip engine,
+mirroring the device engine's own fallback contract.
 
 Parity: the reference has NO distributed execution (SURVEY §2.6) — this is
 the TPU-native axis it lacks.  Row agreement with the host volcano executor
@@ -461,7 +464,11 @@ class DistQueryExecutor:
         q = cq.select
         if q is None or cq.rules or cq.insert or cq.delete or cq.ml_predict:
             raise Unsupported("distributed path executes plain SELECT only")
-        w = q.where
+        from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+        # plain sub-SELECTs fold into the BGP (same rewrite the single-chip
+        # paths apply), so nested selects distribute too
+        w = inline_subqueries(q.where)
         if (
             w.subqueries
             or w.not_blocks

@@ -81,6 +81,12 @@ def eval_where(
     the matching device-lowered plan — an object to execute directly,
     ``False`` if lowering already failed (skip the device path), None if
     no lowering was attempted yet."""
+    from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+    # Fold plain sub-SELECTs into the group before planning: one plan (and
+    # on TPU one device program) instead of materialize-then-join-on-host.
+    # Non-inlinable subqueries stay in where.subqueries for the post-pass.
+    where = inline_subqueries(where)
     engine = ExecutionEngine(db, subquery_eval=lambda sq: eval_select_to_table(db, sq.query))
     resolved = [resolve_pattern(db, p) for p in where.patterns]
     # filters referencing BIND outputs can only run after the binds
@@ -263,7 +269,11 @@ def _try_device_aggregate(
     twice on fallback; lowered False = lowering failed, don't retry)."""
     if not use_optimizer or not _device_routed(db):
         return None, None, None
-    w = q.where
+    from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+    w = inline_subqueries(q.where)  # same fold eval_where applies (it is
+    #                                 deterministic, so the plan built here
+    #                                 matches the where eval_where sees)
     if (
         w.subqueries
         or w.unions
