@@ -1904,7 +1904,12 @@ class PreparedQuery:
             raise Unsupported("prepared queries must be SELECTs")
         self.db = db
         self.query = cq.select
-        where = cq.select.where
+        from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+        # plain sub-SELECTs fold into the BGP (the rewrite every execution
+        # path applies), so e.g. the reference's nested-select benchmark
+        # shape (my_benchmark.rs:55-113) prepares as one device program
+        where = inline_subqueries(cq.select.where)
         if (
             where.subqueries
             or where.unions

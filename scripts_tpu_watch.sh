@@ -33,6 +33,7 @@ STEPS=(
   "repro_fixpoint_pass|600|python repros/mosaic_composed_fixpoint_cap_fault.py 2097152"
   "repro_fixpoint_fault|600|python repros/mosaic_composed_fixpoint_cap_fault.py 4194304"
   "chunked_join_validation|1500|python repros/pallas_chunked_join_validation.py"
+  "subquery_bench|1200|python benches/bench_subquery.py"
   "dist_pallas|1500|python benches/bench_dist_pallas.py"
   "rsp_engine|1500|python benches/bench_rsp_engine.py"
   "r2r_incremental|1500|python benches/bench_r2r_incremental.py"
