@@ -253,6 +253,11 @@ def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> Bind
                 if item.kind == "expr":
                     out[item.alias] = engine.eval_arith_to_ids(item.expr, table)
             table = out
+        elif any(k.startswith("__") for k in table):
+            # internal columns (e.g. inlined subqueries' scoped variables)
+            # are not part of ``*`` — drop them BEFORE DISTINCT so dedup
+            # runs over the visible projection only
+            table = {k: v for k, v in table.items() if not k.startswith("__")}
     if q.distinct:
         table = unique_table(table)
     return table

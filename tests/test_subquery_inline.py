@@ -306,3 +306,40 @@ def test_distributed_subquery_agreement(mesh):
     dist = execute_query_distributed(sparql, db, mesh)
     assert len(host) > 0
     assert dist == host
+
+
+class TestSelectStar:
+    def test_star_excludes_scoped_vars(self, db):
+        from kolibrie_tpu.query.executor import execute_select
+
+        db.register_prefixes_from_query(EX)
+        q = parse_sparql_query(
+            EX
+            + """SELECT * WHERE {
+              ?x ex:name ?n .
+              { SELECT ?x WHERE { ?x ex:dept ?d } }
+            }""",
+            db.prefixes,
+        )
+        from kolibrie_tpu.query.executor import eval_select_to_table
+
+        table = eval_select_to_table(db, q)
+        # subquery-scoped ?d must not surface through SELECT *
+        assert all(not k.startswith("__") for k in table)
+        assert set(table) == {"x", "n"}
+
+    def test_distinct_star_dedups_visible_projection(self, db):
+        from kolibrie_tpu.query.executor import execute_select
+
+        q = parse_sparql_query(
+            EX
+            + """SELECT DISTINCT * WHERE {
+              ?c ex:label ?l .
+              { SELECT ?c WHERE { ?x ex:dept ?c } }
+            }""",
+            db.prefixes,
+        )
+        rows = execute_select(db, q)
+        # without the internal-column drop the hidden ?x would keep the
+        # bag's duplicates alive through DISTINCT
+        assert len(rows) == 2
