@@ -343,3 +343,76 @@ class TestSelectStar:
         # without the internal-column drop the hidden ?x would keep the
         # bag's duplicates alive through DISTINCT
         assert len(rows) == 2
+
+
+def test_subquery_fuzz_differential():
+    """Random subquery queries checked three ways: the legacy
+    materialize-then-join path (inliner patched to identity) is the
+    oracle for the inlined host path and the inlined device path."""
+    import random
+    from unittest import mock
+
+    import kolibrie_tpu.query.subquery_inline as sqmod
+
+    rng = random.Random(20260731)
+    db = SparqlDatabase()
+    lines = []
+    preds = [f"<http://f.e/p{k}>" for k in range(4)]
+    for i in range(400):
+        s = f"<http://f.e/s{rng.randrange(60)}>"
+        pr = rng.choice(preds)
+        if rng.random() < 0.5:
+            o = f"<http://f.e/s{rng.randrange(60)}>"
+        else:
+            o = f'"{rng.randrange(0, 3000)}"'
+        lines.append(f"{s} {pr} {o} .")
+    db.parse_ntriples("\n".join(lines))
+
+    vars_pool = ["?a", "?b", "?c"]
+
+    def rand_bgp(shared_var):
+        n_pat = rng.randrange(1, 3)
+        pats, used = [], []
+        for j in range(n_pat):
+            s = shared_var if j == 0 and shared_var else rng.choice(vars_pool)
+            o = rng.choice(
+                vars_pool + [f"<http://f.e/s{rng.randrange(60)}>"]
+            )
+            pats.append(f"{s} {rng.choice(preds)} {o} .")
+            for t in (s, o):
+                if t.startswith("?") and t not in used:
+                    used.append(t)
+        filt = ""
+        if used and rng.random() < 0.4:
+            v = rng.choice(used)
+            op = rng.choice([">", "<", ">=", "!="])
+            filt = f"FILTER({v} {op} {rng.randrange(0, 3000)})"
+        return pats, used, filt
+
+    for trial in range(25):
+        opats, oused, ofilt = rand_bgp(None)
+        share = rng.choice(oused) if oused and rng.random() < 0.8 else None
+        ipats, iused, ifilt = rand_bgp(share)
+        # project a random nonempty subset (hidden vars exercise renaming;
+        # keep the shared var so the join isn't cartesian)
+        proj = sorted(
+            set(rng.sample(iused, rng.randrange(1, len(iused) + 1)))
+            | ({share} if share else set())
+        )
+        sub = f"{{ SELECT {' '.join(proj)} WHERE {{ {' '.join(ipats)} {ifilt} }} }}"
+        sel_vars = sorted(set(oused) | set(proj))
+        q = (
+            f"SELECT {' '.join(sel_vars)} WHERE "
+            f"{{ {' '.join(opats)} {ofilt} {sub} }}"
+        )
+
+        with mock.patch.object(sqmod, "inline_subqueries", lambda w: w):
+            db.execution_mode = "host"
+            legacy = execute_query_volcano(q, db)
+        db.execution_mode = "host"
+        host = execute_query_volcano(q, db)
+        db.execution_mode = "device"
+        dev = execute_query_volcano(q, db)
+        db.execution_mode = "host"
+        assert sorted(host) == sorted(legacy), (trial, q, len(host), len(legacy))
+        assert sorted(dev) == sorted(legacy), (trial, q, len(dev), len(legacy))
