@@ -121,6 +121,19 @@ class JoinSpec:
 
 
 @dataclass(frozen=True)
+class AntiJoinSpec:
+    """MINUS / query-NAF: keep ``left`` rows with NO ``right`` match on the
+    shared variables (host twin ``ops/join.py::anti_join_tables``).  Output
+    columns/capacity are the left child's; the membership test is one sort
+    + searchsorted over the right keys — validity only shrinks, so no
+    capacity of its own to converge."""
+
+    left: object
+    right: object
+    key_vars: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
 class FilterSpec:
     child: object
     expr: object
@@ -384,6 +397,22 @@ def _plan_body(
             mask = eval_expr(node.expr, cols, valid)
             valid = valid & mask
             return cols, valid, jnp.sum(valid)
+        if isinstance(node, AntiJoinSpec):
+            lcols, lvalid, _ = eval_node(node.left)
+            rcols, rvalid, _ = eval_node(node.right)
+            lc = [lcols[v] for v in node.key_vars]
+            rc = [rcols[v] for v in node.key_vars]
+            if len(node.key_vars) > 2:
+                from kolibrie_tpu.ops.device_join import pack_key_multi
+
+                lkey, rkey = pack_key_multi(lc, rc, lvalid, rvalid)
+            else:
+                lkey = _pack_key(lc, lvalid, _LPAD)
+                rkey = _pack_key(rc, rvalid, _RPAD)
+            rs = jnp.sort(rkey)
+            pos = jnp.clip(jnp.searchsorted(rs, lkey), 0, rs.shape[0] - 1)
+            valid = lvalid & (rs[pos] != lkey)
+            return lcols, valid, jnp.sum(valid)
         raise TypeError(f"unknown plan spec node {node!r}")
 
     cols, valid, _ = eval_node(spec.root)
@@ -457,7 +486,7 @@ class LoweredPlan:
     host :data:`BindingTable` identical to the numpy engine's output.
     """
 
-    def __init__(self, db, plan):
+    def __init__(self, db, plan, anti_plans=()):
         self.db = db
         self.scan_descs: List[tuple] = []  # (order_name, (cs, cp, co)) per scan
         self.mask_arrays: List[np.ndarray] = []
@@ -479,6 +508,19 @@ class LoweredPlan:
         self.root, vars_ = self._lower(plan)
         if self.root is None:
             raise Unsupported("constant-only query")
+        # MINUS / query-NAF branches compose as anti-joins over the main
+        # tree (host post-pass twin: executor's anti_join_tables loop)
+        for bplan in anti_plans:
+            n_checks = len(self.const_checks)
+            broot, bvars = self._lower(bplan)
+            if len(self.const_checks) != n_checks or broot is None:
+                # a branch-local constant guard gates only the BRANCH, not
+                # the query; const_ok() can't express that — fall back
+                raise Unsupported("constant pattern in MINUS/NOT branch")
+            shared = tuple(sorted(bvars & vars_))
+            if not shared:
+                continue  # disjoint domains: MINUS removes nothing
+            self.root = AntiJoinSpec(self.root, broot, shared)
         self.out_vars = tuple(sorted(vars_))
         if not self.out_vars:
             raise Unsupported("no output variables")
@@ -498,7 +540,7 @@ class LoweredPlan:
             if isinstance(node, ScanSpec):
                 if node.order_idx not in used:
                     used.append(node.order_idx)
-            elif isinstance(node, JoinSpec):
+            elif isinstance(node, (JoinSpec, AntiJoinSpec)):
                 collect(node.left)
                 collect(node.right)
             elif isinstance(node, (FilterSpec, QuotedExpandSpec)):
@@ -540,6 +582,10 @@ class LoweredPlan:
                     node.out_vars,
                     node.const_checks,
                     node.eq_checks,
+                )
+            if isinstance(node, AntiJoinSpec):
+                return AntiJoinSpec(
+                    rebuild(node.left), rebuild(node.right), node.key_vars
                 )
             return node
 
@@ -960,6 +1006,12 @@ class LoweredPlan:
                 node.const_checks,
                 node.eq_checks,
             )
+        if isinstance(node, AntiJoinSpec):
+            return AntiJoinSpec(
+                self._with_caps(node.left, scan_caps, join_caps),
+                self._with_caps(node.right, scan_caps, join_caps),
+                node.key_vars,
+            )
         return node
 
     def _node_cap(self, node, scan_caps, join_caps) -> int:
@@ -969,6 +1021,8 @@ class LoweredPlan:
             return join_caps[node.join_idx]
         if isinstance(node, (FilterSpec, QuotedExpandSpec)):
             return self._node_cap(node.child, scan_caps, join_caps)
+        if isinstance(node, AntiJoinSpec):
+            return self._node_cap(node.left, scan_caps, join_caps)
         if isinstance(node, ValuesSpec):
             return node.n
         raise TypeError(node)
@@ -986,6 +1040,12 @@ class LoweredPlan:
                 cap = _round_cap(2 * max(ln, rn))
                 caps[node.join_idx] = cap
                 return cap
+            if isinstance(node, AntiJoinSpec):
+                ln = walk(node.left)
+                walk(node.right)  # fills the branch's own join caps
+                return ln
+            if isinstance(node, (FilterSpec, QuotedExpandSpec)):
+                return walk(node.child)  # fill caps of joins under wrappers
             return self._node_cap(node, scan_caps, caps)
 
         walk(self.root)
@@ -1161,6 +1221,12 @@ class LoweredPlan:
                 for ipos, var in node.eq_checks:
                     mask = mask & (inner[ipos] == cols[var])
                 return {k: v[mask] for k, v in cols.items()}
+            if isinstance(node, AntiJoinSpec):
+                from kolibrie_tpu.ops.join import anti_join_tables
+
+                lcols = eval_node(node.left)
+                rcols = eval_node(node.right)
+                return anti_join_tables(lcols, rcols)
             raise TypeError(node)
 
         table = eval_node(self.root)
@@ -1267,6 +1333,13 @@ class LoweredPlan:
                 )
                 walk(node.left, depth + 1)
                 walk(node.right, depth + 1)
+            elif isinstance(node, AntiJoinSpec):
+                lines.append(
+                    f"{pad}anti-join (MINUS/NOT) on"
+                    f" ({', '.join(node.key_vars)})"
+                )
+                walk(node.left, depth + 1)
+                walk(node.right, depth + 1)
             elif isinstance(node, FilterSpec):
                 lines.append(f"{pad}filter {node.expr}")
                 walk(node.child, depth + 1)
@@ -1369,14 +1442,18 @@ def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:
     return m & ~np.isnan(vals)
 
 
-def lower_plan(db, plan) -> LoweredPlan:
-    return LoweredPlan(db, plan)
+def lower_plan(db, plan, anti_plans=()) -> LoweredPlan:
+    return LoweredPlan(db, plan, anti_plans)
 
 
-def try_device_execute(db, plan) -> Optional[BindingTable]:
-    """Device path if the plan is expressible, else ``None`` (host fallback)."""
+def try_device_execute(db, plan, anti_plans=()) -> Optional[BindingTable]:
+    """Device path if the plan is expressible, else ``None`` (host fallback).
+
+    ``anti_plans``: physical plans of MINUS / NOT-block branches, composed
+    as device anti-joins over the main tree (one program for the whole
+    group pattern)."""
     try:
-        lowered = lower_plan(db, plan)
+        lowered = lower_plan(db, plan, anti_plans)
     except Unsupported:
         return None
     return lowered.execute()

@@ -97,13 +97,13 @@ def eval_where(
     post_bind_filters = [
         f for f in where.filters if set(_filter_vars(f)) & bind_vars
     ]
+    fused_anti = False
     if use_optimizer:
+        planner = Streamertail(db.get_or_build_stats())
         if prebuilt_plan is not None:
             plan = prebuilt_plan
         else:
             logical = build_logical_plan(resolved, plan_filters, [], where.values)
-            stats = db.get_or_build_stats()
-            planner = Streamertail(stats)
             plan = planner.find_best_plan(logical)
         table = None
         if prebuilt_lowered is not None and prebuilt_lowered is not False:
@@ -111,7 +111,28 @@ def eval_where(
         elif prebuilt_lowered is None and _device_routed(db):
             from kolibrie_tpu.optimizer.device_engine import try_device_execute
 
-            table = try_device_execute(db, plan)
+            # MINUS / NOT blocks fuse into the device program as anti-joins
+            # when nothing (union/optional/subquery joins) would otherwise
+            # run between the BGP and the anti pass
+            anti_plans = []
+            if (where.minus or where.not_blocks) and not (
+                where.subqueries or where.unions or where.optionals
+            ):
+                branches = list(where.minus) + [
+                    WhereClause(patterns=nb.patterns)
+                    for nb in where.not_blocks
+                ]
+                for bw in branches:
+                    bplan = _branch_plan(db, planner, bw)
+                    if bplan is None:
+                        anti_plans = []
+                        break
+                    anti_plans.append(bplan)
+            if anti_plans:
+                table = try_device_execute(db, plan, tuple(anti_plans))
+                fused_anti = table is not None
+            if table is None:
+                table = try_device_execute(db, plan)
         if table is None:
             table = engine.execute_with_ids(plan)
     else:
@@ -151,12 +172,15 @@ def eval_where(
         else:
             table = left_outer_join_tables(table, opt_table)
     # MINUS
-    for m in where.minus:
-        table = anti_join_tables(table, eval_where(db, m, use_optimizer))
-    # NOT blocks (NAF)
-    for nb in where.not_blocks:
-        neg_where = WhereClause(patterns=nb.patterns)
-        table = anti_join_tables(table, eval_where(db, neg_where, use_optimizer))
+    if not fused_anti:
+        for m in where.minus:
+            table = anti_join_tables(table, eval_where(db, m, use_optimizer))
+        # NOT blocks (NAF)
+        for nb in where.not_blocks:
+            neg_where = WhereClause(patterns=nb.patterns)
+            table = anti_join_tables(
+                table, eval_where(db, neg_where, use_optimizer)
+            )
     # BINDs after joins (may reference any bound variable)
     for b in where.binds:
         col = engine.eval_arith_to_ids(b.expr, table)
@@ -167,6 +191,30 @@ def eval_where(
         mask = engine.eval_filter(f, table)
         table = {k: v[mask] for k, v in table.items()}
     return table
+
+
+def _branch_plan(db, planner, bw: WhereClause):
+    """Physical plan for a MINUS / NOT-block branch eligible to fuse into
+    the device program as an anti-join; ``None`` when the branch needs the
+    host post-pass (non-BGP content)."""
+    from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+    bw = inline_subqueries(bw)
+    if (
+        not bw.patterns
+        or bw.binds
+        or bw.values is not None
+        or bw.subqueries
+        or bw.not_blocks
+        or bw.window_blocks
+        or bw.optionals
+        or bw.unions
+        or bw.minus
+    ):
+        return None
+    bres = [resolve_pattern(db, p) for p in bw.patterns]
+    blogical = build_logical_plan(bres, list(bw.filters), [], None)
+    return planner.find_best_plan(blogical)
 
 
 def _filter_vars(expr) -> List[str]:

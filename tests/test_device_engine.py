@@ -855,3 +855,149 @@ def test_string_order_by_device_topk():
         dev = try_device_execute_ordered(db, parse_sparql_query(q))
         assert dev is not None, q
         assert dev == host, q
+
+
+# ---------------------------------------------------------------------------
+# MINUS / NOT blocks fused as device anti-joins (round 4)
+# ---------------------------------------------------------------------------
+
+
+def _lowers_with_anti(db, query):
+    """The fused lowering must succeed for these shapes (proves the device
+    path, not the host post-pass, serves the query)."""
+    from kolibrie_tpu.optimizer.engine import resolve_pattern
+    from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
+    from kolibrie_tpu.query.executor import _branch_plan
+    from kolibrie_tpu.query.parser import parse_combined_query
+    from kolibrie_tpu.query.ast import WhereClause
+
+    db.register_prefixes_from_query(query)
+    w = parse_combined_query(query, db.prefixes).select.where
+    planner = Streamertail(db.get_or_build_stats())
+    resolved = [resolve_pattern(db, p) for p in w.patterns]
+    logical = build_logical_plan(resolved, list(w.filters), [], w.values)
+    plan = planner.find_best_plan(logical)
+    branches = list(w.minus) + [
+        WhereClause(patterns=nb.patterns) for nb in w.not_blocks
+    ]
+    anti = [_branch_plan(db, planner, b) for b in branches]
+    assert all(a is not None for a in anti)
+    return lower_plan(db, plan, tuple(anti))
+
+
+def test_minus_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?e ex:dept "dept0" }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) == 400
+    assert sorted(dev) == sorted(host)
+    lowered = _lowers_with_anti(db, q)
+    assert "anti-join" in lowered.describe()
+
+
+def test_minus_with_branch_filter_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?w WHERE {
+        ?e foaf:workplaceHomepage ?w
+        MINUS { ?e ex:salary ?s . FILTER(?s > 60000) }
+    }"""
+    dev, host = run_both(db, q)
+    assert 0 < len(host) < 500
+    assert sorted(dev) == sorted(host)
+    _lowers_with_anti(db, q)
+
+
+def test_not_block_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        NOT { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    assert 0 < len(host) < 500
+    assert sorted(dev) == sorted(host)
+    _lowers_with_anti(db, q)
+
+
+def test_minus_disjoint_domains_removes_nothing():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?a ex:dept "dept0" }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(dev) == 500
+    assert sorted(dev) == sorted(host)
+
+
+def test_minus_and_not_stack():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?e ex:dept "dept1" }
+        NOT { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    assert 0 < len(host) < 500
+    assert sorted(dev) == sorted(host)
+    _lowers_with_anti(db, q)
+
+
+def test_minus_fuzz_agreement():
+    """Random BGP + random MINUS/NOT branches: device vs host."""
+    import random
+
+    rng = random.Random(20260732)
+    db = SparqlDatabase()
+    lines = []
+    preds = [f"<http://f.e/p{k}>" for k in range(4)]
+    for i in range(400):
+        s = f"<http://f.e/s{rng.randrange(60)}>"
+        pr = rng.choice(preds)
+        if rng.random() < 0.5:
+            o = f"<http://f.e/s{rng.randrange(60)}>"
+        else:
+            o = f'"{rng.randrange(0, 3000)}"'
+        lines.append(f"{s} {pr} {o} .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+
+    vars_pool = ["?a", "?b", "?c"]
+    for trial in range(20):
+        n_pat = rng.randrange(1, 3)
+        pats, used = [], []
+        for _ in range(n_pat):
+            s = (
+                rng.choice(used)
+                if used and rng.random() < 0.8
+                else rng.choice(vars_pool)
+            )
+            o = rng.choice(vars_pool + [f"<http://f.e/s{rng.randrange(60)}>"])
+            pats.append(f"{s} {rng.choice(preds)} {o} .")
+            for t in (s, o):
+                if t.startswith("?") and t not in used:
+                    used.append(t)
+        bs = rng.choice(used) if rng.random() < 0.9 else "?z"
+        bo = rng.choice(vars_pool + [f"<http://f.e/s{rng.randrange(60)}>"])
+        bfilt = ""
+        if rng.random() < 0.4 and bo.startswith("?"):
+            bfilt = f"FILTER({bo} > {rng.randrange(0, 3000)})"
+        kw = "MINUS" if rng.random() < 0.5 else "NOT"
+        branch = f"{kw} {{ {bs} {rng.choice(preds)} {bo} . {bfilt} }}"
+        if kw == "NOT" and bfilt:
+            branch = f"NOT {{ {bs} {rng.choice(preds)} {bo} }}"
+        sel = " ".join(used)
+        q = f"SELECT {sel} WHERE {{ {' '.join(pats)} {branch} }}"
+        try:
+            dev, host = run_both(db, q)
+        except Exception as e:
+            raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
+        assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
