@@ -145,16 +145,29 @@ class QueryEngine:
 
         self.db.register_prefixes_from_query(sparql)
         q = parse_sparql_query(sparql, self.db.prefixes)
-        w = q.where
+        from kolibrie_tpu.query.executor import _branch_plan
+        from kolibrie_tpu.query.subquery_inline import inline_subqueries
+        from kolibrie_tpu.query.ast import WhereClause
+
+        w = inline_subqueries(q.where)
         resolved = [resolve_pattern(self.db, p) for p in w.patterns]
         logical = build_logical_plan(
             resolved, list(w.filters), [], w.values
         )
-        plan = Streamertail(self.db.get_or_build_stats()).find_best_plan(
-            logical
-        )
+        planner = Streamertail(self.db.get_or_build_stats())
+        plan = planner.find_best_plan(logical)
+        anti_plans = []
+        if (w.minus or w.not_blocks) and not (
+            w.subqueries or w.unions or w.optionals
+        ):
+            branches = list(w.minus) + [
+                WhereClause(patterns=nb.patterns) for nb in w.not_blocks
+            ]
+            anti_plans = [_branch_plan(self.db, planner, b) for b in branches]
+            if any(a is None for a in anti_plans):
+                anti_plans = []
         try:
-            lowered = lower_plan(self.db, plan)
+            lowered = lower_plan(self.db, plan, tuple(anti_plans))
         except Unsupported as e:
             return f"host path: {e}"
         counts = lowered.calibrate_host() if exact_counts else None
