@@ -121,6 +121,33 @@ class JoinSpec:
 
 
 @dataclass(frozen=True)
+class UnionSpec:
+    """UNION group: concatenation of branch tables over the union of their
+    variables, a branch's missing columns filled with the UNBOUND (0)
+    sentinel (host twin: the executor's branch-normalize + concat).
+    Capacity = sum of branch capacities; joins into the main tree like any
+    other table node."""
+
+    children: Tuple[object, ...]
+    vars: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class LeftOuterSpec:
+    """OPTIONAL: matches of left⋈right plus unmatched left rows with
+    UNBOUND right-only columns (host twin ``ops/join.py::
+    left_outer_join_tables``).  Carries a join capacity for the matching
+    part (validated by the shared convergence protocol); output capacity =
+    join cap + left capacity."""
+
+    left: object
+    right: object
+    key_vars: Tuple[str, ...]
+    join_idx: int
+    cap: int
+
+
+@dataclass(frozen=True)
 class AntiJoinSpec:
     """MINUS / query-NAF: keep ``left`` rows with NO ``right`` match on the
     shared variables (host twin ``ops/join.py::anti_join_tables``).  Output
@@ -413,6 +440,51 @@ def _plan_body(
             pos = jnp.clip(jnp.searchsorted(rs, lkey), 0, rs.shape[0] - 1)
             valid = lvalid & (rs[pos] != lkey)
             return lcols, valid, jnp.sum(valid)
+        if isinstance(node, UnionSpec):
+            parts = [eval_node(ch) for ch in node.children]
+            cols = {}
+            for v in node.vars:
+                segs = []
+                for ccols, cvalid, _ in parts:
+                    if v in ccols:
+                        segs.append(ccols[v])
+                    else:  # branch doesn't bind v: UNBOUND (0) fill
+                        segs.append(
+                            jnp.zeros(cvalid.shape[0], dtype=jnp.uint32)
+                        )
+                cols[v] = jnp.concatenate(segs)
+            valid = jnp.concatenate([p[1] for p in parts])
+            return cols, valid, jnp.sum(valid)
+        if isinstance(node, LeftOuterSpec):
+            lcols, lvalid, _ = eval_node(node.left)
+            rcols, rvalid, _ = eval_node(node.right)
+            lc = [lcols[v] for v in node.key_vars]
+            rc = [rcols[v] for v in node.key_vars]
+            if len(node.key_vars) > 2:
+                from kolibrie_tpu.ops.device_join import pack_key_multi
+
+                lkey, rkey = pack_key_multi(lc, rc, lvalid, rvalid)
+            else:
+                lkey = _pack_key(lc, lvalid, _LPAD)
+                rkey = _pack_key(rc, rvalid, _RPAD)
+            li, ri, mvalid, total = join_indices(lkey, rkey, node.cap)
+            counts.append(total)
+            rs = jnp.sort(rkey)
+            pos = jnp.clip(jnp.searchsorted(rs, lkey), 0, rs.shape[0] - 1)
+            keep = lvalid & (rs[pos] != lkey)  # unmatched left rows
+            out = {}
+            for v, c in lcols.items():
+                out[v] = jnp.concatenate([jnp.where(mvalid, c[li], 0), c])
+            for v, c in rcols.items():
+                if v not in out:  # right-only: UNBOUND on the kept side
+                    out[v] = jnp.concatenate(
+                        [
+                            jnp.where(mvalid, c[ri], 0),
+                            jnp.zeros(lvalid.shape[0], dtype=jnp.uint32),
+                        ]
+                    )
+            valid = jnp.concatenate([mvalid, keep])
+            return out, valid, jnp.sum(valid)
         raise TypeError(f"unknown plan spec node {node!r}")
 
     cols, valid, _ = eval_node(spec.root)
@@ -486,7 +558,7 @@ class LoweredPlan:
     host :data:`BindingTable` identical to the numpy engine's output.
     """
 
-    def __init__(self, db, plan, anti_plans=()):
+    def __init__(self, db, plan, anti_plans=(), union_groups=(), optional_plans=()):
         self.db = db
         self.scan_descs: List[tuple] = []  # (order_name, (cs, cp, co)) per scan
         self.mask_arrays: List[np.ndarray] = []
@@ -508,15 +580,43 @@ class LoweredPlan:
         self.root, vars_ = self._lower(plan)
         if self.root is None:
             raise Unsupported("constant-only query")
-        # MINUS / query-NAF branches compose as anti-joins over the main
-        # tree (host post-pass twin: executor's anti_join_tables loop)
-        for bplan in anti_plans:
+
+        def _lower_branch(bplan, kind):
             n_checks = len(self.const_checks)
             broot, bvars = self._lower(bplan)
             if len(self.const_checks) != n_checks or broot is None:
                 # a branch-local constant guard gates only the BRANCH, not
                 # the query; const_ok() can't express that — fall back
-                raise Unsupported("constant pattern in MINUS/NOT branch")
+                raise Unsupported(f"constant pattern in {kind} branch")
+            return broot, bvars
+
+        # post-pass clauses compose over the main tree in the executor's
+        # order — UNION joins, then OPTIONAL left-outers, then MINUS/NOT
+        # anti-joins — so the whole group pattern is ONE device program
+        for group in union_groups:
+            children, all_vars = [], set()
+            for bplan in group:
+                broot, bvars = _lower_branch(bplan, "UNION")
+                children.append(broot)
+                all_vars |= bvars
+            uspec = UnionSpec(tuple(children), tuple(sorted(all_vars)))
+            self.root, vars_ = self._make_join(
+                self.root, vars_, uspec, all_vars
+            )
+        for bplan in optional_plans:
+            broot, bvars = _lower_branch(bplan, "OPTIONAL")
+            shared = tuple(sorted(bvars & vars_))
+            if not shared:
+                raise Unsupported("OPTIONAL with no shared variables")
+            self.root = LeftOuterSpec(
+                self.root, broot, shared, self.join_count, 0
+            )
+            self.join_count += 1
+            vars_ = vars_ | bvars
+        # MINUS / query-NAF branches compose as anti-joins over the main
+        # tree (host post-pass twin: executor's anti_join_tables loop)
+        for bplan in anti_plans:
+            broot, bvars = _lower_branch(bplan, "MINUS/NOT")
             shared = tuple(sorted(bvars & vars_))
             if not shared:
                 continue  # disjoint domains: MINUS removes nothing
@@ -540,11 +640,14 @@ class LoweredPlan:
             if isinstance(node, ScanSpec):
                 if node.order_idx not in used:
                     used.append(node.order_idx)
-            elif isinstance(node, (JoinSpec, AntiJoinSpec)):
+            elif isinstance(node, (JoinSpec, AntiJoinSpec, LeftOuterSpec)):
                 collect(node.left)
                 collect(node.right)
             elif isinstance(node, (FilterSpec, QuotedExpandSpec)):
                 collect(node.child)
+            elif isinstance(node, UnionSpec):
+                for ch in node.children:
+                    collect(ch)
 
         collect(self.root)
         remap = {old: new for new, old in enumerate(sorted(used))}
@@ -586,6 +689,18 @@ class LoweredPlan:
             if isinstance(node, AntiJoinSpec):
                 return AntiJoinSpec(
                     rebuild(node.left), rebuild(node.right), node.key_vars
+                )
+            if isinstance(node, LeftOuterSpec):
+                return LeftOuterSpec(
+                    rebuild(node.left),
+                    rebuild(node.right),
+                    node.key_vars,
+                    node.join_idx,
+                    node.cap,
+                )
+            if isinstance(node, UnionSpec):
+                return UnionSpec(
+                    tuple(rebuild(ch) for ch in node.children), node.vars
                 )
             return node
 
@@ -1012,6 +1127,22 @@ class LoweredPlan:
                 self._with_caps(node.right, scan_caps, join_caps),
                 node.key_vars,
             )
+        if isinstance(node, LeftOuterSpec):
+            return LeftOuterSpec(
+                self._with_caps(node.left, scan_caps, join_caps),
+                self._with_caps(node.right, scan_caps, join_caps),
+                node.key_vars,
+                node.join_idx,
+                join_caps[node.join_idx],
+            )
+        if isinstance(node, UnionSpec):
+            return UnionSpec(
+                tuple(
+                    self._with_caps(ch, scan_caps, join_caps)
+                    for ch in node.children
+                ),
+                node.vars,
+            )
         return node
 
     def _node_cap(self, node, scan_caps, join_caps) -> int:
@@ -1023,6 +1154,15 @@ class LoweredPlan:
             return self._node_cap(node.child, scan_caps, join_caps)
         if isinstance(node, AntiJoinSpec):
             return self._node_cap(node.left, scan_caps, join_caps)
+        if isinstance(node, LeftOuterSpec):
+            return join_caps[node.join_idx] + self._node_cap(
+                node.left, scan_caps, join_caps
+            )
+        if isinstance(node, UnionSpec):
+            return sum(
+                self._node_cap(ch, scan_caps, join_caps)
+                for ch in node.children
+            )
         if isinstance(node, ValuesSpec):
             return node.n
         raise TypeError(node)
@@ -1044,6 +1184,14 @@ class LoweredPlan:
                 ln = walk(node.left)
                 walk(node.right)  # fills the branch's own join caps
                 return ln
+            if isinstance(node, LeftOuterSpec):
+                ln = walk(node.left)
+                rn = walk(node.right)
+                cap = _round_cap(2 * max(ln, rn))
+                caps[node.join_idx] = cap
+                return cap + ln
+            if isinstance(node, UnionSpec):
+                return sum(walk(ch) for ch in node.children)
             if isinstance(node, (FilterSpec, QuotedExpandSpec)):
                 return walk(node.child)  # fill caps of joins under wrappers
             return self._node_cap(node, scan_caps, caps)
@@ -1227,6 +1375,53 @@ class LoweredPlan:
                 lcols = eval_node(node.left)
                 rcols = eval_node(node.right)
                 return anti_join_tables(lcols, rcols)
+            if isinstance(node, UnionSpec):
+                parts = [eval_node(ch) for ch in node.children]
+                out = {}
+                for v in node.vars:
+                    segs = []
+                    for ccols in parts:
+                        if v in ccols:
+                            segs.append(ccols[v])
+                        else:
+                            n = len(next(iter(ccols.values()), np.empty(0)))
+                            segs.append(np.zeros(n, dtype=np.uint32))
+                    out[v] = np.concatenate(segs) if segs else np.empty(0, np.uint32)
+                return out
+            if isinstance(node, LeftOuterSpec):
+                from kolibrie_tpu.ops.join import _pack_shared_keys
+
+                lcols = eval_node(node.left)
+                rcols = eval_node(node.right)
+                ln = len(next(iter(lcols.values())))
+                rn = len(next(iter(rcols.values())))
+                if ln == 0 or rn == 0:
+                    counts[node.join_idx] = 0
+                    out = {k: v.copy() for k, v in lcols.items()}
+                    for k in rcols:
+                        if k not in out:
+                            out[k] = np.zeros(ln, dtype=np.uint32)
+                    return out
+                lkey, rkey = _pack_shared_keys(
+                    lcols, rcols, list(node.key_vars), ln
+                )
+                li, ri = host_join_indices(lkey, rkey)
+                counts[node.join_idx] = len(li)
+                matched = np.zeros(ln, dtype=bool)
+                matched[li] = True
+                unmatched = np.nonzero(~matched)[0]
+                out = {}
+                for k, col in lcols.items():
+                    out[k] = np.concatenate([col[li], col[unmatched]])
+                for k, col in rcols.items():
+                    if k not in out:
+                        out[k] = np.concatenate(
+                            [
+                                col[ri],
+                                np.zeros(len(unmatched), dtype=np.uint32),
+                            ]
+                        )
+                return out
             raise TypeError(node)
 
         table = eval_node(self.root)
@@ -1340,6 +1535,24 @@ class LoweredPlan:
                 )
                 walk(node.left, depth + 1)
                 walk(node.right, depth + 1)
+            elif isinstance(node, LeftOuterSpec):
+                cnt = (
+                    f" matched={counts[node.join_idx]}"
+                    if counts is not None and node.join_idx < len(counts)
+                    else ""
+                )
+                lines.append(
+                    f"{pad}left-outer-join (OPTIONAL) on"
+                    f" ({', '.join(node.key_vars)}){cnt}"
+                )
+                walk(node.left, depth + 1)
+                walk(node.right, depth + 1)
+            elif isinstance(node, UnionSpec):
+                lines.append(
+                    f"{pad}union -> ({', '.join(node.vars)})"
+                )
+                for ch in node.children:
+                    walk(ch, depth + 1)
             elif isinstance(node, FilterSpec):
                 lines.append(f"{pad}filter {node.expr}")
                 walk(node.child, depth + 1)
@@ -1442,18 +1655,22 @@ def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:
     return m & ~np.isnan(vals)
 
 
-def lower_plan(db, plan, anti_plans=()) -> LoweredPlan:
-    return LoweredPlan(db, plan, anti_plans)
+def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> LoweredPlan:
+    return LoweredPlan(db, plan, anti_plans, union_groups, optional_plans)
 
 
-def try_device_execute(db, plan, anti_plans=()) -> Optional[BindingTable]:
+def try_device_execute(
+    db, plan, anti_plans=(), union_groups=(), optional_plans=()
+) -> Optional[BindingTable]:
     """Device path if the plan is expressible, else ``None`` (host fallback).
 
-    ``anti_plans``: physical plans of MINUS / NOT-block branches, composed
-    as device anti-joins over the main tree (one program for the whole
-    group pattern)."""
+    ``anti_plans``: physical plans of MINUS / NOT-block branches (device
+    anti-joins); ``union_groups``: per-UNION-group tuples of branch plans
+    (device concat + join); ``optional_plans``: OPTIONAL branch plans
+    (device left-outer joins).  All compose over the main tree in the host
+    post-pass order, so the whole group pattern is one device program."""
     try:
-        lowered = lower_plan(db, plan, anti_plans)
+        lowered = lower_plan(db, plan, anti_plans, union_groups, optional_plans)
     except Unsupported:
         return None
     return lowered.execute()

@@ -97,7 +97,7 @@ def eval_where(
     post_bind_filters = [
         f for f in where.filters if set(_filter_vars(f)) & bind_vars
     ]
-    fused_anti = False
+    fused_clauses = False
     if use_optimizer:
         planner = Streamertail(db.get_or_build_stats())
         if prebuilt_plan is not None:
@@ -111,26 +111,52 @@ def eval_where(
         elif prebuilt_lowered is None and _device_routed(db):
             from kolibrie_tpu.optimizer.device_engine import try_device_execute
 
-            # MINUS / NOT blocks fuse into the device program as anti-joins
-            # when nothing (union/optional/subquery joins) would otherwise
-            # run between the BGP and the anti pass
-            anti_plans = []
-            if (where.minus or where.not_blocks) and not (
-                where.subqueries or where.unions or where.optionals
-            ):
+            # UNION / OPTIONAL / MINUS / NOT clauses fuse into the device
+            # program (union concat, left-outer join, anti-join) in the
+            # same order the host post-passes apply them.  All-or-nothing:
+            # a single non-BGP branch keeps everything on the post-pass
+            # path so clause ordering semantics never split across engines.
+            union_groups: List[tuple] = []
+            optional_plans: List[object] = []
+            anti_plans: List[object] = []
+            fusable = not where.subqueries and (
+                where.minus
+                or where.not_blocks
+                or where.unions
+                or where.optionals
+            )
+            if fusable:
+                for groups in where.unions:
+                    g = [_branch_plan(db, planner, bw) for bw in groups]
+                    if any(bp is None for bp in g):
+                        fusable = False
+                        break
+                    union_groups.append(tuple(g))
+                for ow in where.optionals if fusable else ():
+                    bp = _branch_plan(db, planner, ow)
+                    if bp is None:
+                        fusable = False
+                        break
+                    optional_plans.append(bp)
                 branches = list(where.minus) + [
                     WhereClause(patterns=nb.patterns)
                     for nb in where.not_blocks
                 ]
-                for bw in branches:
-                    bplan = _branch_plan(db, planner, bw)
-                    if bplan is None:
-                        anti_plans = []
+                for bw in branches if fusable else ():
+                    bp = _branch_plan(db, planner, bw)
+                    if bp is None:
+                        fusable = False
                         break
-                    anti_plans.append(bplan)
-            if anti_plans:
-                table = try_device_execute(db, plan, tuple(anti_plans))
-                fused_anti = table is not None
+                    anti_plans.append(bp)
+            if fusable:
+                table = try_device_execute(
+                    db,
+                    plan,
+                    tuple(anti_plans),
+                    tuple(union_groups),
+                    tuple(optional_plans),
+                )
+                fused_clauses = table is not None
             if table is None:
                 table = try_device_execute(db, plan)
         if table is None:
@@ -142,7 +168,7 @@ def eval_where(
         sub = eval_select_to_table(db, sq.query)
         table = equi_join_tables(table, sub)
     # UNION groups
-    for groups in where.unions:
+    for groups in () if fused_clauses else where.unions:
         parts = [eval_where(db, g, use_optimizer) for g in groups]
         keys = set()
         for t in parts:
@@ -159,7 +185,7 @@ def eval_where(
         table = equi_join_tables(table, union_table) if table_len(table) or where.patterns else union_table
     # OPTIONAL — over the unit table (no preceding clauses produced columns)
     # join(unit, optional) keeps the optional's solutions
-    for opt in where.optionals:
+    for opt in () if fused_clauses else where.optionals:
         opt_table = eval_where(db, opt, use_optimizer)
         if (
             not table
@@ -172,7 +198,7 @@ def eval_where(
         else:
             table = left_outer_join_tables(table, opt_table)
     # MINUS
-    if not fused_anti:
+    if not fused_clauses:
         for m in where.minus:
             table = anti_join_tables(table, eval_where(db, m, use_optimizer))
         # NOT blocks (NAF)

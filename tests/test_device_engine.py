@@ -1001,3 +1001,136 @@ def test_minus_fuzz_agreement():
         except Exception as e:
             raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
         assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
+
+
+# ---------------------------------------------------------------------------
+# UNION / OPTIONAL fused into the device program (round 4)
+# ---------------------------------------------------------------------------
+
+
+def test_union_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?x WHERE {
+        ?e ex:salary ?x
+        { ?e ex:dept "dept0" } UNION { ?e ex:dept "dept1" }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) == 200
+    assert sorted(dev) == sorted(host)
+
+
+def test_union_unbound_fill_agreement():
+    # branches bind DIFFERENT variables: the union table carries UNBOUND
+    # fills; join happens on the one genuinely shared var
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        { ?e ex:dept "dept2" } UNION { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) > 0
+    assert sorted(dev) == sorted(host)
+
+
+def test_optional_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s .
+        OPTIONAL { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    # every employee kept; knows-targets only where present
+    assert len(host) == 500
+    assert sorted(dev) == sorted(host)
+    blanks = [r for r in host if r[2] == ""]
+    assert 0 < len(blanks) < 500
+
+
+def test_optional_with_filter_branch_agreement():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?w ?s WHERE {
+        ?e foaf:workplaceHomepage ?w .
+        OPTIONAL { ?e ex:salary ?s . FILTER(?s > 70000) }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) == 500
+    assert sorted(dev) == sorted(host)
+
+
+def test_union_optional_minus_compose():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s
+        { ?e ex:dept "dept0" } UNION { ?e ex:dept "dept3" }
+        OPTIONAL { ?e ex:knows ?y }
+        MINUS { ?e foaf:workplaceHomepage <http://company0.example/> }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) > 0
+    assert sorted(dev) == sorted(host)
+
+
+def test_union_optional_fuzz_agreement():
+    """Random BGP + union/optional/minus tails: device vs host."""
+    import random
+
+    rng = random.Random(20260733)
+    db = SparqlDatabase()
+    lines = []
+    preds = [f"<http://f.e/p{k}>" for k in range(4)]
+    for i in range(400):
+        s = f"<http://f.e/s{rng.randrange(60)}>"
+        pr = rng.choice(preds)
+        if rng.random() < 0.5:
+            o = f"<http://f.e/s{rng.randrange(60)}>"
+        else:
+            o = f'"{rng.randrange(0, 3000)}"'
+        lines.append(f"{s} {pr} {o} .")
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+
+    vars_pool = ["?a", "?b", "?c"]
+    for trial in range(25):
+        pats, used = [], []
+        for _ in range(rng.randrange(1, 3)):
+            s = (
+                rng.choice(used)
+                if used and rng.random() < 0.8
+                else rng.choice(vars_pool)
+            )
+            o = rng.choice(vars_pool + [f"<http://f.e/s{rng.randrange(60)}>"])
+            pats.append(f"{s} {rng.choice(preds)} {o} .")
+            for t in (s, o):
+                if t.startswith("?") and t not in used:
+                    used.append(t)
+        share = rng.choice(used)
+        clauses = []
+        kind = rng.randrange(3)
+        if kind == 0:
+            b1 = f"{{ {share} {rng.choice(preds)} <http://f.e/s{rng.randrange(60)}> }}"
+            b2 = f"{{ {share} {rng.choice(preds)} ?u }}"
+            clauses.append(f"{b1} UNION {b2}")
+        elif kind == 1:
+            clauses.append(
+                f"OPTIONAL {{ {share} {rng.choice(preds)} ?v }}"
+            )
+        else:
+            clauses.append(
+                f"OPTIONAL {{ {share} {rng.choice(preds)} ?v }}"
+            )
+            clauses.append(
+                f"MINUS {{ {share} {rng.choice(preds)} "
+                f"<http://f.e/s{rng.randrange(60)}> }}"
+            )
+        sel = " ".join(used)
+        q = f"SELECT {sel} WHERE {{ {' '.join(pats)} {' '.join(clauses)} }}"
+        try:
+            dev, host = run_both(db, q)
+        except Exception as e:
+            raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
+        assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
