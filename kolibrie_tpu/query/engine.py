@@ -156,18 +156,39 @@ class QueryEngine:
         )
         planner = Streamertail(self.db.get_or_build_stats())
         plan = planner.find_best_plan(logical)
-        anti_plans = []
-        if (w.minus or w.not_blocks) and not (
-            w.subqueries or w.unions or w.optionals
-        ):
-            branches = list(w.minus) + [
-                WhereClause(patterns=nb.patterns) for nb in w.not_blocks
-            ]
-            anti_plans = [_branch_plan(self.db, planner, b) for b in branches]
-            if any(a is None for a in anti_plans):
-                anti_plans = []
+        union_groups, optional_plans, anti_plans = [], [], []
+        fusable = not w.subqueries
+        for groups in w.unions if fusable else ():
+            g = [_branch_plan(self.db, planner, bw) for bw in groups]
+            if any(bp is None for bp in g):
+                fusable = False
+                break
+            union_groups.append(tuple(g))
+        for ow in w.optionals if fusable else ():
+            bp = _branch_plan(self.db, planner, ow)
+            if bp is None:
+                fusable = False
+                break
+            optional_plans.append(bp)
+        branches = list(w.minus) + [
+            WhereClause(patterns=nb.patterns) for nb in w.not_blocks
+        ]
+        for bw in branches if fusable else ():
+            bp = _branch_plan(self.db, planner, bw)
+            if bp is None:
+                fusable = False
+                break
+            anti_plans.append(bp)
+        if not fusable:
+            union_groups, optional_plans, anti_plans = [], [], []
         try:
-            lowered = lower_plan(self.db, plan, tuple(anti_plans))
+            lowered = lower_plan(
+                self.db,
+                plan,
+                tuple(anti_plans),
+                tuple(union_groups),
+                tuple(optional_plans),
+            )
         except Unsupported as e:
             return f"host path: {e}"
         counts = lowered.calibrate_host() if exact_counts else None
