@@ -2081,16 +2081,10 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
         return None
     if any(i.kind != "var" for i in q.select) and not q.select_all():
         return None
-    w = q.where
-    if (
-        w.subqueries
-        or w.unions
-        or w.optionals
-        or w.minus
-        or w.binds
-        or w.not_blocks
-        or not w.patterns
-    ):
+    from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+    w = inline_subqueries(q.where)
+    if w.subqueries or w.binds or w.window_blocks or not w.patterns:
         return None
     # cheap shape checks BEFORE any planning (a rejected query would
     # otherwise pay the optimizer + lowering twice: here and again on the
@@ -2122,13 +2116,42 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
     resolved = [resolve_pattern(db, p) for p in w.patterns]
     try:
         logical = build_logical_plan(resolved, list(w.filters), [], w.values)
-        plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
-        lowered = lower_plan(db, plan)
+        planner = Streamertail(db.get_or_build_stats())
+        plan = planner.find_best_plan(logical)
+        # UNION/OPTIONAL/MINUS/NOT fuse exactly as on the unordered path
+        from kolibrie_tpu.query.ast import WhereClause as _WC
+        from kolibrie_tpu.query.executor import _branch_plan
+
+        union_groups, optional_plans, anti_plans = [], [], []
+        for groups in w.unions:
+            g = [_branch_plan(db, planner, bw) for bw in groups]
+            if any(bp is None for bp in g):
+                return None
+            union_groups.append(tuple(g))
+        for ow in w.optionals:
+            bp = _branch_plan(db, planner, ow)
+            if bp is None:
+                return None
+            optional_plans.append(bp)
+        for bw in list(w.minus) + [
+            _WC(patterns=nb.patterns) for nb in w.not_blocks
+        ]:
+            bp = _branch_plan(db, planner, bw)
+            if bp is None:
+                return None
+            anti_plans.append(bp)
+        lowered = lower_plan(
+            db, plan, tuple(anti_plans), tuple(union_groups), tuple(optional_plans)
+        )
         if not lowered.const_ok():
             return []  # a failed constant guard empties the result
     except Unsupported:
         return None
     out_vars = lowered.out_vars
+    if q.select_all():
+        # ``*`` covers branch-bound vars too; internal (renamed) vars stay
+        # hidden, matching table_header's convention
+        sel_vars = {v for v in out_vars if not v.startswith("__")}
     opos, descs = [], []
     for cond in q.order_by:
         if cond.expr.name not in out_vars:

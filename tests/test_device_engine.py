@@ -1134,3 +1134,44 @@ def test_union_optional_fuzz_agreement():
         except Exception as e:
             raise AssertionError(f"trial {trial}: {q!r} raised {e}") from e
         assert sorted(dev) == sorted(host), (trial, q, len(dev), len(host))
+
+
+def test_ordered_with_minus_and_optional():
+    """ORDER BY + LIMIT fast path fuses the round-4 clauses too."""
+    from kolibrie_tpu.optimizer.device_engine import try_device_execute_ordered
+    from kolibrie_tpu.query.parser import parse_sparql_query
+
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        OPTIONAL { ?e ex:knows ?y }
+        MINUS { ?e ex:dept "dept4" }
+    } ORDER BY DESC(?s) LIMIT 7"""
+    dev, host = run_both(db, q)
+    assert len(host) == 7
+    assert dev == host  # ordered: exact row order must match
+    db.register_prefixes_from_query(q)
+    parsed = parse_sparql_query(q, db.prefixes)
+    rows = try_device_execute_ordered(db, parsed)
+    assert rows is not None  # proves the fast path served it
+    assert rows == host
+
+
+def test_ordered_with_subquery():
+    from kolibrie_tpu.optimizer.device_engine import try_device_execute_ordered
+    from kolibrie_tpu.query.parser import parse_sparql_query
+
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s .
+        { SELECT ?e WHERE { ?e ex:dept "dept2" } }
+    } ORDER BY ?s LIMIT 5"""
+    dev, host = run_both(db, q)
+    assert len(host) == 5
+    assert dev == host
+    db.register_prefixes_from_query(q)
+    rows = try_device_execute_ordered(db, parse_sparql_query(q, db.prefixes))
+    assert rows is not None
+    assert rows == host
