@@ -621,6 +621,9 @@ class LoweredPlan:
             if not shared:
                 continue  # disjoint domains: MINUS removes nothing
             self.root = AntiJoinSpec(self.root, broot, shared)
+        # consumers that receive this object prebuilt need to know whether
+        # the union/optional/minus host post-passes are already inside it
+        self.fused_clauses = bool(anti_plans or union_groups or optional_plans)
         self.out_vars = tuple(sorted(vars_))
         if not self.out_vars:
             raise Unsupported("no output variables")

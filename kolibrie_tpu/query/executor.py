@@ -108,6 +108,7 @@ def eval_where(
         table = None
         if prebuilt_lowered is not None and prebuilt_lowered is not False:
             table = prebuilt_lowered.execute()
+            fused_clauses = getattr(prebuilt_lowered, "fused_clauses", False)
         elif prebuilt_lowered is None and _device_routed(db):
             from kolibrie_tpu.optimizer.device_engine import try_device_execute
 
@@ -353,15 +354,7 @@ def _try_device_aggregate(
     w = inline_subqueries(q.where)  # same fold eval_where applies (it is
     #                                 deterministic, so the plan built here
     #                                 matches the where eval_where sees)
-    if (
-        w.subqueries
-        or w.unions
-        or w.optionals
-        or w.minus
-        or w.binds
-        or w.not_blocks
-        or not w.patterns
-    ):
+    if w.subqueries or w.binds or w.window_blocks or not w.patterns:
         return None, None, None
     from kolibrie_tpu.optimizer.device_engine import (
         Unsupported,
@@ -371,10 +364,47 @@ def _try_device_aggregate(
 
     resolved = [resolve_pattern(db, p) for p in w.patterns]
     logical = build_logical_plan(resolved, list(w.filters), [], w.values)
-    plan = Streamertail(db.get_or_build_stats()).find_best_plan(logical)
+    planner = Streamertail(db.get_or_build_stats())
+    plan = planner.find_best_plan(logical)
+    # UNION/OPTIONAL/MINUS/NOT fuse under the aggregation exactly as on
+    # the plain path (all-or-nothing; ineligible branch → host post-pass,
+    # which also means host aggregation over the post-passed table)
+    union_groups, optional_plans, anti_plans = [], [], []
+    fusable = True
+    for groups in w.unions:
+        g = [_branch_plan(db, planner, bw) for bw in groups]
+        if any(bp is None for bp in g):
+            fusable = False
+            break
+        union_groups.append(tuple(g))
+    for ow in w.optionals if fusable else ():
+        bp = _branch_plan(db, planner, ow)
+        if bp is None:
+            fusable = False
+            break
+        optional_plans.append(bp)
+    for bw in (
+        list(w.minus) + [WhereClause(patterns=nb.patterns) for nb in w.not_blocks]
+        if fusable
+        else ()
+    ):
+        bp = _branch_plan(db, planner, bw)
+        if bp is None:
+            fusable = False
+            break
+        anti_plans.append(bp)
+    if not fusable and (w.unions or w.optionals or w.minus or w.not_blocks):
+        return None, None, None
     try:
-        lowered = lower_plan(db, plan)
+        lowered = lower_plan(
+            db, plan, tuple(anti_plans), tuple(union_groups), tuple(optional_plans)
+        )
     except Unsupported:
+        if anti_plans or union_groups or optional_plans:
+            try:  # the plain BGP may still lower even if a branch cannot
+                return None, plan, lower_plan(db, plan)
+            except Unsupported:
+                pass
         return None, plan, False
     return (
         try_device_execute_aggregated(db, plan, q, lowered=lowered),

@@ -1175,3 +1175,37 @@ def test_ordered_with_subquery():
     rows = try_device_execute_ordered(db, parse_sparql_query(q, db.prefixes))
     assert rows is not None
     assert rows == host
+
+
+def test_aggregate_over_union_minus_optional():
+    """GROUP BY aggregation fuses over the round-4 clauses (device segment
+    reduce over the fused table)."""
+    from kolibrie_tpu.query.executor import _try_device_aggregate
+    from kolibrie_tpu.query.parser import parse_sparql_query
+
+    db = employee_db()
+    cases = [
+        PREFIXES + """
+        SELECT ?d (COUNT(?e) AS ?c) WHERE {
+            ?e ex:dept ?d
+            { ?e ex:salary ?s } UNION { ?e ex:knows ?y }
+        } GROUP BY ?d""",
+        PREFIXES + """
+        SELECT ?d (COUNT(?y) AS ?c) WHERE {
+            ?e ex:dept ?d .
+            OPTIONAL { ?e ex:knows ?y }
+        } GROUP BY ?d""",
+        PREFIXES + """
+        SELECT ?d (COUNT(?e) AS ?c) WHERE {
+            ?e ex:dept ?d
+            MINUS { ?e ex:knows ?y }
+        } GROUP BY ?d""",
+    ]
+    for q in cases:
+        dev, host = run_both(db, q)
+        assert len(host) > 0, q
+        assert sorted(dev) == sorted(host), q
+        db.register_prefixes_from_query(q)
+        parsed = parse_sparql_query(q, db.prefixes)
+        table, _p, _l = _try_device_aggregate(db, parsed, True)
+        assert table is not None, q  # proves the device aggregate served it
