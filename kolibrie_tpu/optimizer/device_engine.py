@@ -577,9 +577,15 @@ class LoweredPlan:
         # (engine.rs:144-260 evaluates them as 0/1-row scans; here they
         # never cost a device op)
         self.const_checks: List[tuple] = []
-        self.root, vars_ = self._lower(plan)
-        if self.root is None:
-            raise Unsupported("constant-only query")
+        if plan is None:
+            # clause-only group (UNION/OPTIONAL with no main BGP): the
+            # first clause becomes the root (host twin: the executor's
+            # standalone union/optional special cases)
+            self.root, vars_ = None, set()
+        else:
+            self.root, vars_ = self._lower(plan)
+            if self.root is None:
+                raise Unsupported("constant-only query")
 
         def _lower_branch(bplan, kind):
             n_checks = len(self.const_checks)
@@ -605,6 +611,10 @@ class LoweredPlan:
             )
         for bplan in optional_plans:
             broot, bvars = _lower_branch(bplan, "OPTIONAL")
+            if self.root is None:
+                # leading OPTIONAL with no group: stands alone (host twin)
+                self.root, vars_ = broot, set(bvars)
+                continue
             shared = tuple(sorted(bvars & vars_))
             if not shared:
                 raise Unsupported("OPTIONAL with no shared variables")
@@ -616,11 +626,15 @@ class LoweredPlan:
         # MINUS / query-NAF branches compose as anti-joins over the main
         # tree (host post-pass twin: executor's anti_join_tables loop)
         for bplan in anti_plans:
+            if self.root is None:
+                raise Unsupported("MINUS without a group")
             broot, bvars = _lower_branch(bplan, "MINUS/NOT")
             shared = tuple(sorted(bvars & vars_))
             if not shared:
                 continue  # disjoint domains: MINUS removes nothing
             self.root = AntiJoinSpec(self.root, broot, shared)
+        if self.root is None:
+            raise Unsupported("constant-only query")
         # consumers that receive this object prebuilt need to know whether
         # the union/optional/minus host post-passes are already inside it
         self.fused_clauses = bool(anti_plans or union_groups or optional_plans)

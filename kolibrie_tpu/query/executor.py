@@ -150,14 +150,25 @@ def eval_where(
                         break
                     anti_plans.append(bp)
             if fusable:
-                table = try_device_execute(
-                    db,
-                    plan,
-                    tuple(anti_plans),
-                    tuple(union_groups),
-                    tuple(optional_plans),
-                )
-                fused_clauses = table is not None
+                main_plan = plan
+                if not where.patterns and where.values is None:
+                    # clause-only group: the first union/optional stands
+                    # alone (plan=None).  Filters attached to an empty
+                    # plan never see clause columns on the host path, so
+                    # only a filter-free group keeps exact parity.
+                    if where.filters or not (union_groups or optional_plans):
+                        main_plan = False  # shape host handles better
+                    else:
+                        main_plan = None
+                if main_plan is not False:
+                    table = try_device_execute(
+                        db,
+                        main_plan,
+                        tuple(anti_plans),
+                        tuple(union_groups),
+                        tuple(optional_plans),
+                    )
+                    fused_clauses = table is not None
             if table is None:
                 table = try_device_execute(db, plan)
         if table is None:

@@ -1209,3 +1209,59 @@ def test_aggregate_over_union_minus_optional():
         parsed = parse_sparql_query(q, db.prefixes)
         table, _p, _l = _try_device_aggregate(db, parsed, True)
         assert table is not None, q  # proves the device aggregate served it
+
+
+def test_union_only_query_on_device():
+    """A WHERE that is just a UNION (the executor's standalone-union case)
+    lowers with plan=None — the union IS the program."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e WHERE {
+        { ?e ex:dept "dept0" } UNION { ?e ex:dept "dept1" }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) == 200
+    assert sorted(dev) == sorted(host)
+    lowered = lower_plan(
+        db,
+        None,
+        (),
+        (_union_branch_plans(db, q),),
+        (),
+    )
+    assert "union" in lowered.describe()
+    assert len(lowered.execute()["e"]) == 200
+
+
+def _union_branch_plans(db, q):
+    from kolibrie_tpu.optimizer.planner import Streamertail
+    from kolibrie_tpu.query.executor import _branch_plan
+    from kolibrie_tpu.query.parser import parse_sparql_query
+
+    db.register_prefixes_from_query(q)
+    w = parse_sparql_query(q, db.prefixes).where
+    planner = Streamertail(db.get_or_build_stats())
+    return tuple(_branch_plan(db, planner, bw) for bw in w.unions[0])
+
+
+def test_optional_only_query_on_device():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?y WHERE {
+        OPTIONAL { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) > 0
+    assert sorted(dev) == sorted(host)
+
+
+def test_union_then_optional_clause_only():
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?y WHERE {
+        { ?e ex:dept "dept0" } UNION { ?e ex:dept "dept2" }
+        OPTIONAL { ?e ex:knows ?y }
+    }"""
+    dev, host = run_both(db, q)
+    assert len(host) == 200
+    assert sorted(dev) == sorted(host)
