@@ -33,10 +33,15 @@ cells) lowers to a replicated membership mask inside the mesh program.
 Plain sub-SELECTs (no aggregation/modifiers) fold into the BGP before
 lowering (:mod:`kolibrie_tpu.query.subquery_inline` — the same rewrite
 the single-chip paths apply), so nested selects distribute too.
+MINUS and NOT blocks with BGP(+filter) branches run as mesh
+anti-joins: the branch evaluates through the same shard-local pipeline,
+equal shared-key tuples co-locate by hash routing, and a local
+membership test drops matched rows.
 Everything else (general VALUES, OPTIONAL, UNION, non-inlinable
-subqueries, windows; BIND mixed with aggregates) raises
-:class:`Unsupported` — callers fall back to the single-chip engine,
-mirroring the device engine's own fallback contract.
+subqueries, non-BGP MINUS/NOT branches, windows; BIND mixed with
+aggregates) raises :class:`Unsupported` — callers fall back to the
+single-chip engine, mirroring the device engine's own fallback
+contract.
 
 Parity: the reference has NO distributed execution (SURVEY §2.6) — this is
 the TPU-native axis it lacks.  Row agreement with the host volcano executor
@@ -105,14 +110,16 @@ def _mirror(op: str) -> str:
 
 
 def _lower_query_filters(
-    filters, db, bound: set
+    filters, db, bound: set, mask_offset: int = 0
 ) -> Tuple[Tuple[LoweredFilter, ...], Tuple[tuple, ...]]:
     """Query FILTER expressions → LoweredFilters + numeric mask exprs.
 
     Numeric comparisons (including ``=``/``!=`` — value semantics, matching
     the host engine's NumCmp) become per-ID mask gathers; term equality
     against IRIs/strings becomes an ID compare.  AND composes; anything
-    else is Unsupported.
+    else is Unsupported.  ``mask_offset``: starting index the returned
+    mask exprs will occupy in the caller's combined mask bank (MINUS/NOT
+    branch filters share the main query's bank).
     """
     lowered: List[LoweredFilter] = []
     exprs: List[tuple] = []
@@ -120,7 +127,7 @@ def _lower_query_filters(
 
     def mask_key(k: tuple) -> int:
         if k not in keys:
-            keys[k] = len(exprs)
+            keys[k] = mask_offset + len(exprs)
             exprs.append(k)
         return keys[k]
 
@@ -249,59 +256,125 @@ def _query_body(
     distinct=False,
     topk=None,
     values_var=None,
+    anti=(),
 ):
     fs, fp, fo, fv, gs, gp, go, gv = (a[0] for a in state)
     masks = tuple(masks)
     fcols = (fs, fp, fo)
     overflow = jnp.int32(0)
 
-    table, valid = _scan_premise(premises[seed], fcols, fv)
-    for (j, kv, kpos, extra) in steps:
-        prem = premises[j]
-        if n > 1:
-            table, valid, dropped = _exchange_table(
-                table, valid, kv, n, axis, bucket_cap
+    def eval_bgp(premises, seed, steps, filters):
+        """Seed scan → routed join steps → filters: the shard-local BGP
+        pipeline, shared by the main pattern and MINUS/NOT branches.
+        Accumulates into the enclosing ``overflow`` via its return."""
+        ov = jnp.int32(0)
+        table, valid = _scan_premise(premises[seed], fcols, fv)
+        for (j, kv, kpos, extra) in steps:
+            prem = premises[j]
+            if n > 1:
+                table, valid, dropped = _exchange_table(
+                    table, valid, kv, n, axis, bucket_cap
+                )
+                ov = ov + dropped.astype(jnp.int32)
+            # n == 1 (single-chip mesh): every key hashes to shard 0 — the
+            # exchange is an identity that would still pay a full
+            # bucketize sort per join step; skip it
+            if kpos == 0:
+                side_cols, side_valid, side_key = fcols, fv, fs
+            else:
+                side_cols, side_valid, side_key = (gs, gp, go), gv, go
+            ptable, pmask = _scan_premise(prem, side_cols, side_valid)
+            li, ri, jvalid, total = local_join_u32(
+                table[kv], side_key, join_cap, valid, pmask
             )
-            overflow = overflow + dropped.astype(jnp.int32)
-        # n == 1 (single-chip mesh): every key hashes to shard 0 — the
-        # exchange is an identity that would still pay a full bucketize
-        # sort per join step; skip it
-        if kpos == 0:
-            side_cols, side_valid, side_key = fcols, fv, fs
-        else:
-            side_cols, side_valid, side_key = (gs, gp, go), gv, go
-        ptable, pmask = _scan_premise(prem, side_cols, side_valid)
-        li, ri, jvalid, total = local_join_u32(
-            table[kv], side_key, join_cap, valid, pmask
-        )
-        overflow = overflow + lax.psum(
-            jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
-        )
-        new_table = {v: c[li] for v, c in table.items()}
-        for v, c in ptable.items():
-            if v not in new_table:
-                new_table[v] = c[ri]
-            elif v in extra:
-                jvalid = jvalid & (new_table[v] == c[ri])
-        table, valid = new_table, jvalid
+            ov = ov + lax.psum(
+                jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+            )
+            new_table = {v: c[li] for v, c in table.items()}
+            for v, c in ptable.items():
+                if v not in new_table:
+                    new_table[v] = c[ri]
+                elif v in extra:
+                    jvalid = jvalid & (new_table[v] == c[ri])
+            table, valid = new_table, jvalid
+        for f in filters:
+            col = table[f.var]
+            if f.kind == "eq":
+                valid = valid & (col == jnp.uint32(f.const_id))
+            elif f.kind == "ne":
+                valid = valid & (col != jnp.uint32(f.const_id))
+            elif f.kind == "strmask":
+                valid = valid & _strmask_verdict(col, masks, f)
+            else:
+                m = masks[f.mask_idx]
+                valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+        return table, valid, ov
 
-    for f in filters:
-        col = table[f.var]
-        if f.kind == "eq":
-            valid = valid & (col == jnp.uint32(f.const_id))
-        elif f.kind == "ne":
-            valid = valid & (col != jnp.uint32(f.const_id))
-        elif f.kind == "strmask":
-            valid = valid & _strmask_verdict(col, masks, f)
-        else:
-            m = masks[f.mask_idx]
-            valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+    table, valid, ov = eval_bgp(premises, seed, steps, filters)
+    overflow = overflow + ov
 
     if values_var is not None:
         # replicated VALUES membership: sorted array + searchsorted per row
         col = table[values_var]
         vpos = jnp.clip(jnp.searchsorted(vals, col), 0, vals.shape[0] - 1)
         valid = valid & (vals[vpos] == col)
+
+    # MINUS / NOT branches: evaluate each branch with the same shard-local
+    # BGP pipeline, co-locate equal shared-key tuples by hash routing, and
+    # drop main rows with a local branch match (distributed anti-join —
+    # the mesh twin of the device engine's AntiJoinSpec).
+    for (bprem, bseed, bsteps, bfilters, bkeys) in anti:
+        from kolibrie_tpu.parallel.dist_join import exchange as _exchange
+        from kolibrie_tpu.parallel.dist_join import mix32
+
+        btable, bvalid, ov = eval_bgp(bprem, bseed, bsteps, bfilters)
+        overflow = overflow + ov
+        if n > 1:
+            def _dest(cols_k):
+                h = cols_k[0]
+                for c in cols_k[1:]:
+                    h = mix32(h) ^ c
+                return (mix32(h) % jnp.uint32(n)).astype(jnp.int32)
+
+            names = sorted(table)
+            routed, valid, dropped = _exchange(
+                tuple(table[v] for v in names),
+                valid,
+                _dest([table[v] for v in bkeys]),
+                n,
+                axis,
+                bucket_cap,
+            )
+            overflow = overflow + dropped.astype(jnp.int32)
+            table = dict(zip(names, routed))
+            brouted, bvalid, bdropped = _exchange(
+                tuple(btable[v] for v in bkeys),
+                bvalid,
+                _dest([btable[v] for v in bkeys]),
+                n,
+                axis,
+                bucket_cap,
+            )
+            overflow = overflow + bdropped.astype(jnp.int32)
+            btable = dict(zip(bkeys, brouted))
+        # local membership: pack the shared key tuple; equal tuples are
+        # co-located, so a local rank pack over the CONCATENATED columns
+        # is exact for any key arity
+        lcols_k = [table[v] for v in bkeys]
+        rcols_k = [btable[v] for v in bkeys]
+        lk = lcols_k[0].astype(jnp.uint64)
+        rk = rcols_k[0].astype(jnp.uint64)
+        for lc, rc in zip(lcols_k[1:], rcols_k[1:]):
+            union = jnp.sort(jnp.concatenate([lk, rk]))
+            lr = jnp.searchsorted(union, lk).astype(jnp.uint64)
+            rr = jnp.searchsorted(union, rk).astype(jnp.uint64)
+            lk = (lr << jnp.uint64(32)) | lc.astype(jnp.uint64)
+            rk = (rr << jnp.uint64(32)) | rc.astype(jnp.uint64)
+        lk = jnp.where(valid, lk, jnp.uint64(0xFFFFFFFFFFFFFFFE))
+        rk = jnp.where(bvalid, rk, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        rs = jnp.sort(rk)
+        pos = jnp.clip(jnp.searchsorted(rs, lk), 0, rs.shape[0] - 1)
+        valid = valid & (rs[pos] != lk)
 
     if distinct and out_vars:
         # mesh-side DISTINCT: equal projection tuples hash to the same
@@ -393,6 +466,7 @@ def _query_fn(
     distinct=False,
     topk=None,
     values_var=None,
+    anti=(),
 ):
     axis = mesh.axis_names[0]
     n = mesh.devices.size
@@ -410,6 +484,7 @@ def _query_fn(
         distinct=distinct,
         topk=topk,
         values_var=values_var,
+        anti=anti,
     )
     spec = P(axis, None)
     return jax.jit(
@@ -469,14 +544,7 @@ class DistQueryExecutor:
         # plain sub-SELECTs fold into the BGP (same rewrite the single-chip
         # paths apply), so nested selects distribute too
         w = inline_subqueries(q.where)
-        if (
-            w.subqueries
-            or w.not_blocks
-            or w.window_blocks
-            or w.optionals
-            or w.unions
-            or w.minus
-        ):
+        if w.subqueries or w.window_blocks or w.optionals or w.unions:
             raise Unsupported("non-BGP clause in WHERE")
         if not w.patterns:
             raise Unsupported("empty BGP")
@@ -576,6 +644,48 @@ class DistQueryExecutor:
         self.filters, self.mask_exprs = _lower_query_filters(
             plan_filters, db, bound
         )
+        # MINUS / NOT branches: each lowers to its own premise pipeline
+        # (same machinery as the main BGP) plus the shared-key tuple for
+        # the mesh anti-join.  Branch filters share the main mask bank.
+        mask_exprs = list(self.mask_exprs)
+        anti = []
+        for bw in list(w.minus) + [
+            A.WhereClause(patterns=nb.patterns) for nb in w.not_blocks
+        ]:
+            bw = inline_subqueries(bw)
+            if (
+                not bw.patterns
+                or bw.binds
+                or bw.values is not None
+                or bw.subqueries
+                or bw.not_blocks
+                or bw.window_blocks
+                or bw.optionals
+                or bw.unions
+                or bw.minus
+            ):
+                raise Unsupported("non-BGP MINUS/NOT branch stays single-chip")
+            bres = [resolve_pattern(db, p) for p in bw.patterns]
+            bprem = tuple(_lower_query_pattern(p) for p in bres)
+            bbound = {v for pr in bprem for v, _ in pr.vars}
+            bkeys = tuple(sorted(bbound & bound))
+            if not bkeys:
+                continue  # disjoint domains: MINUS removes nothing
+            bfilters, bexprs = _lower_query_filters(
+                list(bw.filters), db, bbound, mask_offset=len(mask_exprs)
+            )
+            mask_exprs.extend(bexprs)
+            bplans = dict(_plan_rule_dist(bprem))
+            bseed = max(
+                range(len(bprem)),
+                key=lambda i: (
+                    sum(c is not None for c in bprem[i].consts),
+                    -i,
+                ),
+            )
+            anti.append((bprem, bseed, bplans[bseed], bfilters, bkeys))
+        self.anti = tuple(anti)
+        self.mask_exprs = tuple(mask_exprs)
         plans = _plan_rule_dist(self.premises)
         # seed at the most selective premise (most constant positions)
         self.seed = max(
@@ -615,7 +725,7 @@ class DistQueryExecutor:
         if cache is None or cache["version"] != version:
             cache = {"version": version, "caps": {}}
             self.db.__dict__["_dist_cap_cache"] = cache
-        key = (self.premises, self.seed, self.steps, self.n)
+        key = (self.premises, self.seed, self.steps, self.anti, self.n)
         caps = cache["caps"].get(key)
         if caps is None:
             caps = self._calibrate_caps()
@@ -742,6 +852,7 @@ class DistQueryExecutor:
                 distinct,
                 topk,
                 self.values_var,
+                self.anti,
             )
             with jax.enable_x64(True):
                 outs, valid, total, overflow, nan_flag = fn(

@@ -440,3 +440,107 @@ def test_order_by_mixed_key_types_global_decision(mesh):
     dist = execute_query_distributed(q, db, mesh)
     assert len(host) == 8
     assert dist == host
+
+
+# ---------------------------------------------------------------------------
+# MINUS / NOT as mesh anti-joins (round 4)
+# ---------------------------------------------------------------------------
+
+
+def _anti_db(n=300):
+    db = SparqlDatabase()
+    lines = []
+    for i in range(n):
+        e = f"<http://example.org/e{i}>"
+        lines.append(
+            f"{e} <http://example.org/worksAt> <http://example.org/org{i % 9}> ."
+        )
+        lines.append(
+            f'{e} <http://example.org/salary> "{30000 + (i % 40) * 1000}" .'
+        )
+        if i % 3 == 0:
+            lines.append(
+                f"{e} <http://example.org/knows> <http://example.org/e{(i + 1) % n}> ."
+            )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    return db
+
+
+def test_minus_agreement_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert 0 < len(host) < 300
+    assert dist == host
+
+
+def test_minus_with_filter_branch_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?o WHERE {
+        ?e ex:worksAt ?o
+        MINUS { ?e ex:salary ?s . FILTER(?s > 50000) }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert 0 < len(host) < 300
+    assert dist == host
+
+
+def test_not_block_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?o WHERE {
+        ?e ex:worksAt ?o .
+        NOT { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert 0 < len(host) < 300
+    assert dist == host
+
+
+def test_minus_multikey_branch_dist(mesh):
+    # branch shares TWO variables with the outer pattern
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?y WHERE {
+        ?e ex:knows ?y
+        MINUS { ?e ex:worksAt ?o . ?y ex:worksAt ?o }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
+
+
+def test_minus_disjoint_branch_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?a ex:knows ?b }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 300
+    assert dist == host
+
+
+def test_minus_composes_with_distinct_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT DISTINCT ?o WHERE {
+        ?e ex:worksAt ?o
+        MINUS { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
