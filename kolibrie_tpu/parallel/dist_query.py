@@ -257,6 +257,8 @@ def _query_body(
     topk=None,
     values_var=None,
     anti=(),
+    unions=(),
+    optionals=(),
 ):
     fs, fp, fo, fv, gs, gp, go, gv = (a[0] for a in state)
     masks = tuple(masks)
@@ -319,47 +321,54 @@ def _query_body(
         vpos = jnp.clip(jnp.searchsorted(vals, col), 0, vals.shape[0] - 1)
         valid = valid & (vals[vpos] == col)
 
-    # MINUS / NOT branches: evaluate each branch with the same shard-local
-    # BGP pipeline, co-locate equal shared-key tuples by hash routing, and
-    # drop main rows with a local branch match (distributed anti-join —
-    # the mesh twin of the device engine's AntiJoinSpec).
-    for (bprem, bseed, bsteps, bfilters, bkeys) in anti:
-        from kolibrie_tpu.parallel.dist_join import exchange as _exchange
-        from kolibrie_tpu.parallel.dist_join import mix32
+    # UNION / OPTIONAL / MINUS / NOT branches, in the host post-pass
+    # order: each branch evaluates through the same shard-local BGP
+    # pipeline, equal shared-key tuples co-locate by hash routing, then a
+    # local join (union), left-outer join (optional) or membership test
+    # (anti) applies — the mesh twins of the device engine's UnionSpec /
+    # LeftOuterSpec / AntiJoinSpec.
+    from kolibrie_tpu.parallel.dist_join import exchange as _exchange
+    from kolibrie_tpu.parallel.dist_join import mix32
 
-        btable, bvalid, ov = eval_bgp(bprem, bseed, bsteps, bfilters)
-        overflow = overflow + ov
-        if n > 1:
-            def _dest(cols_k):
-                h = cols_k[0]
-                for c in cols_k[1:]:
-                    h = mix32(h) ^ c
-                return (mix32(h) % jnp.uint32(n)).astype(jnp.int32)
+    def _dest(cols_k):
+        h = cols_k[0]
+        for c in cols_k[1:]:
+            h = mix32(h) ^ c
+        return (mix32(h) % jnp.uint32(n)).astype(jnp.int32)
 
-            names = sorted(table)
-            routed, valid, dropped = _exchange(
-                tuple(table[v] for v in names),
-                valid,
-                _dest([table[v] for v in bkeys]),
-                n,
-                axis,
-                bucket_cap,
-            )
-            overflow = overflow + dropped.astype(jnp.int32)
-            table = dict(zip(names, routed))
-            brouted, bvalid, bdropped = _exchange(
-                tuple(btable[v] for v in bkeys),
-                bvalid,
-                _dest([btable[v] for v in bkeys]),
-                n,
-                axis,
-                bucket_cap,
-            )
-            overflow = overflow + bdropped.astype(jnp.int32)
-            btable = dict(zip(bkeys, brouted))
-        # local membership: pack the shared key tuple; equal tuples are
-        # co-located, so a local rank pack over the CONCATENATED columns
-        # is exact for any key arity
+    def _route_sides(table, valid, btable, bvalid, bkeys, bextra):
+        """Co-locate main rows and branch rows by shared-key hash.
+        ``bextra``: branch columns beyond the keys to carry through."""
+        nonlocal overflow
+        if n <= 1:
+            return table, valid, btable, bvalid
+        names = sorted(table)
+        routed, valid, dropped = _exchange(
+            tuple(table[v] for v in names),
+            valid,
+            _dest([table[v] for v in bkeys]),
+            n,
+            axis,
+            bucket_cap,
+        )
+        overflow = overflow + dropped.astype(jnp.int32)
+        table = dict(zip(names, routed))
+        bnames = list(bkeys) + [v for v in bextra if v not in bkeys]
+        brouted, bvalid, bdropped = _exchange(
+            tuple(btable[v] for v in bnames),
+            bvalid,
+            _dest([btable[v] for v in bkeys]),
+            n,
+            axis,
+            bucket_cap,
+        )
+        overflow = overflow + bdropped.astype(jnp.int32)
+        return table, valid, dict(zip(bnames, brouted)), bvalid
+
+    def _pack_pair(table, valid, btable, bvalid, bkeys):
+        """Shared-key tuples → comparable u64 keys.  Equal tuples are
+        co-located after routing, so a LOCAL rank pack over the
+        concatenated columns is exact for any key arity."""
         lcols_k = [table[v] for v in bkeys]
         rcols_k = [btable[v] for v in bkeys]
         lk = lcols_k[0].astype(jnp.uint64)
@@ -372,6 +381,74 @@ def _query_body(
             rk = (rr << jnp.uint64(32)) | rc.astype(jnp.uint64)
         lk = jnp.where(valid, lk, jnp.uint64(0xFFFFFFFFFFFFFFFE))
         rk = jnp.where(bvalid, rk, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        return lk, rk
+
+    for (branches, gvars, gkeys) in unions:
+        parts = []
+        for (bprem, bseed, bsteps, bfilters) in branches:
+            bt, bv, ov = eval_bgp(bprem, bseed, bsteps, bfilters)
+            overflow = overflow + ov
+            parts.append((bt, bv))
+        ucols = {}
+        for v in gvars:
+            segs = [
+                bt[v]
+                if v in bt
+                else jnp.zeros(bv.shape[0], dtype=jnp.uint32)
+                for bt, bv in parts
+            ]
+            ucols[v] = jnp.concatenate(segs)
+        uvalid = jnp.concatenate([bv for _bt, bv in parts])
+        table, valid, ucols, uvalid = _route_sides(
+            table, valid, ucols, uvalid, gkeys, gvars
+        )
+        lk, rk = _pack_pair(table, valid, ucols, uvalid, gkeys)
+        from kolibrie_tpu.ops.device_join import join_indices as _dj
+
+        li, ri, jvalid, total = _dj(lk, rk, join_cap)
+        overflow = overflow + lax.psum(
+            jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+        )
+        new_table = {v: jnp.where(jvalid, c[li], 0) for v, c in table.items()}
+        for v in gvars:
+            if v not in new_table:
+                new_table[v] = jnp.where(jvalid, ucols[v][ri], 0)
+        table, valid = new_table, jvalid
+
+    for (oprem, oseed, osteps, ofilters, ovars, okeys) in optionals:
+        bt, bv, ov = eval_bgp(oprem, oseed, osteps, ofilters)
+        overflow = overflow + ov
+        table, valid, bt, bv = _route_sides(table, valid, bt, bv, okeys, ovars)
+        lk, rk = _pack_pair(table, valid, bt, bv, okeys)
+        from kolibrie_tpu.ops.device_join import join_indices as _dj
+
+        li, ri, jvalid, total = _dj(lk, rk, join_cap)
+        overflow = overflow + lax.psum(
+            jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+        )
+        rs = jnp.sort(rk)
+        pos = jnp.clip(jnp.searchsorted(rs, lk), 0, rs.shape[0] - 1)
+        keep = valid & (rs[pos] != lk)  # unmatched main rows
+        new_table = {}
+        for v, c in table.items():
+            new_table[v] = jnp.concatenate([jnp.where(jvalid, c[li], 0), c])
+        for v in ovars:
+            if v not in table:
+                new_table[v] = jnp.concatenate(
+                    [
+                        jnp.where(jvalid, bt[v][ri], 0),
+                        jnp.zeros(valid.shape[0], dtype=jnp.uint32),
+                    ]
+                )
+        table, valid = new_table, jnp.concatenate([jvalid, keep])
+
+    for (bprem, bseed, bsteps, bfilters, bkeys) in anti:
+        btable, bvalid, ov = eval_bgp(bprem, bseed, bsteps, bfilters)
+        overflow = overflow + ov
+        table, valid, btable, bvalid = _route_sides(
+            table, valid, btable, bvalid, bkeys, ()
+        )
+        lk, rk = _pack_pair(table, valid, btable, bvalid, bkeys)
         rs = jnp.sort(rk)
         pos = jnp.clip(jnp.searchsorted(rs, lk), 0, rs.shape[0] - 1)
         valid = valid & (rs[pos] != lk)
@@ -467,6 +544,8 @@ def _query_fn(
     topk=None,
     values_var=None,
     anti=(),
+    unions=(),
+    optionals=(),
 ):
     axis = mesh.axis_names[0]
     n = mesh.devices.size
@@ -485,6 +564,8 @@ def _query_fn(
         topk=topk,
         values_var=values_var,
         anti=anti,
+        unions=unions,
+        optionals=optionals,
     )
     spec = P(axis, None)
     return jax.jit(
@@ -544,13 +625,63 @@ class DistQueryExecutor:
         # plain sub-SELECTs fold into the BGP (same rewrite the single-chip
         # paths apply), so nested selects distribute too
         w = inline_subqueries(q.where)
-        if w.subqueries or w.window_blocks or w.optionals or w.unions:
+        if w.subqueries or w.window_blocks:
             raise Unsupported("non-BGP clause in WHERE")
         if not w.patterns:
             raise Unsupported("empty BGP")
         resolved = [resolve_pattern(db, p) for p in w.patterns]
         self.premises = tuple(_lower_query_pattern(p) for p in resolved)
         bound = {v for pr in self.premises for v, _ in pr.vars}
+
+        # UNION groups / OPTIONAL branches: structural lowering NOW so the
+        # clause variables join the projection/aggregation variable space;
+        # branch filters lower later into the shared mask bank.  Join keys
+        # accumulate left-to-right, matching the host post-pass order
+        # (group N may key on group N-1's variables).
+        def _branch_bgp(bw, kind):
+            bw = inline_subqueries(bw)
+            if (
+                not bw.patterns
+                or bw.binds
+                or bw.values is not None
+                or bw.subqueries
+                or bw.not_blocks
+                or bw.window_blocks
+                or bw.optionals
+                or bw.unions
+                or bw.minus
+            ):
+                raise Unsupported(f"non-BGP {kind} branch stays single-chip")
+            bres = [resolve_pattern(db, p) for p in bw.patterns]
+            bprem = tuple(_lower_query_pattern(p) for p in bres)
+            bbound = {v for pr in bprem for v, _ in pr.vars}
+            return bprem, bbound, bw
+
+        cur_vars = set(bound)
+        union_pre = []
+        for groups in w.unions:
+            gpre = [_branch_bgp(bw_u, "UNION") for bw_u in groups]
+            gvars: set = set()
+            for _bp, bb, _bw in gpre:
+                gvars |= bb
+            keys = tuple(sorted(gvars & cur_vars))
+            if not keys:
+                raise Unsupported(
+                    "UNION with no shared variables stays single-chip"
+                )
+            union_pre.append((gpre, tuple(sorted(gvars)), keys))
+            cur_vars |= gvars
+        opt_pre = []
+        for ow in w.optionals:
+            oprem, obound, ow_i = _branch_bgp(ow, "OPTIONAL")
+            keys = tuple(sorted(obound & cur_vars))
+            if not keys:
+                raise Unsupported(
+                    "OPTIONAL with no shared variables stays single-chip"
+                )
+            opt_pre.append((oprem, obound, ow_i, keys))
+            cur_vars |= obound
+        full_bound = cur_vars
         # VALUES in its constraining form — ONE variable that the BGP
         # binds, all cells bound and distinct — lowers to a replicated
         # membership mask inside the mesh program (a sorted array +
@@ -610,11 +741,11 @@ class DistQueryExecutor:
                     raise Unsupported(f"aggregate {a.func}")
                 if a.distinct and a.func != "COUNT":
                     raise Unsupported("DISTINCT on non-COUNT aggregate")
-                if a.var is not None and a.var not in bound:
+                if a.var is not None and a.var not in full_bound:
                     raise Unsupported(f"aggregate variable unbound: {a.var}")
             if any(i.kind == "expr" for i in q.select):
                 raise Unsupported("expressions in aggregate SELECT")
-            missing = set(q.group_by) - bound
+            missing = set(q.group_by) - full_bound
             if missing:
                 raise Unsupported(f"group variables unbound: {missing}")
             # out columns = group vars + every aggregated var
@@ -623,56 +754,36 @@ class DistQueryExecutor:
                 for i in self.agg_items
                 if i.agg.var is not None
             ]
-            self.out_vars = tuple(dict.fromkeys(need)) or tuple(sorted(bound))[:1]
+            self.out_vars = tuple(dict.fromkeys(need)) or tuple(sorted(full_bound))[:1]
         elif not q.select_all() and any(i.kind != "var" for i in q.select):
             raise Unsupported("expressions in SELECT")
         elif q.select_all():
-            self.out_vars = tuple(sorted(bound))
+            self.out_vars = tuple(sorted(full_bound))
         elif self.binds:
             # binds may reference any pattern variable: gather them ALL,
             # apply binds host-side, project afterwards (run())
             sel = tuple(item.var for item in q.select)
-            missing = set(sel) - bound - bind_vars
+            missing = set(sel) - full_bound - bind_vars
             if missing:
                 raise Unsupported(f"projected variables unbound: {missing}")
-            self.out_vars = tuple(sorted(bound))
+            self.out_vars = tuple(sorted(full_bound))
         else:
             self.out_vars = tuple(item.var for item in q.select)
-            missing = set(self.out_vars) - bound
+            missing = set(self.out_vars) - full_bound
             if missing:
                 raise Unsupported(f"projected variables unbound: {missing}")
         self.filters, self.mask_exprs = _lower_query_filters(
             plan_filters, db, bound
         )
-        # MINUS / NOT branches: each lowers to its own premise pipeline
-        # (same machinery as the main BGP) plus the shared-key tuple for
-        # the mesh anti-join.  Branch filters share the main mask bank.
+        # Clause branches (UNION / OPTIONAL structurally lowered above,
+        # MINUS / NOT here): each lowers to its own premise pipeline (same
+        # machinery as the main BGP).  Branch filters share the main mask
+        # bank via offsets.
         mask_exprs = list(self.mask_exprs)
-        anti = []
-        for bw in list(w.minus) + [
-            A.WhereClause(patterns=nb.patterns) for nb in w.not_blocks
-        ]:
-            bw = inline_subqueries(bw)
-            if (
-                not bw.patterns
-                or bw.binds
-                or bw.values is not None
-                or bw.subqueries
-                or bw.not_blocks
-                or bw.window_blocks
-                or bw.optionals
-                or bw.unions
-                or bw.minus
-            ):
-                raise Unsupported("non-BGP MINUS/NOT branch stays single-chip")
-            bres = [resolve_pattern(db, p) for p in bw.patterns]
-            bprem = tuple(_lower_query_pattern(p) for p in bres)
-            bbound = {v for pr in bprem for v, _ in pr.vars}
-            bkeys = tuple(sorted(bbound & bound))
-            if not bkeys:
-                continue  # disjoint domains: MINUS removes nothing
+
+        def _branch_pipeline(bprem, bfilter_src, bbound):
             bfilters, bexprs = _lower_query_filters(
-                list(bw.filters), db, bbound, mask_offset=len(mask_exprs)
+                list(bfilter_src), db, bbound, mask_offset=len(mask_exprs)
             )
             mask_exprs.extend(bexprs)
             bplans = dict(_plan_rule_dist(bprem))
@@ -683,7 +794,34 @@ class DistQueryExecutor:
                     -i,
                 ),
             )
-            anti.append((bprem, bseed, bplans[bseed], bfilters, bkeys))
+            return bprem, bseed, bplans[bseed], bfilters
+
+        unions_l = []
+        for gpre, gvars, keys in union_pre:
+            branches = tuple(
+                _branch_pipeline(bprem, bw_u.filters, bbound)
+                for bprem, bbound, bw_u in gpre
+            )
+            unions_l.append((branches, gvars, keys))
+        self.union_specs = tuple(unions_l)
+        opts_l = []
+        for oprem, obound, ow_i, keys in opt_pre:
+            opts_l.append(
+                _branch_pipeline(oprem, ow_i.filters, obound)
+                + (tuple(sorted(obound)), keys)
+            )
+        self.optional_specs = tuple(opts_l)
+        anti = []
+        for bw in list(w.minus) + [
+            A.WhereClause(patterns=nb.patterns) for nb in w.not_blocks
+        ]:
+            bprem, bbound, bw = _branch_bgp(bw, "MINUS/NOT")
+            bkeys = tuple(sorted(bbound & full_bound))
+            if not bkeys:
+                continue  # disjoint domains: MINUS removes nothing
+            anti.append(
+                _branch_pipeline(bprem, bw.filters, bbound) + (bkeys,)
+            )
         self.anti = tuple(anti)
         self.mask_exprs = tuple(mask_exprs)
         plans = _plan_rule_dist(self.premises)
@@ -725,7 +863,15 @@ class DistQueryExecutor:
         if cache is None or cache["version"] != version:
             cache = {"version": version, "caps": {}}
             self.db.__dict__["_dist_cap_cache"] = cache
-        key = (self.premises, self.seed, self.steps, self.anti, self.n)
+        key = (
+            self.premises,
+            self.seed,
+            self.steps,
+            self.anti,
+            self.union_specs,
+            self.optional_specs,
+            self.n,
+        )
         caps = cache["caps"].get(key)
         if caps is None:
             caps = self._calibrate_caps()
@@ -853,6 +999,8 @@ class DistQueryExecutor:
                 topk,
                 self.values_var,
                 self.anti,
+                self.union_specs,
+                self.optional_specs,
             )
             with jax.enable_x64(True):
                 outs, valid, total, overflow, nan_flag = fn(

@@ -120,12 +120,21 @@ def test_constant_subject_and_limit(mesh):
 
 def test_unsupported_shapes_raise(mesh, lubm_db):
     with pytest.raises(Unsupported):
-        # OPTIONAL stays single-chip (BIND is a host tail and constraining
-        # VALUES a mesh membership mask — see their agreement tests)
+        # OPTIONAL now distributes, but only with a plain BGP(+filter)
+        # branch — a nested OPTIONAL inside the branch stays single-chip
         DistQueryExecutor(
             mesh,
             lubm_db,
-            "SELECT ?x WHERE { ?x ?p ?y . OPTIONAL { ?y ?q ?z } }",
+            "SELECT ?x WHERE { ?x ?p ?y . "
+            "OPTIONAL { ?y ?q ?z OPTIONAL { ?z ?q ?w } } }",
+        )
+    with pytest.raises(Unsupported):
+        # an OPTIONAL sharing no variable with the group has cross-join
+        # semantics on the host — stays single-chip
+        DistQueryExecutor(
+            mesh,
+            lubm_db,
+            "SELECT ?x WHERE { ?x ?p ?y . OPTIONAL { ?a ?q ?b } }",
         )
     with pytest.raises(Unsupported):
         # GROUP_CONCAT stays host-side (same contract as the single-chip
@@ -539,6 +548,82 @@ def test_minus_composes_with_distinct_dist(mesh):
     SELECT DISTINCT ?o WHERE {
         ?e ex:worksAt ?o
         MINUS { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
+
+
+# ---------------------------------------------------------------------------
+# UNION / OPTIONAL as mesh programs (round 4)
+# ---------------------------------------------------------------------------
+
+
+def test_union_agreement_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        { ?e ex:worksAt <http://example.org/org0> }
+        UNION { ?e ex:worksAt <http://example.org/org1> }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert 0 < len(host) < 300
+    assert dist == host
+
+
+def test_union_unbound_fill_dist(mesh):
+    # branches bind different variable sets: UNBOUND fill rides the mesh
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s
+        { ?e ex:worksAt <http://example.org/org2> } UNION { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) > 0
+    assert dist == host
+
+
+def test_optional_agreement_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s .
+        OPTIONAL { ?e ex:knows ?y }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 300
+    assert dist == host
+    assert any(r[2] == "" for r in dist)  # UNBOUND survives the mesh
+
+
+def test_optional_filter_branch_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?o ?s WHERE {
+        ?e ex:worksAt ?o .
+        OPTIONAL { ?e ex:salary ?s . FILTER(?s > 60000) }
+    }"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 300
+    assert dist == host
+
+
+def test_union_optional_minus_compose_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s
+        { ?e ex:worksAt <http://example.org/org0> }
+        UNION { ?e ex:worksAt <http://example.org/org3> }
+        OPTIONAL { ?e ex:knows ?y }
+        MINUS { ?e ex:worksAt <http://example.org/org3> }
     }"""
     host = execute_query_volcano(q, db)
     dist = execute_query_distributed(q, db, mesh)
