@@ -33,15 +33,18 @@ cells) lowers to a replicated membership mask inside the mesh program.
 Plain sub-SELECTs (no aggregation/modifiers) fold into the BGP before
 lowering (:mod:`kolibrie_tpu.query.subquery_inline` — the same rewrite
 the single-chip paths apply), so nested selects distribute too.
-MINUS and NOT blocks with BGP(+filter) branches run as mesh
-anti-joins: the branch evaluates through the same shard-local pipeline,
-equal shared-key tuples co-locate by hash routing, and a local
-membership test drops matched rows.
-Everything else (general VALUES, OPTIONAL, UNION, non-inlinable
-subqueries, non-BGP MINUS/NOT branches, windows; BIND mixed with
-aggregates) raises :class:`Unsupported` — callers fall back to the
-single-chip engine, mirroring the device engine's own fallback
-contract.
+UNION, OPTIONAL, MINUS and NOT clauses with BGP(+filter) branches run
+as mesh programs: each branch evaluates through the same shard-local
+pipeline, equal shared-key tuples co-locate by hash routing, then a
+local join (UNION, over the branch concat with UNBOUND fill), a
+left-outer join (OPTIONAL — matches plus unmatched main rows with
+UNBOUND branch-only columns) or a membership test (MINUS/NOT) applies,
+in the host post-pass order.
+Everything else (general VALUES, non-inlinable subqueries, non-BGP
+clause branches, clauses sharing no variable with the group, windows;
+BIND mixed with aggregates) raises :class:`Unsupported` — callers fall
+back to the single-chip engine, mirroring the device engine's own
+fallback contract.
 
 Parity: the reference has NO distributed execution (SURVEY §2.6) — this is
 the TPU-native axis it lacks.  Row agreement with the host volcano executor
