@@ -26,6 +26,12 @@ jax.config.update("jax_platforms", "cpu")
 # 8-device shard_map compiles that are identical run-to-run (VERDICT r2:
 # full suite >10 min, dist_* files ~5 min each).  Cache survives across
 # pytest invocations; harmless if the backend ignores it.
+#
+# CAUTION: do not run two suites concurrently against this cache — the
+# XLA-level caches ("all" below) are not write-atomic, and a torn entry
+# SEGFAULTS jax's zstd cache read on the next run.  Symptom: pytest dies
+# rc=139 inside compilation_cache.get_executable_and_time; fix:
+# ``rm -rf .jax_cache/*`` and rerun (one process).
 _cache_dir = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
