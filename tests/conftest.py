@@ -32,7 +32,14 @@ jax.config.update("jax_platforms", "cpu")
 # SEGFAULTS jax's zstd cache read on the next run.  Symptom: pytest dies
 # rc=139 inside compilation_cache.get_executable_and_time; fix:
 # ``rm -rf .jax_cache/*`` and rerun (one process).
-_cache_dir = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+if os.environ.get("KOLIBRIE_NO_TEST_CACHE"):
+    pass  # cold-compile everything (cache-corruption triage)
+else:
+    _cache_dir = os.path.join(
+        os.path.dirname(__file__), os.pardir, ".jax_cache"
+    )
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.abspath(_cache_dir)
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
