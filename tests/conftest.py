@@ -18,6 +18,20 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import resource  # noqa: E402
+
+# XLA's CPU compiler can exhaust the default 8 MiB stack on the suite's
+# largest programs (the Pallas chunk-scan joins) once a few hundred tests
+# of state have accumulated — a nondeterministic SIGSEGV in
+# backend_compile_and_load.  The main thread's stack grows on demand up
+# to the SOFT limit, so raising it here (before any big compile) is
+# effective.
+try:
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    resource.setrlimit(resource.RLIMIT_STACK, (_hard, _hard))
+except (ValueError, OSError):
+    pass
+
 import jax  # noqa: E402  (preloaded by sitecustomize anyway)
 
 jax.config.update("jax_platforms", "cpu")
@@ -32,6 +46,26 @@ jax.config.update("jax_platforms", "cpu")
 # SEGFAULTS jax's zstd cache read on the next run.  Symptom: pytest dies
 # rc=139 inside compilation_cache.get_executable_and_time; fix:
 # ``rm -rf .jax_cache/*`` and rerun (one process).
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_memory():
+    """Drop compiled executables after every test module.
+
+    A full-suite run compiles many hundreds of programs into one
+    process; past a threshold the NEXT big XLA CPU compile dies with
+    SIGSEGV inside ``backend_compile_and_load`` (reproducibly around the
+    Pallas chunk-join programs at ~60% of the suite; independent of
+    stack rlimit, map count, and the persistent cache — consistent with
+    LLVM-JIT address-space/relocation exhaustion).  Neither half of the
+    suite alone reproduces it, so bounding accumulation per module is
+    both the fix and the regression guard.  The persistent compile cache
+    below absorbs the recompiles this forces."""
+    yield
+    jax.clear_caches()
+
+
 if os.environ.get("KOLIBRIE_NO_TEST_CACHE"):
     pass  # cold-compile everything (cache-corruption triage)
 else:
