@@ -1,0 +1,101 @@
+"""The whole group graph pattern as ONE device program.
+
+Round 4 fused every SPARQL group-pattern clause into the single compiled
+query program the device engine dispatches:
+
+- plain sub-SELECTs inline into the outer BGP before planning
+  (``kolibrie_tpu/query/subquery_inline.py``; subquery-scoped variables
+  renamed fresh, so SPARQL scoping is preserved);
+- UNION becomes a branch-table concatenation over the union of branch
+  variables (UNBOUND fill) that joins the main tree;
+- OPTIONAL becomes a left-outer join (matches + unmatched-left rows);
+- MINUS / NOT become membership anti-joins.
+
+This demo runs one query using ALL of them, shows the physical-plan
+EXPLAIN of the fused program, verifies device/host row agreement, and
+then runs the same query distributed over an 8-device mesh (the mesh
+executor fuses the same clauses as shard-local branch pipelines with
+hash co-location).
+
+Run:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/16_group_pattern_fusion.py
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kolibrie_tpu.parallel import make_mesh
+from kolibrie_tpu.parallel.dist_query import execute_query_distributed
+from kolibrie_tpu.query.engine import QueryEngine
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+db = SparqlDatabase()
+lines = []
+for i in range(600):
+    e = f"<https://corp.example/emp{i}>"
+    lines.append(
+        f"{e} <https://corp.example/dept> <https://corp.example/d{i % 6}> ."
+    )
+    lines.append(f'{e} <https://corp.example/salary> "{40000 + (i % 60) * 1000}" .')
+    if i % 2 == 0:
+        lines.append(
+            f"{e} <https://corp.example/site> <https://corp.example/hq> ."
+        )
+    else:
+        lines.append(
+            f"{e} <https://corp.example/site> <https://corp.example/remote> ."
+        )
+    if i % 5 == 0:
+        lines.append(
+            f"{e} <https://corp.example/mentors> "
+            f"<https://corp.example/emp{(i + 1) % 600}> ."
+        )
+    if i % 7 == 0:
+        lines.append(f"{e} <https://corp.example/flagged> \"yes\" .")
+db.parse_ntriples("\n".join(lines))
+
+QUERY = """PREFIX c: <https://corp.example/>
+SELECT ?e ?s ?m WHERE {
+    ?e c:dept ?d .
+    { SELECT ?e WHERE { ?e c:salary ?s2 . FILTER(?s2 >= 70000) } }
+    { ?e c:site c:hq } UNION { ?e c:site c:remote }
+    ?e c:salary ?s .
+    OPTIONAL { ?e c:mentors ?m }
+    MINUS { ?e c:flagged "yes" }
+}
+"""
+
+print("=== EXPLAIN (the fused device program) ===")
+print(QueryEngine(db).explain_device(QUERY))
+
+db.execution_mode = "device"
+dev_rows = execute_query_volcano(QUERY, db)
+db.execution_mode = "host"
+host_rows = execute_query_volcano(QUERY, db)
+assert sorted(dev_rows) == sorted(host_rows)
+n_mentored = sum(1 for r in dev_rows if r[2])
+print(
+    f"\ndevice == host: {len(dev_rows)} rows "
+    f"({n_mentored} with a mentor bound, rest UNBOUND via OPTIONAL)"
+)
+
+mesh = make_mesh(8)
+dist_rows = execute_query_distributed(QUERY, db, mesh)
+assert dist_rows == host_rows
+print(f"distributed (8-device mesh) == host: {len(dist_rows)} rows")
