@@ -56,11 +56,13 @@ while :; do
         log "tunnel UP -> running $name (timeout ${tmo}s)"
         out="$DONE_DIR/$name.out"
         if timeout -s KILL "$tmo" $cmd > "$out" 2>&1; then
-            log "$name OK"
+            log "$name OK; output tail:"
+            tail -30 "$out" >> "$LOG"
             touch "$DONE_DIR/$name"
         else
             rc=$?
-            log "$name FAILED rc=$rc (output kept at $out)"
+            log "$name FAILED rc=$rc; output tail:"
+            tail -15 "$out" >> "$LOG"
             # 137 = KILL timeout = tunnel wedge mid-step: retry next burst.
             # Other rcs are real failures; stamp as attempted to not loop.
             if [ "$rc" != 137 ]; then touch "$DONE_DIR/$name"; fi
