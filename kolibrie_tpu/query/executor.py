@@ -232,9 +232,9 @@ def eval_where(
 
 
 def _branch_plan(db, planner, bw: WhereClause):
-    """Physical plan for a MINUS / NOT-block branch eligible to fuse into
-    the device program as an anti-join; ``None`` when the branch needs the
-    host post-pass (non-BGP content)."""
+    """Physical plan for a clause branch (UNION / OPTIONAL / MINUS / NOT
+    block) eligible to fuse into the device program; ``None`` when the
+    branch needs the host post-pass (non-BGP content)."""
     from kolibrie_tpu.query.subquery_inline import inline_subqueries
 
     bw = inline_subqueries(bw)
