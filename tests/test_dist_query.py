@@ -722,3 +722,17 @@ def test_topk_on_optional_var_dist(mesh):
         for r in execute_query_volcano(q.split(" LIMIT")[0], db)
     }
     assert all(tuple(r) in full for r in dist)
+
+
+def test_aggregate_over_clauses_dist(mesh):
+    db = _anti_db()
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?o (COUNT(?y) AS ?c) WHERE {
+        ?e ex:worksAt ?o .
+        OPTIONAL { ?e ex:knows ?y }
+        MINUS { ?e ex:salary ?s . FILTER(?s > 66000) }
+    } GROUP BY ?o"""
+    host = execute_query_volcano(q, db)
+    dist = execute_query_distributed(q, db, mesh)
+    assert len(host) == 9
+    assert dist == host
