@@ -12,7 +12,6 @@ nested-select benchmark (``kolibrie/benches/my_benchmark.rs:55-113``).
 import jax
 import pytest
 
-from kolibrie_tpu.optimizer.device_engine import Unsupported as DevUnsupported
 from kolibrie_tpu.optimizer.device_engine import lower_plan
 from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
 from kolibrie_tpu.query.executor import (
