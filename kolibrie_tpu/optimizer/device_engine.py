@@ -2238,27 +2238,49 @@ class PreparedQuery:
             raise Unsupported("prepared queries must be SELECTs")
         self.db = db
         self.query = cq.select
+        from kolibrie_tpu.query.ast import WhereClause
+        from kolibrie_tpu.query.executor import _branch_plan
         from kolibrie_tpu.query.subquery_inline import inline_subqueries
 
         # plain sub-SELECTs fold into the BGP (the rewrite every execution
         # path applies), so e.g. the reference's nested-select benchmark
-        # shape (my_benchmark.rs:55-113) prepares as one device program
+        # shape (my_benchmark.rs:55-113) prepares as one device program;
+        # UNION/OPTIONAL/MINUS/NOT fuse as clause branches like the
+        # executor's device path
         where = inline_subqueries(cq.select.where)
-        if (
-            where.subqueries
-            or where.unions
-            or where.optionals
-            or where.minus
-            or where.binds
-            or where.not_blocks
-            or where.window_blocks
-        ):
+        if where.subqueries or where.binds or where.window_blocks:
             raise Unsupported("prepared device queries support BGP+FILTER only")
+        if not where.patterns:
+            raise Unsupported("prepared clause-only groups unsupported")
+        planner = Streamertail(db.get_or_build_stats())
+        union_groups, optional_plans, anti_plans = [], [], []
+        for groups in where.unions:
+            g = [_branch_plan(db, planner, bw) for bw in groups]
+            if any(bp is None for bp in g):
+                raise Unsupported("non-BGP UNION branch in prepared query")
+            union_groups.append(tuple(g))
+        for ow in where.optionals:
+            bp = _branch_plan(db, planner, ow)
+            if bp is None:
+                raise Unsupported("non-BGP OPTIONAL branch in prepared query")
+            optional_plans.append(bp)
+        for bw in list(where.minus) + [
+            WhereClause(patterns=nb.patterns) for nb in where.not_blocks
+        ]:
+            bp = _branch_plan(db, planner, bw)
+            if bp is None:
+                raise Unsupported("non-BGP MINUS/NOT branch in prepared query")
+            anti_plans.append(bp)
         resolved = [resolve_pattern(db, p) for p in where.patterns]
         logical = build_logical_plan(resolved, where.filters, [], where.values)
-        planner = Streamertail(db.get_or_build_stats())
         self.plan = planner.find_best_plan(logical)
-        self.lowered = lower_plan(db, self.plan)
+        self.lowered = lower_plan(
+            db,
+            self.plan,
+            tuple(anti_plans),
+            tuple(union_groups),
+            tuple(optional_plans),
+        )
         if self.lowered.const_checks:
             # run() is dispatch-only by contract; a store-dependent host
             # guard between dispatches would break its timing semantics

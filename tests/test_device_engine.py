@@ -1265,3 +1265,33 @@ def test_union_then_optional_clause_only():
     dev, host = run_both(db, q)
     assert len(host) == 200
     assert sorted(dev) == sorted(host)
+
+
+def test_prepared_query_with_clauses():
+    """PreparedQuery accepts the fused clause surface: calibrate,
+    dispatch-only runs, amortized runs, and fetch all work with
+    union/optional/anti branches in the program."""
+    import jax
+
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s
+        { ?e ex:dept "dept0" } UNION { ?e ex:dept "dept1" }
+        OPTIONAL { ?e ex:knows ?y }
+        MINUS { ?e foaf:workplaceHomepage <http://company3.example/> }
+    }"""
+    prep = PreparedQuery(db, q)
+    prep.calibrate()
+    out = prep.run()
+    jax.block_until_ready(out)
+    rows = prep.fetch(out)
+    db.execution_mode = "host"
+    host = execute_query_volcano(q, db)
+    db.execution_mode = "device"
+    assert rows == sorted(host)
+    assert len(rows) > 0
+    sums, counts = prep.run_amortized(4)
+    import numpy as np
+
+    assert int(np.asarray(counts)[0]) == len(host)
