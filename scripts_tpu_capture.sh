@@ -14,6 +14,8 @@ timeout -s KILL 600  python repros/mosaic_composed_fixpoint_cap_fault.py 4194304
 timeout -s KILL 1200 python repros/pallas_chunked_join_validation.py 2>&1 | tail -6
 # Round-4: nested-subquery headline (reference COMPLEX QUERY, inlined)
 timeout -s KILL 1200 python benches/bench_subquery.py 2>&1 | tail -2
+# Round-4: UNION+OPTIONAL+MINUS fused program vs host pipeline
+timeout -s KILL 1200 python benches/bench_clause_fusion.py 2>&1 | tail -2
 # Round-4: distributed shard-local join, Pallas vs XLA inside shard_map
 # (1-device mesh on the real chip — the KOLIBRIE_PALLAS_DIST decision data)
 timeout -s KILL 1200 python benches/bench_dist_pallas.py 2>&1 | tail -3

@@ -34,6 +34,7 @@ STEPS=(
   "chunked_join_validation|1500|python repros/pallas_chunked_join_validation.py"
   "dist_pallas|1500|python benches/bench_dist_pallas.py"
   "subquery_bench|1200|python benches/bench_subquery.py"
+  "clause_fusion_bench|1200|python benches/bench_clause_fusion.py"
   "rsp_engine|1500|python benches/bench_rsp_engine.py"
   "r2r_incremental|1500|python benches/bench_r2r_incremental.py"
   "repro_rowstart_pass|600|python repros/mosaic_merge_join_rowstart_fault.py 393216"
