@@ -45,7 +45,15 @@ STEPS=(
 )
 
 log "watcher start (pid $$)"
+# Stand down before the driver's own end-of-round bench window so a
+# late tunnel burst isn't consumed by a capture step while bench.py runs
+# (KOLIBRIE_WATCH_DEADLINE: epoch seconds; 0 = no deadline).
+DEADLINE="${KOLIBRIE_WATCH_DEADLINE:-0}"
 while :; do
+    if [ "$DEADLINE" != 0 ] && [ "$(date +%s)" -gt "$DEADLINE" ]; then
+        log "deadline reached; watcher standing down"
+        exit 0
+    fi
     all_done=1
     for step in "${STEPS[@]}"; do
         name="${step%%|*}"; rest="${step#*|}"
