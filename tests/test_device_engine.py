@@ -1295,3 +1295,18 @@ def test_prepared_query_with_clauses():
     import numpy as np
 
     assert int(np.asarray(counts)[0]) == len(host)
+
+
+def test_group_concat_over_minus_uses_fused_prebuilt():
+    """GROUP_CONCAT can't aggregate on device, but the WHERE (with MINUS)
+    still executes as the fused device program; the prebuilt-lowered
+    handoff must not re-apply the MINUS post-pass (fused_clauses flag)."""
+    db = employee_db()
+    q = PREFIXES + """
+    SELECT ?d (GROUP_CONCAT(?e) AS ?c) WHERE {
+        ?e ex:dept ?d
+        MINUS { ?e ex:knows ?y }
+    } GROUP BY ?d"""
+    dev, host = run_both(db, q)
+    assert len(host) == 5
+    assert sorted(dev) == sorted(host)
