@@ -417,3 +417,20 @@ def test_cli_explain_flag(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "scan[" in out and "project ->" in out
+
+
+def test_http_explain_renders_fused_clauses(server):
+    body = post(
+        server,
+        "/explain",
+        {
+            "rdf": TTL,
+            "format": "turtle",
+            "sparql": "PREFIX ex: <http://example.org/> "
+            "SELECT ?a ?b ?c WHERE { ?a ex:knows ?b "
+            "OPTIONAL { ?b ex:knows ?c } "
+            "MINUS { ?a ex:knows ex:carol } }",
+        },
+    )
+    assert "left-outer-join (OPTIONAL)" in body["plan"]
+    assert "anti-join (MINUS/NOT)" in body["plan"]
