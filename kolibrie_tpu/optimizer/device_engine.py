@@ -596,12 +596,45 @@ class LoweredPlan:
                 raise Unsupported(f"constant pattern in {kind} branch")
             return broot, bvars
 
+        def _statically_empty(op) -> bool:
+            """A branch whose plan scans an UNKNOWN constant can never
+            match (the term isn't in the dictionary) — its table is empty
+            for the lifetime of this lowering's store version."""
+            if isinstance(op, (P.PhysIndexScan, P.PhysTableScan)):
+                pat = op.pattern
+                return any(
+                    t.kind == "id" and t.value is None
+                    for t in (pat.subject, pat.predicate, pat.object)
+                )
+            if isinstance(
+                op,
+                (
+                    P.PhysHashJoin,
+                    P.PhysMergeJoin,
+                    P.PhysParallelJoin,
+                    P.PhysNestedLoopJoin,
+                ),
+            ):
+                return _statically_empty(op.left) or _statically_empty(op.right)
+            if isinstance(op, P.PhysStarJoin):
+                return any(_statically_empty(s) for s in op.scans)
+            if isinstance(op, (P.PhysFilter, P.PhysProjection)):
+                return _statically_empty(op.child)
+            return False
+
         # post-pass clauses compose over the main tree in the executor's
         # order — UNION joins, then OPTIONAL left-outers, then MINUS/NOT
         # anti-joins — so the whole group pattern is ONE device program
         for group in union_groups:
+            live = [b for b in group if not _statically_empty(b)]
+            if not live:
+                # every branch scans an unknown constant: the union table
+                # is empty, and joining an empty table empties the result
+                # (host equi_join semantics) — a never-true guard says so
+                self.const_checks.append((None, None, None))
+                continue
             children, all_vars = [], set()
-            for bplan in group:
+            for bplan in live:
                 broot, bvars = _lower_branch(bplan, "UNION")
                 children.append(broot)
                 all_vars |= bvars
@@ -610,6 +643,11 @@ class LoweredPlan:
                 self.root, vars_, uspec, all_vars
             )
         for bplan in optional_plans:
+            if _statically_empty(bplan):
+                # host keeps every left row and fills the branch-only
+                # columns with UNBOUND; synthesizing those columns without
+                # a branch tree isn't worth the spec — host fallback
+                raise Unsupported("OPTIONAL branch with unknown constant")
             broot, bvars = _lower_branch(bplan, "OPTIONAL")
             if self.root is None:
                 # leading OPTIONAL with no group: stands alone (host twin)
@@ -628,6 +666,8 @@ class LoweredPlan:
         for bplan in anti_plans:
             if self.root is None:
                 raise Unsupported("MINUS without a group")
+            if _statically_empty(bplan):
+                continue  # empty branch: MINUS/NOT removes nothing
             broot, bvars = _lower_branch(bplan, "MINUS/NOT")
             shared = tuple(sorted(bvars & vars_))
             if not shared:

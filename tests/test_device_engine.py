@@ -1310,3 +1310,46 @@ def test_group_concat_over_minus_uses_fused_prebuilt():
     dev, host = run_both(db, q)
     assert len(host) == 5
     assert sorted(dev) == sorted(host)
+
+
+def test_empty_branch_clauses():
+    """Branches scanning UNKNOWN constants (absent from the dictionary):
+    MINUS/NOT remove nothing, an all-empty UNION empties the result, a
+    some-empty UNION uses the live branches — all still on device."""
+    db = employee_db()
+    q1 = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        MINUS { ?e ex:no_such_predicate ?y }
+    }"""
+    dev, host = run_both(db, q1)
+    assert len(dev) == 500
+    assert sorted(dev) == sorted(host)
+
+    q2 = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        { ?e ex:no_such_a "x" } UNION { ?e ex:no_such_b "y" }
+    }"""
+    dev, host = run_both(db, q2)
+    assert dev == host == []
+
+    q3 = PREFIXES + """
+    SELECT ?e ?s WHERE {
+        ?e ex:salary ?s
+        { ?e ex:no_such_a "x" } UNION { ?e ex:dept "dept0" }
+    }"""
+    dev, host = run_both(db, q3)
+    assert len(dev) == 100
+    assert sorted(dev) == sorted(host)
+
+    # OPTIONAL over an unknown predicate: host semantics (left kept,
+    # UNBOUND fill) via fallback — rows must still agree
+    q4 = PREFIXES + """
+    SELECT ?e ?s ?y WHERE {
+        ?e ex:salary ?s
+        OPTIONAL { ?e ex:no_such ?y }
+    }"""
+    dev, host = run_both(db, q4)
+    assert len(dev) == 500
+    assert sorted(dev) == sorted(host)
