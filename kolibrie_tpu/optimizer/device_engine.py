@@ -596,6 +596,38 @@ class LoweredPlan:
                 raise Unsupported(f"constant pattern in {kind} branch")
             return broot, bvars
 
+        def _phys_vars(op) -> set:
+            """Variable set a physical branch plan WOULD bind — used for
+            statically-empty UNION branches, which are dropped from the
+            fused tree but whose variables the host post-pass still
+            synthesizes as UNBOUND-filled columns (executor.py union
+            normalize): the device union must carry them too, or SELECT *
+            arity diverges between the engines."""
+            if isinstance(op, (P.PhysIndexScan, P.PhysTableScan)):
+                # pattern.variables() recurses into quoted (RDF-star)
+                # terms, whose inner variables the host also synthesizes
+                return set(op.pattern.variables())
+            if isinstance(
+                op,
+                (
+                    P.PhysHashJoin,
+                    P.PhysMergeJoin,
+                    P.PhysParallelJoin,
+                    P.PhysNestedLoopJoin,
+                ),
+            ):
+                return _phys_vars(op.left) | _phys_vars(op.right)
+            if isinstance(op, P.PhysStarJoin):
+                out: set = set()
+                for s in op.scans:
+                    out |= _phys_vars(s)
+                return out
+            if isinstance(op, (P.PhysFilter, P.PhysProjection)):
+                return _phys_vars(op.child)
+            if isinstance(op, P.PhysValues):
+                return set(op.values.variables)
+            return set()
+
         def _statically_empty(op) -> bool:
             """A branch whose plan scans an UNKNOWN constant can never
             match (the term isn't in the dictionary) — its table is empty
@@ -638,6 +670,11 @@ class LoweredPlan:
                 broot, bvars = _lower_branch(bplan, "UNION")
                 children.append(broot)
                 all_vars |= bvars
+            # dropped (statically-empty) branches contribute no rows but
+            # DO contribute columns: UNBOUND(0)-filled, like the host
+            for bplan in group:
+                if not any(bplan is lv for lv in live):
+                    all_vars |= _phys_vars(bplan)
             uspec = UnionSpec(tuple(children), tuple(sorted(all_vars)))
             self.root, vars_ = self._make_join(
                 self.root, vars_, uspec, all_vars

@@ -761,7 +761,12 @@ class DistQueryExecutor:
         elif not q.select_all() and any(i.kind != "var" for i in q.select):
             raise Unsupported("expressions in SELECT")
         elif q.select_all():
-            self.out_vars = tuple(sorted(full_bound))
+            # internal variables (subquery-inline renames, "__sq*") are
+            # never user-visible: keeping them here would make the
+            # mesh-side DISTINCT dedup over hidden columns and disagree
+            # with the host engine (which drops them before dedup)
+            visible = [v for v in sorted(full_bound) if not v.startswith("__")]
+            self.out_vars = tuple(visible) or tuple(sorted(full_bound))[:1]
         elif self.binds:
             # binds may reference any pattern variable: gather them ALL,
             # apply binds host-side, project afterwards (run())
@@ -905,39 +910,130 @@ class DistQueryExecutor:
                 m &= scan[a] == scan[b]
             return {v: scan[pos][m] for v, pos in prem.vars}
 
-        table = table_of(self.premises[self.seed])
-        n_rows = len(next(iter(table.values()))) if table else 0
-        max_rows = n_rows
-        for j, kv, kpos, extra in self.steps:
-            ptab = table_of(self.premises[j])
-            lk, rk = table[kv], ptab[kv]
-            order = np.argsort(rk, kind="stable")
-            rs = rk[order]
-            lo = np.searchsorted(rs, lk, side="left")
-            counts = np.searchsorted(rs, lk, side="right") - lo
-            total = int(counts.sum())
+        class _Blowup(Exception):
+            pass
+
+        def walk_chain(premises, seed, steps):
+            """(max intermediate rows, final table) of one premise chain —
+            the same machinery for the main BGP and every clause branch."""
+            table = table_of(premises[seed])
+            n_rows = len(next(iter(table.values()))) if table else 0
+            max_rows = n_rows
+            for j, kv, kpos, extra in steps:
+                ptab = table_of(premises[j])
+                lk, rk = table[kv], ptab[kv]
+                order = np.argsort(rk, kind="stable")
+                rs = rk[order]
+                lo = np.searchsorted(rs, lk, side="left")
+                counts = np.searchsorted(rs, lk, side="right") - lo
+                total = int(counts.sum())
+                if total > self._CALIBRATE_ROW_LIMIT:
+                    raise _Blowup
+                # expand (li, ri) straight from the bounds already in hand
+                li = np.repeat(np.arange(len(lk)), counts)
+                offs = np.concatenate(([0], np.cumsum(counts[:-1]))) if len(
+                    counts
+                ) else np.zeros(0, dtype=np.int64)
+                pos = np.arange(total) - np.repeat(offs, counts) + np.repeat(
+                    lo, counts
+                )
+                ri = order[pos]
+                new_table = {v: c[li] for v, c in table.items()}
+                keep = np.ones(total, dtype=bool)
+                for v, c in ptab.items():
+                    if v not in new_table:
+                        new_table[v] = c[ri]
+                    elif v in extra:
+                        keep &= new_table[v] == c[ri]
+                # pre-mask size is what the static join output must hold;
+                # masked rows stay in the buffer as invalid
+                max_rows = max(max_rows, total)
+                table = {v: c[keep] for v, c in new_table.items()}
+            return max_rows, table
+
+        def count_and_join(table, btable, keys):
+            """Clause join on the mesh program's shared-key route: returns
+            (pre-mask join total, joined table restricted to the host
+            emulation's needs) — sizes the ``join_cap`` the ``_dj`` of
+            this clause must hold."""
+            from kolibrie_tpu.ops.join import _pack_shared_keys, join_indices
+
+            ln = len(next(iter(table.values()))) if table else 0
+            rn = len(next(iter(btable.values()))) if btable else 0
+            if ln == 0 or rn == 0:
+                return 0, {
+                    v: np.empty(0, dtype=np.uint32)
+                    for v in set(table) | set(btable)
+                }
+            lk, rk = _pack_shared_keys(table, btable, list(keys), ln)
+            li, ri = join_indices(lk, rk)
+            total = len(li)
             if total > self._CALIBRATE_ROW_LIMIT:
-                return heuristic, heuristic
-            # expand (li, ri) straight from the bounds already in hand
-            li = np.repeat(np.arange(len(lk)), counts)
-            offs = np.concatenate(([0], np.cumsum(counts[:-1]))) if len(
-                counts
-            ) else np.zeros(0, dtype=np.int64)
-            pos = np.arange(total) - np.repeat(offs, counts) + np.repeat(
-                lo, counts
-            )
-            ri = order[pos]
-            new_table = {v: c[li] for v, c in table.items()}
-            keep = np.ones(total, dtype=bool)
-            for v, c in ptab.items():
-                if v not in new_table:
-                    new_table[v] = c[ri]
-                elif v in extra:
-                    keep &= new_table[v] == c[ri]
-            # pre-mask size is what the static join output must hold;
-            # masked rows stay in the buffer as invalid
-            max_rows = max(max_rows, total)
-            table = {v: c[keep] for v, c in new_table.items()}
+                raise _Blowup
+            out = {v: c[li] for v, c in table.items()}
+            for v, c in btable.items():
+                if v not in out:
+                    out[v] = c[ri]
+            return total, out
+
+        try:
+            max_rows, table = walk_chain(self.premises, self.seed, self.steps)
+            # Clause pipelines run through the SAME static buffers: their
+            # chain intermediates, their clause-join totals, and the
+            # grown post-OPTIONAL tables all have to fit, or the first
+            # dispatch overflows and pays recompiles at doubled caps.
+            for branches, gvars, gkeys in self.union_specs:
+                parts = []
+                for bprem, bseed, bsteps, _bf in branches:
+                    bmax, btab = walk_chain(bprem, bseed, bsteps)
+                    max_rows = max(max_rows, bmax)
+                    parts.append(btab)
+                un = sum(
+                    len(next(iter(t.values()))) if t else 0 for t in parts
+                )
+                ucols = {}
+                for v in gvars:
+                    ucols[v] = np.concatenate(
+                        [
+                            t[v]
+                            if v in t
+                            else np.zeros(
+                                len(next(iter(t.values()))) if t else 0,
+                                dtype=np.uint32,
+                            )
+                            for t in parts
+                        ]
+                    ) if parts else np.empty(0, dtype=np.uint32)
+                max_rows = max(max_rows, un)
+                total, table = count_and_join(table, ucols, gkeys)
+                max_rows = max(max_rows, total)
+            for oprem, oseed, osteps, _of, ovars, okeys in self.optional_specs:
+                bmax, btab = walk_chain(oprem, oseed, osteps)
+                max_rows = max(max_rows, bmax)
+                total, joined = count_and_join(table, btab, okeys)
+                # OPTIONAL output = matches + every left row (mesh concat)
+                grown = total + (
+                    len(next(iter(table.values()))) if table else 0
+                )
+                if grown > self._CALIBRATE_ROW_LIMIT:
+                    raise _Blowup
+                max_rows = max(max_rows, grown)
+                n_l = len(next(iter(table.values()))) if table else 0
+                out = {}
+                for v in set(table) | set(joined):
+                    left_part = table.get(
+                        v, np.zeros(n_l, dtype=np.uint32)
+                    )
+                    join_part = joined.get(
+                        v, np.zeros(total, dtype=np.uint32)
+                    )
+                    out[v] = np.concatenate([join_part, left_part])
+                table = out
+            for bprem, bseed, bsteps, _bf, bkeys in self.anti:
+                bmax, _btab = walk_chain(bprem, bseed, bsteps)
+                max_rows = max(max_rows, bmax)  # anti only shrinks the main
+        except _Blowup:
+            return heuristic, heuristic
         per_shard = -(-max(max_rows, 1) // self.n)
         cap = round_cap(4 * per_shard, 256)
         return cap, cap

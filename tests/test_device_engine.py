@@ -1343,6 +1343,31 @@ def test_empty_branch_clauses():
     assert len(dev) == 100
     assert sorted(dev) == sorted(host)
 
+    # ADVICE r4 (medium): SELECT * with a some-empty UNION — the dropped
+    # branch's variables must still surface as UNBOUND-filled columns so
+    # the device arity matches the host post-pass (4 columns, not 3)
+    q3b = PREFIXES + """
+    SELECT * WHERE {
+        ?e ex:salary ?s
+        { ?e ex:dept ?d } UNION { ?e ex:no_such_c ?z }
+    }"""
+    dev, host = run_both(db, q3b)
+    assert len(host) > 0
+    assert len(host[0]) == 4  # e, s, d, z (z all-UNBOUND)
+    assert sorted(dev) == sorted(host)
+
+    # ... and a dropped branch whose QUOTED term carries inner variables
+    # (?x ?y) must surface those too (PatternTriple.variables recursion)
+    q3c = PREFIXES + """
+    SELECT * WHERE {
+        ?e ex:salary ?s
+        { ?e ex:dept ?d } UNION { << ?x ex:no_such_r ?y >> ex:no_such_p ?c }
+    }"""
+    dev, host = run_both(db, q3c)
+    assert len(host) > 0
+    assert len(host[0]) == 6  # e, s, d, c, x, y (c/x/y all-UNBOUND)
+    assert sorted(dev) == sorted(host)
+
     # OPTIONAL over an unknown predicate: host semantics (left kept,
     # UNBOUND fill) via fallback — rows must still agree
     q4 = PREFIXES + """

@@ -736,3 +736,33 @@ def test_aggregate_over_clauses_dist(mesh):
     dist = execute_query_distributed(q, db, mesh)
     assert len(host) == 9
     assert dist == host
+
+
+def test_calibration_covers_branch_pipelines(mesh):
+    """ADVICE r4 (low): _calibrate_caps must size the static buffers from
+    the clause-branch pipelines too, not just the main premise chain —
+    a branch-heavy query would otherwise overflow on first dispatch and
+    pay recompiles at doubled caps."""
+    db = SparqlDatabase()
+    lines = []
+    for i in range(100):
+        e = f"<http://example.org/e{i}>"
+        lines.append(f"{e} <http://example.org/p1> <http://example.org/a{i}> .")
+        for j in range(100):  # OPTIONAL branch: 100x the main chain
+            lines.append(
+                f"{e} <http://example.org/p2> <http://example.org/b{j}> ."
+            )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """PREFIX ex: <http://example.org/>
+    SELECT ?e ?a ?b WHERE {
+        ?e ex:p1 ?a .
+        OPTIONAL { ?e ex:p2 ?b }
+    }"""
+    ex = DistQueryExecutor(mesh, db, q)
+    # branch table = 10_000 rows; OPTIONAL output grows to matches + left.
+    # Main-chain-only calibration would give the 4*100/8-row floor (256).
+    assert ex.join_cap >= 4 * 10_000 // 8
+    dist = ex.run()
+    host = execute_query_volcano(q, db)
+    assert dist == host

@@ -307,6 +307,35 @@ def test_distributed_subquery_agreement(mesh):
     assert dist == host
 
 
+def test_distributed_distinct_star_subquery(mesh):
+    """ADVICE r4 (high): SELECT DISTINCT * with an inlinable sub-SELECT —
+    the mesh DISTINCT must dedup over the VISIBLE projection only, not the
+    internal __sq* columns the inliner creates (those take several values
+    per visible row, so deduping over them resurrects duplicates)."""
+    from kolibrie_tpu.parallel.dist_query import execute_query_distributed
+
+    db = SparqlDatabase()
+    db.parse_turtle(
+        """
+    @prefix ex: <http://example.org/> .
+    ex:alice ex:worksAt ex:acme .
+    ex:acme ex:city ex:north ; ex:city ex:south .
+    """
+    )
+    db.execution_mode = "host"
+    sparql = (
+        EX
+        + """SELECT DISTINCT * WHERE {
+          ?e ex:worksAt ?c .
+          { SELECT ?c WHERE { ?c ex:city ?cc } }
+        }"""
+    )
+    host = execute_query_volcano(sparql, db)
+    dist = execute_query_distributed(sparql, db, mesh)
+    assert len(host) == 1
+    assert dist == host
+
+
 class TestSelectStar:
     def test_star_excludes_scoped_vars(self, db):
         from kolibrie_tpu.query.executor import execute_select
