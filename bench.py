@@ -109,10 +109,14 @@ def main():
     # ---- host baseline: full e2e and operator-pipeline-only --------------
     db.execution_mode = "host"
     host_e2e = float("inf")
-    for _ in range(3):
+    host_e2e_cold = None
+    for _ in range(4):
         t0 = time.perf_counter()
         host_rows = execute_query_volcano(JOIN_QUERY, db)
-        host_e2e = min(host_e2e, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if host_e2e_cold is None:
+            host_e2e_cold = dt  # first call: parse+plan+display-cache build
+        host_e2e = min(host_e2e, dt)
 
     note(f"host e2e done ({host_e2e:.2f}s best)")
     prep = PreparedQuery(db, JOIN_QUERY)
@@ -123,6 +127,50 @@ def main():
         t0 = time.perf_counter()
         _table, _counts = prep.lowered.host_execute()
         host_exec = min(host_exec, time.perf_counter() - t0)
+
+    # ---- native (threaded C++) twin of the same operator pipeline --------
+    # Baseline floor for what the reference's SIMD+rayon join achieves on
+    # one node (shared/src/join_algorithm.rs:19-131): scans through the
+    # store's sorted orders, kn_join_u32 on subject, native column gathers.
+    # vs_baseline divides by the STRONGEST host engine (numpy or native).
+    native_exec = None
+    try:
+        from kolibrie_tpu.native.join_native import (
+            available as native_available,
+            gather_native,
+            join_indices_native,
+        )
+
+        if native_available():
+            pid_w = db.dictionary.lookup(
+                "http://xmlns.com/foaf/0.1/workplaceHomepage"
+            )
+            pid_s = db.dictionary.lookup(
+                "https://data.example/ontology#annual_salary"
+            )
+
+            def native_pipeline():
+                s1, _p1, o1 = db.store.match(p=pid_w)
+                s2, _p2, o2 = db.store.match(p=pid_s)
+                li, ri = join_indices_native(s1, s2)
+                return (
+                    gather_native(s1, li),
+                    gather_native(o1, li),
+                    gather_native(o2, ri),
+                )
+
+            e_col, _w, _v = native_pipeline()  # warm (thread pool, caches)
+            assert len(e_col) == len(host_rows), (
+                f"native twin rows {len(e_col)} != host {len(host_rows)}"
+            )
+            native_exec = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                native_pipeline()
+                native_exec = min(native_exec, time.perf_counter() - t0)
+    except Exception as e:  # never let the twin kill the capture
+        note(f"native twin unavailable: {e}")
+    host_best = min(host_exec, native_exec) if native_exec else host_exec
 
     # ---- device: warm, then timed dispatches (NO readback in the loop) ---
     out = prep.run()
@@ -181,6 +229,32 @@ def main():
 
     assert int(np.asarray(outk[1])[0]) == len(host_rows)
 
+    # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
+    # amortization caveat): embedded from the watcher-captured artifact
+    # so the headline file carries them without re-running a 4M-triple
+    # build inside the bench attempt window.
+    lubm = None
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LUBM1000.json")
+        ) as f:
+            lrec = json.load(f)
+        lubm = {"captured": lrec.get("date")}
+        for r in lrec.get("results", []):
+            m = r.get("metric", "")
+            if m in (
+                "lubm_q2_host_wall_clock",
+                "lubm_q9_host_wall_clock",
+                "lubm_q2_device_wall_clock",
+                "lubm_q9_device_wall_clock",
+            ):
+                lubm[m + "_ms"] = r.get("ms")
+                if r.get("rows") is not None:
+                    lubm[m + "_rows"] = r.get("rows")
+    except (OSError, ValueError):
+        pass
+
     throughput = N_TRIPLES / dev_tk
     print(
         json.dumps(
@@ -188,13 +262,21 @@ def main():
                 "metric": f"bgp_join_employee100k_engine_triples_per_sec_{platform}",
                 "value": round(throughput, 1),
                 "unit": "triples/sec/chip",
-                "vs_baseline": round(host_exec / dev_tk, 3),
+                "vs_baseline": round(host_best / dev_tk, 3),
+                # first-class unamortized pair: ONE dispatch of the plan,
+                # tunnel latency and all — no amortization caveat needed
+                "value_single_dispatch": round(N_TRIPLES / dev_t, 1),
+                "vs_baseline_single_dispatch": round(host_best / dev_t, 3),
                 "secondary": {
                     "plan_exec_amortized_ms": round(1000 * dev_tk, 4),
                     "single_dispatch_ms": round(1000 * dev_t, 3),
                     "single_dispatch_triples_per_sec": round(N_TRIPLES / dev_t, 1),
                     "host_engine_exec_ms": round(1000 * host_exec, 3),
+                    "host_native_engine_exec_ms": (
+                        round(1000 * native_exec, 3) if native_exec else None
+                    ),
                     "host_e2e_ms": round(1000 * host_e2e, 2),
+                    "host_e2e_cold_ms": round(1000 * host_e2e_cold, 2),
                     "pallas_join_exec_ms": (
                         round(1000 * pallas_tk, 4) if platform == "tpu" else None
                     ),
@@ -206,12 +288,17 @@ def main():
                     ),
                     "rows": len(rows),
                     "bulk_load_s": round(t_load, 3),
-                    "note": "public-API prepared query: SPARQL parse + "
-                    "Streamertail plan once, then the plan's single XLA "
-                    "program over device-resident store orders; value = "
-                    f"throughput amortized over {scan_k} executions/dispatch "
-                    "(materialized columns produced every iteration); rows "
-                    "verified equal to the host numpy engine",
+                    "lubm1000": lubm,
+                    "note": "public-API query: SPARQL parse + Streamertail "
+                    "plan cached automatically on the database (round 5), "
+                    "then the plan's single XLA program over device-resident "
+                    "store orders; value = throughput amortized over "
+                    f"{scan_k} executions/dispatch (materialized columns "
+                    "produced every iteration), value_single_dispatch = one "
+                    "plan execution per dispatch; vs_baseline divides by "
+                    "the best host engine (max of numpy pipeline and the "
+                    "threaded C++ native twin); rows verified equal to the "
+                    "host numpy engine",
                 },
             }
         )

@@ -74,7 +74,18 @@ def make_sds(n: int, update_ratio_percent: int) -> Sds:
     return sds
 
 
-def run_sweep(sizes=(100, 1000, 5000), ratios=(1, 10, 50, 100)):
+def run_sweep(
+    sizes=(100, 500, 1_000, 5_000, 10_000, 50_000),
+    ratios=(1, 10, 50, 100),
+):
+    """Full reference grid (citybench_cross_window_compare.rs:29-30):
+    sizes {100, 500, 1k, 5k, 10k, 50k} x update ratios {1, 10, 50, 100}%.
+    Pass KOLIBRIE_CITYBENCH_QUICK=1 for the reduced smoke grid."""
+    import os
+
+    if os.environ.get("KOLIBRIE_CITYBENCH_QUICK"):
+        sizes = (100, 1000, 5000)
+    records = []
     for n in sizes:
         for ratio in ratios:
             dictionary = Dictionary()
@@ -108,21 +119,24 @@ def run_sweep(sizes=(100, 1000, 5000), ratios=(1, 10, 50, 100)):
             )
             naive_results = {tuple(t) for t in naive_out.get(RESULT, [])}
             inc_results = {tuple(t) for t in ext.get(RESULT, [])}
-            print(
-                json.dumps(
-                    {
-                        "metric": "cross_window_sds_plus",
-                        "size": n,
-                        "update_ratio_pct": ratio,
-                        "naive_ms": round(1000 * t_naive, 2),
-                        "incremental_ms": round(1000 * t_inc, 2),
-                        "speedup": round(t_naive / max(t_inc, 1e-9), 2),
-                        "agree": naive_results == inc_results,
-                        "derived": len(naive_results),
-                    }
-                )
-            )
+            rec = {
+                "metric": "cross_window_sds_plus",
+                "size": n,
+                "update_ratio_pct": ratio,
+                "naive_ms": round(1000 * t_naive, 2),
+                "incremental_ms": round(1000 * t_inc, 2),
+                "speedup": round(t_naive / max(t_inc, 1e-9), 2),
+                "agree": naive_results == inc_results,
+                "derived": len(naive_results),
+            }
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+    return records
 
 
 if __name__ == "__main__":
-    run_sweep()
+    recs = run_sweep()
+    # checked-in sweep artifact (VERDICT r4 item 9): the full grid's rows
+    out = Path(__file__).resolve().parent.parent / "CITYBENCH_SWEEP.json"
+    out.write_text(json.dumps({"grid": recs}, indent=1))
+    print(f"wrote {out} ({len(recs)} grid points)")

@@ -17,6 +17,19 @@ QUOTED_BIT = 0x8000_0000
 MAX_PLAIN_ID = 0x7FFF_FFFF
 
 
+def display_form(s: Optional[str]) -> str:
+    """Human-facing form of a stored term: literal quotes stripped
+    (``executor._format_value`` semantics).  Maintained incrementally at
+    intern time so result formatting never re-walks the dictionary."""
+    if not s:
+        return ""
+    if s[0] == '"':
+        end = s.rfind('"')
+        if end > 0:
+            return s[1:end]
+    return s
+
+
 def is_quoted_triple_id(term_id: int) -> bool:
     """True if the ID refers to a quoted triple ``<< s p o >>`` (bit 31 set)."""
     return bool(term_id & QUOTED_BIT)
@@ -29,11 +42,12 @@ class Dictionary:
     for padding.  Plain-term IDs start at 1 and must stay below 2^31.
     """
 
-    __slots__ = ("str_to_id", "id_to_str", "_next_id")
+    __slots__ = ("str_to_id", "id_to_str", "display", "_next_id")
 
     def __init__(self) -> None:
         self.str_to_id: Dict[str, int] = {}
         self.id_to_str: List[Optional[str]] = [None]  # index 0 = NULL sentinel
+        self.display: List[str] = [""]  # display_form per ID, same order
         self._next_id = 1
 
     def __len__(self) -> int:
@@ -50,6 +64,7 @@ class Dictionary:
         self._next_id = eid + 1
         self.str_to_id[s] = eid
         self.id_to_str.append(s)
+        self.display.append(display_form(s))
         return eid
 
     def encode_many(self, strs: Iterable[str]) -> List[int]:
@@ -65,6 +80,8 @@ class Dictionary:
             return self.encode_many(strs)
         sti = self.str_to_id
         its_append = self.id_to_str.append
+        dis_append = self.display.append
+        disp = display_form
         get = sti.get
         nid = self._next_id
         out = []
@@ -76,6 +93,7 @@ class Dictionary:
                 nid += 1
                 sti[s] = eid
                 its_append(s)
+                dis_append(disp(s))
             append(eid)
         self._next_id = nid
         return out
@@ -125,9 +143,20 @@ class Dictionary:
             remap[oid] = self.encode(s)
         return remap
 
+    def display_forms(self) -> List[str]:
+        """Display form per ID, resynced if ``id_to_str`` was replaced
+        wholesale (checkpoint restore assigns it directly)."""
+        disp, its = self.display, self.id_to_str
+        if len(disp) > len(its):
+            del disp[len(its):]
+        elif len(disp) < len(its):
+            disp.extend(display_form(s) for s in its[len(disp):])
+        return disp
+
     def clone(self) -> "Dictionary":
         d = Dictionary.__new__(Dictionary)
         d.str_to_id = dict(self.str_to_id)
         d.id_to_str = list(self.id_to_str)
+        d.display = list(self.display)
         d._next_id = self._next_id
         return d

@@ -1754,7 +1754,7 @@ def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> L
 
 
 def try_device_execute(
-    db, plan, anti_plans=(), union_groups=(), optional_plans=()
+    db, plan, anti_plans=(), union_groups=(), optional_plans=(), capture=None
 ) -> Optional[BindingTable]:
     """Device path if the plan is expressible, else ``None`` (host fallback).
 
@@ -1762,11 +1762,18 @@ def try_device_execute(
     anti-joins); ``union_groups``: per-UNION-group tuples of branch plans
     (device concat + join); ``optional_plans``: OPTIONAL branch plans
     (device left-outer joins).  All compose over the main tree in the host
-    post-pass order, so the whole group pattern is one device program."""
+    post-pass order, so the whole group pattern is one device program.
+    ``capture``: plan-cache entry — records the lowered program (``False``
+    when this plan cannot lower) so the next identical query skips
+    lowering/compilation entirely."""
     try:
         lowered = lower_plan(db, plan, anti_plans, union_groups, optional_plans)
     except Unsupported:
+        if capture is not None:
+            capture["lowered"] = False
         return None
+    if capture is not None:
+        capture["lowered"] = lowered
     return lowered.execute()
 
 
@@ -2386,6 +2393,4 @@ class PreparedQuery:
         from kolibrie_tpu.query.executor import format_results
 
         table = self.lowered.to_table(*self.lowered.converge(out))
-        rows = format_results(self.db, table, self.query)
-        rows.sort()
-        return rows
+        return format_results(self.db, table, self.query, sort_rows=True)

@@ -1144,9 +1144,7 @@ class DistQueryExecutor:
             apos,
         )
         table = _order_table(self.db, table, q.order_by)
-        rows = format_results(self.db, table, q)
-        if not q.order_by:
-            rows.sort()
+        rows = format_results(self.db, table, q, sort_rows=not q.order_by)
         return _apply_limit_offset(rows, q)
 
     def _run_with_binds(self) -> List[List[str]]:
@@ -1184,9 +1182,7 @@ class DistQueryExecutor:
         if q.distinct and table:
             table = unique_table(table)
         table = _order_table(self.db, table, q.order_by)
-        rows = format_results(self.db, table, q)
-        if not q.order_by:
-            rows.sort()
+        rows = format_results(self.db, table, q, sort_rows=not q.order_by)
         return _apply_limit_offset(rows, q)
 
     def run(self) -> List[List[str]]:
@@ -1237,9 +1233,9 @@ class DistQueryExecutor:
         }
         # DISTINCT already happened on the mesh (owner-shard dedup)
         table = _order_table(self.db, table, self.query.order_by)
-        rows = format_results(self.db, table, self.query)
-        if not self.query.order_by:
-            rows.sort()
+        rows = format_results(
+            self.db, table, self.query, sort_rows=not self.query.order_by
+        )
         return _apply_limit_offset(rows, self.query)
 
 

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kolibrie_tpu.core.dictionary import QUOTED_BIT, display_form
 from kolibrie_tpu.core.triple import Triple
 from kolibrie_tpu.optimizer.engine import UNBOUND, ExecutionEngine, resolve_pattern
 from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
@@ -72,6 +73,7 @@ def eval_where(
     use_optimizer: bool = True,
     prebuilt_plan=None,
     prebuilt_lowered=None,
+    capture=None,
 ) -> BindingTable:
     """Evaluate a group graph pattern to a binding table (IDs).
 
@@ -80,7 +82,9 @@ def eval_where(
     here instead of running the optimizer twice).  ``prebuilt_lowered``:
     the matching device-lowered plan — an object to execute directly,
     ``False`` if lowering already failed (skip the device path), None if
-    no lowering was attempted yet."""
+    no lowering was attempted yet.  ``capture``: plan-cache entry dict —
+    the plan and the lowered program (or ``False`` for a failed lowering)
+    are recorded into it for reuse by the next identical query."""
     from kolibrie_tpu.query.subquery_inline import inline_subqueries
 
     # Fold plain sub-SELECTs into the group before planning: one plan (and
@@ -105,6 +109,8 @@ def eval_where(
         else:
             logical = build_logical_plan(resolved, plan_filters, [], where.values)
             plan = planner.find_best_plan(logical)
+        if capture is not None:
+            capture["plan"] = plan
         table = None
         if prebuilt_lowered is not None and prebuilt_lowered is not False:
             table = prebuilt_lowered.execute()
@@ -167,10 +173,11 @@ def eval_where(
                         tuple(anti_plans),
                         tuple(union_groups),
                         tuple(optional_plans),
+                        capture=capture,
                     )
                     fused_clauses = table is not None
             if table is None:
-                table = try_device_execute(db, plan)
+                table = try_device_execute(db, plan, capture=capture)
         if table is None:
             table = engine.execute_with_ids(plan)
     else:
@@ -308,9 +315,15 @@ def _naive_eval(
 # --------------------------------------------------------------------------
 
 
-def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> BindingTable:
+def eval_select_to_table(
+    db, q: SelectQuery, use_optimizer: bool = True, cache_entry=None
+) -> BindingTable:
     """Run a SELECT down to a binding table projected to its variables
-    (aggregates resolved).  Used for subqueries and ML input queries."""
+    (aggregates resolved).  Used for subqueries and ML input queries.
+
+    ``cache_entry``: automatic plan-cache slot (see ``_plan_cache_entry``)
+    — a populated entry's plan/lowered program short-circuit the planner
+    and device lowering; a fresh one captures them for the next call."""
     prebuilt_plan = None
     prebuilt_lowered = None
     if q.group_by or any(i.kind == "agg" for i in q.select):
@@ -321,12 +334,19 @@ def eval_select_to_table(db, q: SelectQuery, use_optimizer: bool = True) -> Bind
             if q.distinct:
                 table = unique_table(table)
             return table
+        cache_entry = None  # aggregate fallback: prebuilts already in hand
+    if cache_entry is not None:
+        if cache_entry["plan"] is not None:
+            prebuilt_plan = cache_entry["plan"]
+        if cache_entry["lowered"] is not None:
+            prebuilt_lowered = cache_entry["lowered"]
     table = eval_where(
         db,
         q.where,
         use_optimizer,
         prebuilt_plan=prebuilt_plan,
         prebuilt_lowered=prebuilt_lowered,
+        capture=cache_entry,
     )
     if q.group_by or any(i.kind == "agg" for i in q.select):
         table = _group_and_aggregate_table(db, table, q)
@@ -557,14 +577,12 @@ def _order_table(db, table: BindingTable, order_by: List[OrderCondition]) -> Bin
 
 
 def _format_value(term: Optional[str]) -> str:
-    """Human-facing form: strip literal quotes and datatype suffix."""
-    if term is None:
-        return ""
-    if term.startswith('"'):
-        end = term.rfind('"')
-        if end > 0:
-            return term[1:end]
-    return term
+    """Human-facing form: strip literal quotes and datatype suffix.
+
+    THE display rule — delegates to :func:`core.dictionary.display_form`,
+    which the dictionary also applies incrementally at intern time, so the
+    per-ID display cache and this per-term path can never diverge."""
+    return display_form(term)
 
 
 def table_header(table: BindingTable, q: SelectQuery) -> List[str]:
@@ -583,27 +601,123 @@ def table_header(table: BindingTable, q: SelectQuery) -> List[str]:
     return header
 
 
-def format_results(db, table: BindingTable, q: SelectQuery) -> Rows:
+_GLOBAL_RANK_MAX = 1 << 19  # dict sizes past this use per-column ranks
+
+
+def _display_array(db):
+    """(dict_len, display): ``display[id]`` is the human-facing form of
+    every plain dictionary term (object array; ``display[0] == ""`` for
+    UNBOUND).  Maintained INCREMENTALLY: the dictionary appends display
+    forms at intern time, and growth here is one ``np.concatenate`` of the
+    new tail — no full rebuild.  This converts the per-query decode of
+    :func:`format_results` into one fancy index — the decode analogue of
+    the reference's deferred final rayon pass (engine.rs:34-50)."""
+    d = db.dictionary
+    n = d._next_id
+    cache = db.__dict__.get("_display_cache")
+    if cache is not None and cache[0] == n:
+        return cache
+    forms = d.display_forms()
+    if cache is not None and cache[0] < n:
+        disp = np.concatenate(
+            [cache[1], np.array(forms[cache[0]:], dtype=object)]
+        )
+    else:
+        disp = np.array(forms, dtype=object)
+    cache = (n, disp)
+    db.__dict__["_display_cache"] = cache
+    return cache
+
+
+def _display_ranks(db, disp):
+    """``ranks[id]`` = dense rank of ``display[id]`` in lexicographic
+    order, or None when the dictionary is too large for a dictionary-wide
+    sort to amortize (callers rank per column instead).  Built only when a
+    canonical row sort actually needs it, once per dictionary size."""
+    n = len(disp)
+    if n > _GLOBAL_RANK_MAX:
+        return None
+    cache = db.__dict__.get("_display_ranks")
+    if cache is not None and cache[0] == n:
+        return cache[1]
+    if n:
+        _, ranks = np.unique(disp, return_inverse=True)
+        ranks = ranks.astype(np.uint32)
+    else:
+        ranks = np.empty(0, dtype=np.uint32)
+    db.__dict__["_display_ranks"] = (n, ranks)
+    return ranks
+
+
+def format_results(
+    db, table: BindingTable, q: SelectQuery, sort_rows: bool = False
+) -> Rows:
     """Final ID→string decode (engine.rs:34-50 parity).
 
-    Each DISTINCT id per column is decoded once (np.unique + inverse map) —
-    RDF columns are heavily repetitive, so this is the decode analogue of
-    the reference's deferred final rayon pass."""
+    Plain-term columns decode by fancy-indexing the db-level display cache;
+    ``sort_rows=True`` additionally applies the engine's canonical
+    no-ORDER-BY row order (lexicographic by display string) via
+    ``np.lexsort`` over per-ID display ranks — exactly ``rows.sort()``,
+    without materializing rows first.  Columns containing quoted-triple IDs
+    (RDF-star) take the per-unique decode path instead."""
     header = table_header(table, q)
     n = table_len(table)
-    dec = db.decode_term
-    cols = []
+    if n == 0 or not header:
+        return []
+    id_cols = []
+    any_quoted = False
     for h in header:
         col = table.get(h)
         if col is None:
-            cols.append([""] * n)
+            id_cols.append(None)
             continue
-        uniq, inv = np.unique(np.asarray(col), return_inverse=True)
-        decoded = [
-            _format_value(dec(int(i))) if i != UNBOUND else "" for i in uniq
-        ]
-        cols.append([decoded[j] for j in inv.tolist()])
-    return [list(row) for row in zip(*cols)] if n else []
+        ids = np.asarray(col)
+        if (ids & QUOTED_BIT).any():
+            any_quoted = True
+        id_cols.append(ids)
+    if any_quoted:
+        # rare path: per-unique recursive decode (<< s p o >> rendering)
+        dec = db.decode_term
+        cols = []
+        for ids in id_cols:
+            if ids is None:
+                cols.append([""] * n)
+                continue
+            uniq, inv = np.unique(ids, return_inverse=True)
+            decoded = [
+                _format_value(dec(int(i))) if i != UNBOUND else ""
+                for i in uniq
+            ]
+            cols.append([decoded[j] for j in inv.tolist()])
+        rows = [list(row) for row in zip(*cols)]
+        if sort_rows:
+            rows.sort()
+        return rows
+    dict_len, disp = _display_array(db)
+    safe_cols = [
+        None if ids is None else np.where(ids < dict_len, ids, 0)
+        for ids in id_cols
+    ]
+    if sort_rows:
+        ranks = _display_ranks(db, disp)
+        keys = []
+        for ids in safe_cols:
+            if ids is None:
+                keys.append(np.zeros(n, dtype=np.uint32))
+            elif ranks is not None:
+                keys.append(ranks[ids])
+            else:
+                # dictionary too large for global ranks: dense ranks over
+                # just this column's distinct display strings
+                u_ids, inv = np.unique(ids, return_inverse=True)
+                _, u_rank = np.unique(disp[u_ids], return_inverse=True)
+                keys.append(u_rank.astype(np.uint32)[inv])
+        idx = np.lexsort(tuple(reversed(keys)))
+        safe_cols = [None if c is None else c[idx] for c in safe_cols]
+    out = np.empty((n, len(header)), dtype=object)
+    for j, ids in enumerate(safe_cols):
+        out[:, j] = "" if ids is None else disp[ids]
+    return out.tolist()
 
 
 # --------------------------------------------------------------------------
@@ -617,7 +731,9 @@ def _apply_limit_offset(rows: Rows, q: SelectQuery) -> Rows:
     return rows[start:end]
 
 
-def execute_select(db, q: SelectQuery, use_optimizer: bool = True) -> Rows:
+def execute_select(
+    db, q: SelectQuery, use_optimizer: bool = True, cache_entry=None
+) -> Rows:
     if use_optimizer and q.order_by and q.limit is not None:
         # ORDER BY + LIMIT fused on device: top-k sort, O(limit) readback
         from kolibrie_tpu.optimizer.device_engine import (
@@ -627,11 +743,9 @@ def execute_select(db, q: SelectQuery, use_optimizer: bool = True) -> Rows:
         rows = try_device_execute_ordered(db, q)
         if rows is not None:
             return rows
-    table = eval_select_to_table(db, q, use_optimizer)
+    table = eval_select_to_table(db, q, use_optimizer, cache_entry=cache_entry)
     table = _order_table(db, table, q.order_by)
-    rows = format_results(db, table, q)
-    if not q.order_by:
-        rows.sort()
+    rows = format_results(db, table, q, sort_rows=not q.order_by)
     return _apply_limit_offset(rows, q)
 
 
@@ -687,11 +801,73 @@ def process_delete_clause(db, delete: DeleteClause) -> int:
     return count
 
 
+_PLAN_CACHE_MAX = 128
+
+
+_PLAN_STATES_MAX = 4  # per-query (store version, udfs, mode) slots kept
+
+
+def _plan_cache_entry(db, sparql: str):
+    """Automatic plan cache on the database.  Two granularities:
+
+    - the parsed AST is keyed by (query text, prefix map) — it survives
+      store mutations, so INSERT/SELECT workloads never re-parse;
+    - the physical plan + device-lowered program live in per-state slots
+      keyed by (store version, UDF registry, execution mode), so e.g.
+      host/device alternation keeps BOTH compiled programs warm instead
+      of evicting on every flip.
+
+    Repeat queries through the plain public API get PreparedQuery
+    economics — parse, Streamertail plan, and device
+    lowering/compilation all happen once per state — without opting in
+    (the reference's nom parse + plan is sub-millisecond per call,
+    parser.rs:1036 / optimizer.rs:186; re-lowering a device program here
+    costs far more, so caching is the engine-appropriate answer rather
+    than a faster parser alone).  Returns ``(entry, slot)``; ``slot`` has
+    the ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
+    from collections import OrderedDict
+
+    cache = db.__dict__.get("_plan_cache")
+    if cache is None:
+        cache = OrderedDict()
+        db.__dict__["_plan_cache"] = cache
+    prefix_sig = tuple(sorted(db.prefixes.items()))
+    ent = cache.get(sparql)
+    if ent is None or ent["prefix_sig"] != prefix_sig:
+        ent = {"prefix_sig": prefix_sig, "cq": None, "by_state": {}}
+        cache[sparql] = ent
+    cache.move_to_end(sparql)
+    while len(cache) > _PLAN_CACHE_MAX:
+        cache.popitem(last=False)
+    version = db.store.version
+    state = (
+        version,
+        db.__dict__.get("_udf_version", 0),
+        db.execution_mode,
+    )
+    slot = ent["by_state"].get(state)
+    if slot is None:
+        # stale-version slots pin device-resident copies of OLD store
+        # orders (a LoweredPlan holds full sorted-store copies): drop
+        # them, keeping only the live version's udf/mode variants (same
+        # policy as dist_query's _dist_cap_cache)
+        for k in [k for k in ent["by_state"] if k[0] != version]:
+            ent["by_state"].pop(k)
+        slot = {"plan": None, "lowered": None}
+        ent["by_state"][state] = slot
+        while len(ent["by_state"]) > _PLAN_STATES_MAX:
+            # dicts iterate in insertion order: drop the oldest state
+            ent["by_state"].pop(next(iter(ent["by_state"])))
+    return ent, slot
+
+
 def execute_query_volcano(sparql: str, db) -> Rows:
     """The main query path (execute_query.rs:356 parity)."""
     db.register_prefixes_from_query(sparql)
-    cq = parse_combined_query(sparql, db.prefixes)
-    return execute_combined(db, cq)
+    ent, slot = _plan_cache_entry(db, sparql)
+    if ent["cq"] is None:
+        ent["cq"] = parse_combined_query(sparql, db.prefixes)
+    return execute_combined(db, ent["cq"], cache_entry=slot)
 
 
 def collect_all_patterns(where: WhereClause) -> List[PatternTriple]:
@@ -725,8 +901,26 @@ def _materialize_neural_for_select(db, select: SelectQuery) -> None:
     )
 
 
-def execute_combined(db, cq: CombinedQuery) -> Rows:
+def execute_combined(db, cq: CombinedQuery, cache_entry=None) -> Rows:
     db.prefixes.update(cq.prefixes)
+    if cache_entry is not None and (
+        cq.register is not None
+        or cq.rules
+        or cq.insert is not None
+        or cq.delete is not None
+        or cq.models
+        or cq.neural_relations
+        or cq.train_decls
+        or cq.ml_predict is not None
+    ):
+        # updates / declarations mutate the database (or registries the
+        # cache state key doesn't cover): only plain SELECTs reuse plans
+        cache_entry = None
+    if cache_entry is not None and db.neural_relations:
+        # neural-predicate materialization inserts triples MID-execution,
+        # so the slot's store-version key would not describe the program
+        # captured under it
+        cache_entry = None
     # neural/train declarations
     if cq.models or cq.neural_relations or cq.train_decls or cq.ml_predict:
         from kolibrie_tpu.ml import runtime as ml_runtime
@@ -748,7 +942,7 @@ def execute_combined(db, cq: CombinedQuery) -> Rows:
         # neural predicates referenced anywhere in the query materialize as
         # ordinary triples first (neural_relations.rs parity)
         _materialize_neural_for_select(db, cq.select)
-        return execute_select(db, cq.select)
+        return execute_select(db, cq.select, cache_entry=cache_entry)
     return []
 
 

@@ -257,6 +257,12 @@ class SparqlDatabase:
         db.dictionary.str_to_id = {
             t: i for i, t in enumerate(id_to_str) if t is not None
         }
+        # display is a POSITION-aligned cache of id_to_str; replacing the
+        # term list wholesale requires rebuilding it, or later appends
+        # would extend a misaligned prefix (wrong decoded rows)
+        from kolibrie_tpu.core.dictionary import display_form
+
+        db.dictionary.display = [display_form(t) for t in id_to_str]
         db.dictionary._next_id = len(id_to_str)
         for qid, s_, p_, o_ in data["quoted"].astype(np.uint64).tolist():
             key = (int(s_), int(p_), int(o_))
@@ -484,6 +490,9 @@ class SparqlDatabase:
     def register_udf(self, name: str, fn: Callable) -> None:
         """Parity: ``sparql_database.rs:3164`` UDF registry."""
         self.udfs[name.upper()] = fn
+        # re-registering a name can change semantics of an already-cached
+        # plan whose filters bound the old function: bump the cache state
+        self._udf_version = self.__dict__.get("_udf_version", 0) + 1
 
     # --------------------------------------------------------- numeric cache
 

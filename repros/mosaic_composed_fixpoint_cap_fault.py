@@ -54,4 +54,9 @@ def main(cap: int) -> None:
 
 
 if __name__ == "__main__":
+    import jaxlib
+
+    # version pin: the fault boundary is empirical per toolchain — see
+    # repros/OBSERVED_VERSIONS.md for the observation table
+    print(f"jax {jax.__version__} / jaxlib {jaxlib.__version__}", flush=True)
     main(int(sys.argv[1]) if len(sys.argv) > 1 else 4_194_304)

@@ -34,7 +34,11 @@ import numpy as np
 def main() -> int:
     import jax
     import jax.numpy as jnp
+    import jaxlib
     from jax.sharding import Mesh, PartitionSpec as P
+
+    # version pin: upstream behavior — see repros/OBSERVED_VERSIONS.md
+    print(f"jax {jax.__version__} / jaxlib {jaxlib.__version__}", flush=True)
 
     from kolibrie_tpu.ops.pallas_kernels import merge_join_indices
 

@@ -367,3 +367,199 @@ class TestDatabaseStats:
         st.remove_stats(5, 2, 6)
         assert st.total_triples == 1 and st.predicate_counts[2] == 1.0
         assert st.distinct_subjects == 1 and st.distinct_objects == 1
+
+
+class TestPlanCache:
+    """Automatic plan cache on SparqlDatabase (round 5): repeat queries
+    through the plain public API skip parse + Streamertail plan + device
+    lowering; any store/prefix/UDF/mode change invalidates."""
+
+    def _db(self, n=200):
+        db = SparqlDatabase()
+        lines = []
+        for i in range(n):
+            e = f"<http://e.x/e{i}>"
+            lines.append(f"{e} <http://e.x/works> <http://e.x/c{i % 7}> .")
+            lines.append(f'{e} <http://e.x/sal> "{1000 + i}" .')
+        db.parse_ntriples("\n".join(lines))
+        return db
+
+    Q = (
+        "SELECT ?e ?w ?s WHERE { ?e <http://e.x/works> ?w . "
+        "?e <http://e.x/sal> ?s }"
+    )
+
+    def test_repeat_query_reuses_plan_and_lowered(self):
+        db = self._db()
+        db.execution_mode = "device"
+        r1 = execute_query_volcano(self.Q, db)
+        ent = db.__dict__["_plan_cache"][self.Q]
+        assert ent["cq"] is not None
+        (slot,) = ent["by_state"].values()
+        assert slot["plan"] is not None
+        assert slot["lowered"] not in (None, False)
+        lowered_obj = slot["lowered"]
+        r2 = execute_query_volcano(self.Q, db)
+        assert r2 == r1 and len(r1) == 200
+        # same object still cached — the second run reused it
+        (slot2,) = db.__dict__["_plan_cache"][self.Q]["by_state"].values()
+        assert slot2["lowered"] is lowered_obj
+
+    def test_mode_flip_keeps_both_lowered_states(self):
+        db = self._db()
+        db.execution_mode = "device"
+        dev1 = execute_query_volcano(self.Q, db)
+        db.execution_mode = "host"
+        execute_query_volcano(self.Q, db)
+        db.execution_mode = "device"
+        ent = db.__dict__["_plan_cache"][self.Q]
+        states = ent["by_state"]
+        assert len(states) == 2  # device + host slots coexist
+        dev_slot = next(
+            s for (v, u, m), s in states.items() if m == "device"
+        )
+        lowered_obj = dev_slot["lowered"]
+        assert lowered_obj not in (None, False)
+        assert execute_query_volcano(self.Q, db) == dev1
+        dev_slot2 = next(
+            s
+            for (v, u, m), s in db.__dict__["_plan_cache"][self.Q][
+                "by_state"
+            ].items()
+            if m == "device"
+        )
+        assert dev_slot2["lowered"] is lowered_obj  # flip did not evict
+
+    def test_insert_keeps_parsed_ast(self):
+        db = self._db()
+        db.execution_mode = "host"
+        execute_query_volcano(self.Q, db)
+        cq = db.__dict__["_plan_cache"][self.Q]["cq"]
+        db.parse_ntriples(
+            "<http://e.x/eY> <http://e.x/works> <http://e.x/c2> .\n"
+            '<http://e.x/eY> <http://e.x/sal> "5" .'
+        )
+        r = execute_query_volcano(self.Q, db)
+        assert len(r) == 201
+        # the store bump invalidated the plan slot but NOT the parse
+        assert db.__dict__["_plan_cache"][self.Q]["cq"] is cq
+
+    def test_store_mutation_invalidates(self):
+        db = self._db()
+        db.execution_mode = "host"
+        r1 = execute_query_volcano(self.Q, db)
+        db.parse_ntriples(
+            "<http://e.x/eX> <http://e.x/works> <http://e.x/c0> .\n"
+            '<http://e.x/eX> <http://e.x/sal> "99" .'
+        )
+        r2 = execute_query_volcano(self.Q, db)
+        assert len(r2) == len(r1) + 1
+
+    def test_update_queries_not_cached(self):
+        db = self._db()
+        ins = (
+            'INSERT DATA { <http://e.x/n1> <http://e.x/works> '
+            "<http://e.x/c1> }"
+        )
+        execute_query_volcano(ins, db)
+        execute_query_volcano(ins, db)  # runs again, not replayed from cache
+        rows = execute_query_volcano(
+            "SELECT ?e WHERE { ?e <http://e.x/works> <http://e.x/c1> }", db
+        )
+        assert any(r == ["http://e.x/n1"] for r in rows)
+
+    def test_mode_split(self):
+        db = self._db()
+        db.execution_mode = "host"
+        host = execute_query_volcano(self.Q, db)
+        db.execution_mode = "device"
+        dev = execute_query_volcano(self.Q, db)
+        assert dev == host
+
+    def test_udf_reregistration_invalidates(self):
+        db = self._db(5)
+        db.register_udf("TAG", lambda s: f"v1:{s}")
+        q = (
+            "SELECT ?y WHERE { ?e <http://e.x/sal> ?s . "
+            "BIND(TAG(?s) AS ?y) }"
+        )
+        r1 = execute_query_volcano(q, db)
+        assert all(r[0].startswith("v1:") for r in r1)
+        db.register_udf("TAG", lambda s: f"v2:{s}")
+        r2 = execute_query_volcano(q, db)
+        assert all(r[0].startswith("v2:") for r in r2)
+
+
+class TestFormatDisplayCache:
+    def test_sorted_rows_match_python_sort(self):
+        import random
+
+        import numpy as np
+
+        from kolibrie_tpu.query.executor import (
+            eval_select_to_table,
+            format_results,
+        )
+        from kolibrie_tpu.query.parser import parse_sparql_query
+
+        db = SparqlDatabase()
+        rng = random.Random(7)
+        lines = []
+        for i in range(300):
+            s = f"<http://z.x/s{rng.randrange(40)}>"
+            o = (
+                f'"{rng.randrange(50)}"'
+                if rng.random() < 0.5
+                else f"<http://z.x/o{rng.randrange(30)}>"
+            )
+            lines.append(f"{s} <http://z.x/p> {o} .")
+        db.parse_ntriples("\n".join(lines))
+        q = parse_sparql_query(
+            "SELECT ?a ?b WHERE { ?a <http://z.x/p> ?b }", db.prefixes
+        )
+        table = eval_select_to_table(db, q)
+        fast = format_results(db, table, q, sort_rows=True)
+        slow = format_results(db, table, q)
+        slow.sort()
+        assert fast == slow
+
+    def test_quoted_ids_take_recursive_path(self):
+        from kolibrie_tpu.query.executor import execute_query_volcano as run
+
+        db = SparqlDatabase()
+        db.parse_ntriples(
+            "<< <http://z.x/a> <http://z.x/p> <http://z.x/b> >> "
+            "<http://z.x/saidBy> <http://z.x/carol> ."
+        )
+        rows = run(
+            "SELECT ?t ?w WHERE { ?t <http://z.x/saidBy> ?w }", db
+        )
+        assert rows == [
+            ["<< http://z.x/a http://z.x/p http://z.x/b >>", "http://z.x/carol"]
+        ]
+
+    def test_display_survives_checkpoint_restore(self, tmp_path):
+        db = SparqlDatabase()
+        db.parse_ntriples(
+            '<http://z.x/a> <http://z.x/p> "hello" .'
+        )
+        path = str(tmp_path / "snap.npz")
+        db.checkpoint(path)
+        db2 = SparqlDatabase.from_checkpoint(path)
+        rows = execute_query_volcano(
+            "SELECT ?o WHERE { <http://z.x/a> <http://z.x/p> ?o }", db2
+        )
+        assert rows == [["hello"]]
+        # regression (code-review r5): interning NEW terms after a restore
+        # must not shift the restored IDs' display forms — the display
+        # list is position-aligned and must be rebuilt at restore time
+        db2.parse_ntriples(
+            "<http://z.x/new> <http://z.x/p> <http://z.x/also_new> ."
+        )
+        rows = execute_query_volcano(
+            "SELECT ?s ?o WHERE { ?s <http://z.x/p> ?o }", db2
+        )
+        assert rows == [
+            ["http://z.x/a", "hello"],
+            ["http://z.x/new", "http://z.x/also_new"],
+        ]

@@ -437,6 +437,10 @@ def test_subquery_fuzz_differential():
         with mock.patch.object(sqmod, "inline_subqueries", lambda w: w):
             db.execution_mode = "host"
             legacy = execute_query_volcano(q, db)
+        # the mocked inliner changed parse→plan semantics OUTSIDE the
+        # database's visibility, so the oracle run's cached plan must not
+        # serve the real runs (production never swaps the inliner)
+        db.__dict__.pop("_plan_cache", None)
         db.execution_mode = "host"
         host = execute_query_volcano(q, db)
         db.execution_mode = "device"
