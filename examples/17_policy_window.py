@@ -1,0 +1,56 @@
+"""Declarative policy automation over a sliding window.
+
+Mirrors the reference's policy family
+(``kolibrie/examples/policy/automate_policy.rs:26-57``): what used to be an
+imperative ``set_sliding_window(10, 5)`` + ``auto_policy_evaluation`` loop
+becomes ONE RSP-QL query — a 10-tick window sliding every 5 ticks whose
+firings stream matched policy triples out via RSTREAM to a consumer.
+
+Run: ``python examples/17_policy_window.py``
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.rsp.builder import RSPBuilder  # noqa: E402
+from kolibrie_tpu.rsp.s2r import WindowTriple  # noqa: E402
+
+firings = []
+
+engine = (
+    RSPBuilder(
+        """PREFIX ex: <http://example.org/>
+        REGISTER RSTREAM <http://example.org/out> AS
+        SELECT ?s ?p ?o
+        FROM NAMED WINDOW <http://example.org/policyWindow>
+            ON <http://example.org/policyStream> [RANGE 10 STEP 5]
+        WHERE {
+          WINDOW <http://example.org/policyWindow> { ?s ?p ?o }
+        }"""
+    )
+    .with_consumer(lambda row: firings.append(row))
+    .build()
+)
+
+# feed 20 ticks, one policy event per tick (automate_policy.rs:47-57 feeds
+# the same shape through parse_data + add_to_stream)
+for tick in range(1, 21):
+    engine.add_to_stream(
+        "http://example.org/policyStream",
+        WindowTriple(
+            f"http://example.org/subject{tick}",
+            f"http://example.org/predicate{tick}",
+            f"http://example.org/object{tick}",
+        ),
+        tick,
+    )
+engine.process_single_thread_window_results()
+engine.stop()
+
+print(f"policy window fired {len(firings)} binding rows")
+assert firings, "sliding window never fired"
+# each row is the (s, p, o) of a policy event inside a fired window
+print("first:", firings[0])
+print("last:", firings[-1])

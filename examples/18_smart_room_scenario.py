@@ -1,0 +1,136 @@
+"""Smart-room sensor scenario: RDF/XML ingestion + in-query RULEs driving
+adaptive detection strategy, then grid and authorization queries.
+
+Mirrors the reference's real-scenario walkthrough
+(``kolibrie/examples/real_scenario/real_scenario.rs``): a virtual room's
+sensor snapshot arrives as RDF/XML (:20-273), in-query RULE definitions
+choose a detection strategy from the light/noise levels and mark detection
+events unauthorized (:307-397), inference materializes the conclusions,
+and plain SPARQL then asks for the sensor grid layout and the
+unauthorized events (:455-487).
+
+Run: ``python examples/18_smart_room_scenario.py``
+"""
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano  # noqa: E402
+from kolibrie_tpu.query.sparql_database import SparqlDatabase  # noqa: E402
+
+rng = random.Random(7)
+
+
+def generate_rdf_xml() -> str:
+    """Random sensor values on fixed grid positions (real_scenario.rs:20)."""
+    room_light = rng.randrange(60, 95)
+    room_noise = rng.randrange(20, 35)
+    cam1_motion = rng.random() < 0.7
+    cam2_motion = rng.random() < 0.4
+    cam2_angle = rng.randrange(0, 360)
+    noise1_level = rng.randrange(5, 20)
+    event_time = f"{rng.randrange(0, 24):02}:{rng.randrange(0, 60):02}"
+    return f"""<?xml version="1.0"?>
+<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+         xmlns:ex="http://example.org#">
+  <rdf:Description rdf:about="http://example.org#VirtualRoom">
+    <ex:lightLevel>{room_light}</ex:lightLevel>
+    <ex:noiseLevel>{room_noise}</ex:noiseLevel>
+    <ex:gridWidth>150</ex:gridWidth>
+    <ex:gridHeight>150</ex:gridHeight>
+  </rdf:Description>
+  <rdf:Description rdf:about="http://example.org#Camera1">
+    <ex:type>Camera</ex:type>
+    <ex:gridX>0</ex:gridX>
+    <ex:gridY>0</ex:gridY>
+    <ex:detectedMotion>{str(cam1_motion).lower()}</ex:detectedMotion>
+    <ex:coverage>Wide</ex:coverage>
+  </rdf:Description>
+  <rdf:Description rdf:about="http://example.org#Camera2">
+    <ex:type>RotatingCamera</ex:type>
+    <ex:gridX>150</ex:gridX>
+    <ex:gridY>100</ex:gridY>
+    <ex:detectedMotion>{str(cam2_motion).lower()}</ex:detectedMotion>
+    <ex:currentAngle>{cam2_angle}</ex:currentAngle>
+  </rdf:Description>
+  <rdf:Description rdf:about="http://example.org#MotionSensor1">
+    <ex:type>MotionSensor</ex:type>
+    <ex:gridX>75</ex:gridX>
+    <ex:gridY>0</ex:gridY>
+    <ex:detection>true</ex:detection>
+  </rdf:Description>
+  <rdf:Description rdf:about="http://example.org#NoiseSensor1">
+    <ex:type>NoiseSensor</ex:type>
+    <ex:gridX>0</ex:gridX>
+    <ex:gridY>150</ex:gridY>
+    <ex:noiseLevel>{noise1_level}</ex:noiseLevel>
+  </rdf:Description>
+  <rdf:Description rdf:about="http://example.org#DetectionEvent1">
+    <ex:detectedCategory>CategoryA</ex:detectedCategory>
+    <ex:timeOfDetection>{event_time}</ex:timeOfDetection>
+  </rdf:Description>
+</rdf:RDF>"""
+
+
+db = SparqlDatabase()
+db.parse_rdf(generate_rdf_xml())
+print(f"loaded {len(db.store)} sensor triples from RDF/XML")
+
+# In-query RULEs (real_scenario.rs:307-397).  Conclusions materialize into
+# the store, so later SELECTs see them like any base triple.
+RULES = [
+    # quiet room (noise < 30) → noise-based detection
+    """PREFIX ex: <http://example.org#>
+    RULE :UseNoiseSensor :- CONSTRUCT { ?room ex:detectionStrategy "NoiseBased" . }
+    WHERE { ?room ex:noiseLevel ?level FILTER (?level < 30) }""",
+    # every room gets the motion fallback
+    """PREFIX ex: <http://example.org#>
+    RULE :DefaultMotionSensor :- CONSTRUCT { ?room ex:fallbackDetectionStrategy "MotionBased" . }
+    WHERE { ?room ex:noiseLevel ?level }""",
+    # bright room (light > 50) → camera detection + identification
+    """PREFIX ex: <http://example.org#>
+    RULE :UseCameraDetection :- CONSTRUCT { ?room ex:detectionStrategy "CameraBased" . }
+    WHERE { ?room ex:lightLevel ?level FILTER (?level > 50) }""",
+    """PREFIX ex: <http://example.org#>
+    RULE :UseCameraIdentification :- CONSTRUCT { ?room ex:identificationMethod "CameraIdentification" . }
+    WHERE { ?room ex:lightLevel ?level FILTER (?level > 50) }""",
+    # every detection event starts unauthorized until cleared
+    """PREFIX ex: <http://example.org#>
+    RULE :MarkAllEventsUnauthorized :- CONSTRUCT { ?event ex:unauthorized "true" . }
+    WHERE { ?event ex:detectedCategory ?person }""",
+]
+for rule in RULES:
+    execute_query_volcano(rule, db)
+
+strategies = execute_query_volcano(
+    """PREFIX ex: <http://example.org#>
+    SELECT ?room ?strategy WHERE { ?room ex:detectionStrategy ?strategy }""",
+    db,
+)
+print("detection strategies:", strategies)
+assert any(r[1] == "CameraBased" for r in strategies), strategies
+
+grid = execute_query_volcano(
+    """PREFIX ex: <http://example.org#>
+    SELECT ?sensor ?type ?x ?y WHERE {
+        ?sensor ex:type ?type ; ex:gridX ?x ; ex:gridY ?y .
+    }""",
+    db,
+)
+print("sensors on the grid:")
+for row in grid:
+    print("  ", row)
+assert len(grid) == 4, grid
+
+unauthorized = execute_query_volcano(
+    """PREFIX ex: <http://example.org#>
+    SELECT ?event ?time WHERE {
+        ?event ex:unauthorized "true" ; ex:timeOfDetection ?time .
+    }""",
+    db,
+)
+print("unauthorized detection events:", unauthorized)
+assert len(unauthorized) == 1

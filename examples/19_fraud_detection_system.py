@@ -1,0 +1,159 @@
+"""Streaming fraud-detection system: RSP window over a transaction stream,
+symbolic RULEs deriving suspicion flags, ML assist, verdicts per window.
+
+Mirrors the reference's flagship real-scenario system
+(``kolibrie/examples/real_scenario/fraud_detection_system.rs``): the
+transaction stream flows through an RSP-QL sliding window (:370-390,
+RANGE/STEP scaled down for a headless run), each fired window's
+transactions land in a SparqlDatabase where the reference's rule pack
+(:675-760 — SuspiciousVelocity / SuspiciousAmount / HighMerchantRisk /
+ForeignHighRisk / chained HighRisk) materializes suspicion flags, an
+ML-assisted rule amplifies a weak model score when velocity is elevated,
+and a verdict query grades every transaction (FRAUD / SUSPICIOUS / CLEAR)
+from its flag count.
+
+Run: ``python examples/19_fraud_detection_system.py``
+"""
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano  # noqa: E402
+from kolibrie_tpu.query.sparql_database import SparqlDatabase  # noqa: E402
+from kolibrie_tpu.rsp.builder import RSPBuilder  # noqa: E402
+from kolibrie_tpu.rsp.s2r import WindowTriple  # noqa: E402
+
+rng = random.Random(13)
+EX = "http://fraud.example.org/"
+
+# ---- 1. the stream: transactions as per-tx property triples --------------
+windows = []
+engine = (
+    RSPBuilder(
+        f"""PREFIX ex: <{EX}>
+        REGISTER RSTREAM <{EX}out/transactions> AS
+        SELECT ?txId ?amount ?vel ?mRisk ?isF
+        FROM NAMED WINDOW <{EX}txWindow>
+            ON <{EX}transactionStream> [RANGE 30 STEP 10]
+        WHERE {{
+          WINDOW <{EX}txWindow> {{
+            ?txId <{EX}amount> ?amount .
+            ?txId <{EX}velocity1h> ?vel .
+            ?txId <{EX}merchantRisk> ?mRisk .
+            ?txId <{EX}isForeign> ?isF .
+          }}
+        }}"""
+    )
+    .with_consumer(lambda row: windows.append(dict(row)))
+    .build()
+)
+
+
+def make_tx(i: int):
+    """One transaction: mostly normal, some engineered fraud shapes."""
+    fraud = rng.random() < 0.25
+    amount = rng.uniform(1200, 5000) if fraud else rng.uniform(5, 400)
+    vel = rng.randint(6, 15) if fraud and rng.random() < 0.7 else rng.randint(0, 4)
+    m_risk = rng.randint(71, 99) if fraud and rng.random() < 0.5 else rng.randint(1, 60)
+    is_foreign = 1 if fraud and rng.random() < 0.4 else 0
+    tx = f"{EX}tx{i}"
+    return tx, [
+        (tx, f"{EX}amount", f'"{amount:.0f}"'),
+        (tx, f"{EX}velocity1h", f'"{vel}"'),
+        (tx, f"{EX}merchantRisk", f'"{m_risk}"'),
+        (tx, f"{EX}isForeign", f'"{is_foreign}"'),
+    ]
+
+
+all_tx = []
+for tick in range(1, 61):
+    tx, triples = make_tx(tick)
+    all_tx.append(tx)
+    for s, p, o in triples:
+        engine.add_to_stream(f"{EX}transactionStream", WindowTriple(s, p, o), tick)
+engine.process_single_thread_window_results()
+engine.stop()
+print(f"{len(windows)} windowed transaction rows streamed out")
+assert windows, "transaction window never fired"
+
+# ---- 2. symbolic pass: the reference's rule pack over the fired windows --
+db = SparqlDatabase()
+for row in windows:
+    tx = row["txId"]
+    db.add_triple_parts(tx, f"{EX}amount", f'"{row["amount"]}"')
+    db.add_triple_parts(tx, f"{EX}velocity1h", f'"{row["vel"]}"')
+    db.add_triple_parts(tx, f"{EX}merchantRisk", f'"{row["mRisk"]}"')
+    db.add_triple_parts(tx, f"{EX}isForeign", f'"{row["isF"]}"')
+
+RULES = [
+    # fraud_detection_system.rs:679 — R1 velocity
+    f"""PREFIX ex: <{EX}>
+    RULE :SuspiciousVelocity :- CONSTRUCT {{ ?tx ex:suspiciousFlag ex:highVelocity . }}
+    WHERE {{ ?tx ex:velocity1h ?vel FILTER(?vel > 5) }}""",
+    # :690 — R2 amount
+    f"""PREFIX ex: <{EX}>
+    RULE :SuspiciousAmount :- CONSTRUCT {{ ?tx ex:suspiciousFlag ex:largeAmount . }}
+    WHERE {{ ?tx ex:amount ?amt FILTER(?amt > 1000) }}""",
+    # :705 — R3 merchant risk
+    f"""PREFIX ex: <{EX}>
+    RULE :HighMerchantRisk :- CONSTRUCT {{ ?tx ex:suspiciousFlag ex:highMerchantRisk . }}
+    WHERE {{ ?tx ex:merchantRisk ?mr FILTER(?mr > 70) }}""",
+    # :720 — R4 foreign x merchant risk
+    f"""PREFIX ex: <{EX}>
+    RULE :ForeignHighRisk :- CONSTRUCT {{ ?tx ex:suspiciousFlag ex:foreignHighRisk . }}
+    WHERE {{ ?tx ex:isForeign ?isF . ?tx ex:merchantRisk ?mr
+             FILTER(?isF > 0) FILTER(?mr > 70) }}""",
+    # :737 — R5 chained amount x velocity
+    f"""PREFIX ex: <{EX}>
+    RULE :HighRisk :- CONSTRUCT {{ ?tx ex:riskLevel ex:high . }}
+    WHERE {{ ?tx ex:amount ?amt . ?tx ex:velocity1h ?vel
+             FILTER(?amt > 1000) FILTER(?vel > 5) }}""",
+]
+for rule in RULES:
+    execute_query_volcano(rule, db)
+
+# ---- 3. ML assist (R6): a weak model score amplified by velocity ---------
+# The score stands in for the trained classifier of the reference's
+# dashboard; per-tx scores land as triples so the rule can see them.
+for tx in set(r["txId"] for r in windows):
+    amt_rows = execute_query_volcano(
+        f"PREFIX ex: <{EX}> SELECT ?a ?v WHERE {{ <{tx}> ex:amount ?a . "
+        f"<{tx}> ex:velocity1h ?v }}",
+        db,
+    )
+    amt, vel = float(amt_rows[0][0]), float(amt_rows[0][1])
+    score = min(99, int(amt / 50) + 8 * int(vel > 5))  # toy model, 0-100
+    db.add_triple_parts(tx, f"{EX}mlScore", f'"{score}"')
+execute_query_volcano(
+    f"""PREFIX ex: <{EX}>
+    RULE :MlAssistedAlert :- CONSTRUCT {{ ?tx ex:suspiciousFlag ex:mlAssisted . }}
+    WHERE {{ ?tx ex:mlScore ?s . ?tx ex:velocity1h ?vel
+             FILTER(?s > 40) FILTER(?vel > 5) }}""",
+    db,
+)
+
+# ---- 4. verdicts: flag count per transaction -----------------------------
+flag_counts = execute_query_volcano(
+    f"""PREFIX ex: <{EX}>
+    SELECT ?tx (COUNT(?f) AS ?n) WHERE {{ ?tx ex:suspiciousFlag ?f }}
+    GROUP BY ?tx ORDER BY DESC(?n) ?tx""",
+    db,
+)
+verdicts = {"FRAUD": 0, "SUSPICIOUS": 0, "CLEAR": 0}
+flagged = {row[0]: int(row[1]) for row in flag_counts}
+for tx in set(r["txId"] for r in windows):
+    n = flagged.get(tx, 0)
+    v = "FRAUD" if n >= 3 else ("SUSPICIOUS" if n >= 1 else "CLEAR")
+    verdicts[v] += 1
+print("verdicts:", verdicts)
+assert verdicts["FRAUD"] > 0 and verdicts["CLEAR"] > 0, verdicts
+
+high_risk = execute_query_volcano(
+    f"PREFIX ex: <{EX}> SELECT ?tx WHERE {{ ?tx ex:riskLevel ex:high }}",
+    db,
+)
+print(f"chained high-risk transactions: {len(high_risk)}")
+print("top flagged:", flag_counts[:3])

@@ -1,0 +1,189 @@
+"""Message-bus → RSP bridge: JSON sensor payloads from topic subscriptions
+become RDF stream events driving a surveillance alarm decision.
+
+Mirrors the reference's MQTT scenario
+(``kolibrie/examples/real_scenario/mqtt_real_scenario.rs``): camera
+detection topics (``camera/detections/N``), PIR sensor topics, and a
+``schedule`` topic feed JSON payloads (:25-45, :199-260) that a
+background subscriber turns into engine events; an alarm controller
+(:72-195) decides ARMED/DISARMED from detections + PIR intensity within
+the armed schedule and publishes a JSON alarm status.
+
+This image has no MQTT broker, so the transport is an in-process broker
+with the SAME topic/payload contract (publish/subscribe on topic
+strings, JSON payloads, background delivery thread) — swapping it for a
+real client changes only the ``Broker`` class.
+
+Run: ``python examples/20_mqtt_stream_bridge.py``
+"""
+
+import json
+import queue
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kolibrie_tpu.query.executor import execute_query_volcano  # noqa: E402
+from kolibrie_tpu.query.sparql_database import SparqlDatabase  # noqa: E402
+from kolibrie_tpu.rsp.builder import RSPBuilder  # noqa: E402
+from kolibrie_tpu.rsp.s2r import WindowTriple  # noqa: E402
+
+EX = "http://mqtt.example.org/"
+
+
+class Broker:
+    """In-process stand-in for an MQTT client: topic pub/sub with a
+    background delivery thread (the reference subscribes in a background
+    thread too, mqtt_real_scenario.rs:199-260)."""
+
+    def __init__(self):
+        self._subs = {}
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._deliver, daemon=True)
+        self._running = True
+        self._worker.start()
+
+    def subscribe(self, topic, fn):
+        self._subs.setdefault(topic, []).append(fn)
+
+    def publish(self, topic, payload: dict):
+        self._q.put((topic, json.dumps(payload)))
+
+    def _deliver(self):
+        while self._running or not self._q.empty():
+            try:
+                topic, raw = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            for fn in self._subs.get(topic, []):
+                fn(topic, json.loads(raw))
+            self._q.task_done()
+
+    def drain(self):
+        self._q.join()
+
+    def stop(self):
+        self._running = False
+        self._worker.join(timeout=2)
+
+
+# ---- RSP side: detection events in a sliding window ----------------------
+window_rows = []
+engine = (
+    RSPBuilder(
+        f"""PREFIX ex: <{EX}>
+        REGISTER RSTREAM <{EX}out/detections> AS
+        SELECT ?evt ?cam ?kind ?conf
+        FROM NAMED WINDOW <{EX}w> ON <{EX}detections> [RANGE 20 STEP 5]
+        WHERE {{
+          WINDOW <{EX}w> {{
+            ?evt <{EX}camera> ?cam .
+            ?evt <{EX}kind> ?kind .
+            ?evt <{EX}confidence> ?conf .
+          }}
+        }}"""
+    )
+    .with_consumer(lambda row: window_rows.append(dict(row)))
+    .build()
+)
+
+pir_state = {}
+
+
+def on_camera(topic, payload):
+    """camera/detections/N → one RDF event per detection in the payload.
+
+    The event time is the payload's own ``ts`` (not delivery wall-clock):
+    broker delivery is asynchronous, and stream windows reason over the
+    SENSOR's timeline, exactly like the reference tags MQTT payloads with
+    their capture timestamp."""
+    cam = topic.rsplit("/", 1)[1]
+    for i, det in enumerate(payload["detections"]):
+        evt = f"{EX}evt_{payload['ts']}_{cam}_{i}"
+        for p, o in (
+            ("camera", f'"{cam}"'),
+            ("kind", f'"{det["type"]}"'),
+            ("confidence", f'"{int(100 * det["confidence"])}"'),
+        ):
+            engine.add_to_stream(
+                f"{EX}detections",
+                WindowTriple(evt, f"{EX}{p}", o),
+                payload["ts"],
+            )
+
+
+def on_pir(topic, payload):
+    pir_state[payload["sensor_id"]] = payload["intensity"]
+
+
+def on_schedule(topic, payload):
+    pir_state["__armed"] = (payload["armed_from"], payload["armed_to"])
+
+
+broker = Broker()
+broker.subscribe("camera/detections/0", on_camera)
+broker.subscribe("camera/detections/1", on_camera)
+broker.subscribe("pir/sensor1", on_pir)
+broker.subscribe("pir/sensor2", on_pir)
+broker.subscribe("schedule", on_schedule)
+
+# ---- publish a night of traffic -----------------------------------------
+broker.publish("schedule", {"armed_from": 22, "armed_to": 6})
+for t in range(1, 31):
+    if t % 3 == 0:
+        broker.publish(
+            "camera/detections/0",
+            {
+                "ts": t,
+                "detections": [
+                    {"type": "person", "confidence": 0.6 + 0.01 * (t % 30)}
+                ],
+            },
+        )
+    if t % 7 == 0:
+        broker.publish(
+            "camera/detections/1",
+            {"ts": t, "detections": [{"type": "cat", "confidence": 0.9}]},
+        )
+    if t % 5 == 0:
+        broker.publish(
+            "pir/sensor1", {"sensor_id": "pir1", "intensity": 40 + t}
+        )
+broker.drain()
+engine.process_single_thread_window_results()
+engine.stop()
+broker.stop()
+print(f"{len(window_rows)} detection rows through the window")
+assert window_rows, "no detections streamed"
+
+# ---- alarm controller: windowed detections + PIR + schedule --------------
+db = SparqlDatabase()
+for row in window_rows:
+    db.add_triple_parts(row["evt"], f"{EX}camera", f'"{row["cam"]}"')
+    db.add_triple_parts(row["evt"], f"{EX}kind", f'"{row["kind"]}"')
+    db.add_triple_parts(row["evt"], f"{EX}confidence", f'"{row["conf"]}"')
+
+persons = execute_query_volcano(
+    f"""PREFIX ex: <{EX}>
+    SELECT ?evt ?conf WHERE {{
+        ?evt ex:kind "person" ; ex:confidence ?conf FILTER(?conf > 70)
+    }}""",
+    db,
+)
+hour = 23  # inside the armed window published on the schedule topic
+armed_from, armed_to = pir_state["__armed"]
+armed = hour >= armed_from or hour < armed_to
+pir_hot = any(v >= 50 for k, v in pir_state.items() if k != "__armed")
+alarm = armed and (len(persons) > 0 or pir_hot)
+status = {
+    "status": "ALARM" if alarm else "OK",
+    "reason": (
+        f"{len(persons)} confident person detections, pir_hot={pir_hot}"
+    ),
+    "camera_ids": sorted({r["cam"] for r in window_rows}),
+}
+broker2 = json.dumps(status)  # what would be published back to MQTT
+print("alarm status:", broker2)
+assert alarm, status

@@ -1,0 +1,145 @@
+"""Household-savings forecasting: the reference ML corpus's saving domain,
+three regressors compared through MLSchema + resource-scored discovery.
+
+Domain-predictor example (reference parity:
+``ml/examples/saving_predictor.py:94-292`` — financial features income /
+spending / savings_rate, a future-savings regression target, and the
+THREE-predictor comparison of the reference's corpus: linear regression,
+random forest, gradient boosting, each exporting mse/r2 + cpu/memory
+metrics into MLSchema sidecars).  The generated predictor script is the
+framework's ``generate_ml_models`` contract (like examples 13-15);
+discovery resource-scores the sidecars, the winner serves a savings
+forecast, and the MLSchema metrics are loaded back as RDF and queried
+with plain SPARQL for a model leaderboard.
+
+Run: ``python examples/21_saving_predictor.py``
+"""
+
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from kolibrie_tpu.ml.handler import MLHandler  # noqa: E402
+from kolibrie_tpu.query.executor import execute_query_volcano  # noqa: E402
+from kolibrie_tpu.query.sparql_database import SparqlDatabase  # noqa: E402
+
+rng = np.random.default_rng(23)
+N = 1000
+
+# financial features (saving_predictor.py:94-98)
+income = rng.normal(5000, 2000, N).clip(500)         # monthly income $
+spending = rng.normal(3500, 1500, N).clip(100)       # monthly spending $
+savings_rate = np.clip(rng.normal(0.15, 0.1, N), 0.01, 0.5)
+
+# future savings: income raises it, spending lowers it, the savings rate
+# compounds with income, disposable income helps (saving_predictor.py:101-108)
+future_savings = (
+    income * 0.6
+    - spending * 0.4
+    + savings_rate * income * 5
+    + (income - spending) * 0.3
+    + rng.normal(0, 400, N)
+)
+
+X = np.column_stack([income, spending, savings_rate])
+workdir = Path(tempfile.mkdtemp(prefix="kolibrie_saving_"))
+np.save(workdir / "features.npy", X)
+np.save(workdir / "target.npy", future_savings)
+
+(workdir / "saving_predictor.py").write_text(
+    textwrap.dedent(
+        '''
+        """Trains the saving-domain regressor trio; pkl + MLSchema TTL."""
+        import pickle, sys, time
+        from pathlib import Path
+        import numpy as np
+        import psutil
+        from sklearn.ensemble import (
+            GradientBoostingRegressor,
+            RandomForestRegressor,
+        )
+        from sklearn.linear_model import LinearRegression
+
+        sys.path.insert(0, {repo!r})
+        from kolibrie_tpu.ml.mlschema import model_to_mlschema_ttl
+
+        X = np.load("features.npy"); y = np.load("target.npy")
+        n_train = int(0.8 * len(X))
+        Xtr, Xte, ytr, yte = X[:n_train], X[n_train:], y[:n_train], y[n_train:]
+        proc = psutil.Process()
+        for name, model in (
+            ("saving_linreg", LinearRegression()),
+            ("saving_rf", RandomForestRegressor(
+                n_estimators=60, max_depth=10, random_state=42)),
+            ("saving_gbr", GradientBoostingRegressor(
+                n_estimators=60, learning_rate=0.1, max_depth=3,
+                random_state=42)),
+        ):
+            rss0 = proc.memory_info().rss
+            t0 = time.process_time()
+            model.fit(Xtr, ytr)
+            cpu = time.process_time() - t0
+            mem = max(proc.memory_info().rss - rss0, 0) / 1e6
+            t1 = time.perf_counter()
+            pred = model.predict(Xte)
+            pred_ms = (time.perf_counter() - t1) * 1000 / len(Xte)
+            mse = float(((pred - yte) ** 2).mean())
+            ss_tot = float(((yte - yte.mean()) ** 2).sum())
+            r2 = 1.0 - float(((pred - yte) ** 2).sum()) / ss_tot
+            with open(f"{{name}}_predictor.pkl", "wb") as f:
+                pickle.dump(model, f)
+            Path(f"{{name}}_schema.ttl").write_text(model_to_mlschema_ttl(
+                name, algorithm=type(model).__name__,
+                metrics={{"mse": mse, "r2": r2, "cpuUsage": cpu,
+                          "memoryUsage": mem, "predictionTime": pred_ms}}))
+            print(f"{{name}}: mse={{mse:.0f}} r2={{r2:.4f}} cpu={{cpu:.3f}}s")
+        '''.format(repo=str(Path(__file__).resolve().parent.parent))
+    )
+)
+
+handler = MLHandler()
+names = handler.generate_ml_models(str(workdir))
+print(f"generated models: {names}")
+assert len(names) == 3, names
+loaded = handler.discover_and_load_models(str(workdir))
+print(f"resource-best model: {loaded}")
+for meta in handler.compare_models():
+    print(
+        f"  {meta.name}: cpu={meta.cpu_usage:.3f}s"
+        f" mem={meta.memory_usage:.1f}MB score={meta.resource_score():.3f}"
+    )
+
+# ---- forecast: who saves the most next year? -----------------------------
+households = {
+    "frugal_saver": [4000.0, 2200.0, 0.35],
+    "big_spender": [6500.0, 6200.0, 0.02],
+    "median_household": [5000.0, 3500.0, 0.15],
+}
+rows = list(households.values())
+result = handler.predict(loaded[0], rows)
+for (name, _feats), pred in zip(households.items(), result.predictions):
+    print(f"  {name}: predicted future savings ${pred:,.0f}")
+by_name = dict(zip(households, result.predictions))
+assert by_name["frugal_saver"] > by_name["big_spender"]
+
+# ---- metrics-as-RDF leaderboard (the MLSchema sidecars queried back) -----
+db = SparqlDatabase()
+for ttl in sorted(workdir.glob("*_schema.ttl")):
+    db.parse_turtle(ttl.read_text())
+leaderboard = execute_query_volcano(
+    """PREFIX mls: <http://www.w3.org/ns/mls#>
+    SELECT ?model ?v WHERE {
+        ?run mls:hasOutput ?model . ?model a mls:Model .
+        ?run mls:hasOutput ?e . ?e a mls:ModelEvaluation .
+        ?e mls:specifiedBy mls:r2 . ?e mls:hasValue ?v .
+    } ORDER BY DESC(?v)""",
+    db,
+)
+print("r2 leaderboard:", leaderboard)
+assert len(leaderboard) == 3
+print("ok")
