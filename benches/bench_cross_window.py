@@ -18,6 +18,14 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+# The cross-window SDS+ engines are host-only (numpy) — pin the CPU
+# backend so a dead TPU tunnel can never kill the sweep at import time
+# (the env preloads the axon platform; jax.config is the reliable
+# override, same dance as tests/conftest.py).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 from kolibrie_tpu.core.dictionary import Dictionary  # noqa: E402
 from kolibrie_tpu.reasoner.cross_window import (  # noqa: E402
     Sds,

@@ -22,10 +22,12 @@ position), numeric + term-equality + constant-pattern string FILTERs
 projection,
 DISTINCT (mesh-side: projection tuples hash to an owner shard, shard-local
 sort-unique is globally exact), ORDER BY + LIMIT (mesh-side per-shard
-numeric-key top-k, O(k·n) readback, host re-orders the union; non-numeric
-sort keys re-run without the top-k stage and order on host; for rows tied
-at the k boundary the kept representative may differ from the host
-executor's stable order — both are valid SPARQL answers), and BIND (the
+top-k, O(k·n) readback, host re-orders the union; a non-numeric sort value
+ANYWHERE flips the run to global per-ID string ranks — the single-chip
+engine's rank tables, replicated — and re-runs the SAME mesh top-k, so
+string keys never fall back to full-result readback; for rows tied at the
+k boundary the kept representative may differ from the host executor's
+stable order — both are valid SPARQL answers), and BIND (the
 mesh gathers all pattern variables; binds + bind-reading filters apply
 host-side to the small result table — the single-chip device split).
 VALUES in its constraining form (one BGP-bound variable, distinct bound

@@ -426,21 +426,42 @@ def _commit_parts(
 # ---------------------------------------------------------------------------
 
 
+def _concl_unifies_neg(concl, neg) -> bool:
+    """Conservative syntactic unification of a conclusion pattern with a
+    negated premise — variables unify with anything."""
+    return all(
+        kind != "const" or c is None or c == v
+        for (kind, v), c in zip(concl, neg.consts)
+    )
+
+
 def _naf_cross_blocking(naf_rules) -> bool:
     """True when some NAF rule's conclusion pattern could unify with some
     NAF rule's NEGATED premise (including its own): within one negative
     pass the host's sequential fact commits make the outcome order-
-    dependent, which the snapshot-based device pass cannot reproduce.
-    Conservative syntactic test — variables unify with anything."""
+    dependent.  Since round 5 this routes to the SEQUENTIAL per-rule
+    driver (host rule order reproduced dispatch-by-dispatch) instead of
+    gating — only the within-rule case (:func:`_naf_self_blocking`)
+    still falls back to host."""
     for ra in naf_rules:
         for concl in ra.concls:
             for rb in naf_rules:
                 for neg in rb.negs:
-                    if all(
-                        kind != "const" or c is None or c == v
-                        for (kind, v), c in zip(concl, neg.consts)
-                    ):
+                    if _concl_unifies_neg(concl, neg):
                         return True
+    return False
+
+
+def _naf_self_blocking(naf_rules) -> bool:
+    """True when a NAF rule's conclusion unifies a negated premise OF THE
+    SAME rule: the host commits that rule's derivations row by row, so an
+    earlier row's conclusion can block a later row of the same evaluation
+    — an order no snapshot pass or per-rule sequencing reproduces."""
+    for r in naf_rules:
+        for concl in r.concls:
+            for neg in r.negs:
+                if _concl_unifies_neg(concl, neg):
+                    return True
     return False
 
 
@@ -543,7 +564,7 @@ def _prov_naf_pass(
     """
     import jax.numpy as jnp
 
-    from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices
+    from kolibrie_tpu.ops.device_join import join_indices
 
     F, D, J = caps.fact, caps.delta, caps.join
     fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
@@ -665,9 +686,8 @@ def _prov_round_addmult(
     :func:`_prov_round`; an overflowing round does not commit.
     """
     import jax.numpy as jnp
-    from jax import lax
 
-    from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices, pack2
+    from kolibrie_tpu.ops.device_join import join_indices
 
     F, D, J = caps.fact, caps.delta, caps.join
     fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
@@ -724,6 +744,54 @@ def _prov_round_addmult(
                     else:
                         out.append(jnp.full(n, v, dtype=jnp.uint32))
                 parts.append((out[0], out[1], out[2], tag, valid))
+
+    (
+        nfs,
+        nfp,
+        nfo,
+        nftag,
+        n_facts_next,
+        ndidx,
+        n_dnext,
+        overflow,
+    ) = _addmult_commit(parts, caps, fs, fp, fo, ftag, n_facts, overflow)
+    ok = overflow == 0
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    return (
+        sel(nfs, fs),
+        sel(nfp, fp),
+        sel(nfo, fo),
+        sel(nftag, ftag),
+        sel(n_facts_next, n_facts),
+        sel(ndidx, didx),
+        sel(n_dnext.astype(jnp.int32), np.int32(0)),
+        overflow,
+    )
+
+
+def _addmult_commit(
+    parts, caps, fs, fp, fo, ftag, n_facts, overflow, fresh_delta_only=False
+):
+    """Shared commit tail of the addmult round AND NAF pass: group the
+    candidate (s,p,o,tag,valid) blocks, ⊕ per group as a segment noisy-OR
+    in log space (order-free — exactly ⊕ folded over the group), merge with
+    stored tags (``TagStore.update_disjunction`` semantics incl. the 1e-12
+    change cutoff), append fresh facts, and emit the next delta as fact-row
+    indices.  ``fresh_delta_only`` (the NAF pass): the delta carries ONLY
+    newly-appended facts — host parity with ``_negative_pass``, whose
+    ``naf_new`` returns newly ADDED keys, so an improved pre-existing
+    conclusion must NOT re-enter the positive stratum.  Traced inside the
+    callers' jit."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kolibrie_tpu.ops.device_join import pack2
+
+    F, D = caps.fact, caps.delta
+    fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
 
     cs = jnp.concatenate([p[0] for p in parts])
     cp = jnp.concatenate([p[1] for p in parts])
@@ -802,13 +870,183 @@ def _prov_round_addmult(
     nftag = ftag.at[adest].set(gtag, mode="drop")
     nftag = nftag.at[jnp.where(changed, fidx, F)].set(merged, mode="drop")
 
-    # next delta = indices of new ∪ changed fact rows
-    dmask = fresh | changed
+    # next delta = indices of new (∪ changed, unless fresh_delta_only) rows
+    dmask = fresh if fresh_delta_only else (fresh | changed)
     row_idx = jnp.where(fresh, adest, fidx).astype(jnp.int32)
     n_dnext = jnp.sum(dmask)
     ddest = jnp.where(dmask, jnp.cumsum(dmask) - 1, D)
     ndidx = jnp.zeros(D, jnp.int32).at[ddest].set(row_idx, mode="drop")
+    return nfs, nfp, nfo, nftag, n_facts_next, ndidx, n_dnext, overflow
 
+
+# ---------------------------------------------------------------------------
+# Non-idempotent stratified NAF pass: exactly-once via a device seen-set
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("rule", "caps", "scap"))
+def _prov_naf_pass_addmult(
+    rule,
+    caps: _Caps,
+    scap: int,
+    fs,
+    fp,
+    fo,
+    ftag,
+    n_facts,
+    seen_cols,
+    n_seen,
+    masks,
+    gtag,
+):
+    """One NAF rule's stratified pass for the NON-idempotent addmult
+    semiring, with the host's exactly-once derivation accounting
+    (``naf_seen``, provenance_seminaive.py::_negative_pass) ON DEVICE.
+
+    The host processes each derivation signature — (rule, variable
+    bindings) — at most once across passes, because noisy-OR ⊕ would
+    double-count re-derivations.  Here the signature set is a device-
+    resident SEEN relation: ``seen_cols`` is one sorted u32 column per
+    rule variable (lexicographic, capacity ``scap``), partitioned per rule
+    by the driver.  The pass sorts [seen rows ∥ this pass's candidate
+    rows] on the binding columns with a seen-first tie-break; a candidate
+    fires iff it HEADS its equal-binding group (neither a seen row nor an
+    earlier duplicate candidate precedes it), and the sorted union of
+    distinct bindings is the next seen relation — dedup, membership, and
+    maintenance in ONE multi-operand sort.
+
+    One rule per dispatch: the driver sequences rules in host order, so a
+    rule's committed facts are visible to later rules' body joins and
+    negated-premise checks exactly like the host's within-pass sequential
+    commits (this also serves the idempotent cross-blocking case).
+    Self-interaction (a rule's conclusion unifying its OWN negated
+    premise, or reaching its own positive premises) stays host-gated —
+    there the host's per-ROW commit order is load-bearing.
+
+    Same didx delta / overflow protocol as :func:`_prov_round_addmult`;
+    overflow bit 8 = seen-set capacity.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kolibrie_tpu.ops.device_join import join_indices
+
+    F, D, J = caps.fact, caps.delta, caps.join
+    fvalid = jnp.arange(F, dtype=jnp.int32) < n_facts
+    fcols = (fs, fp, fo)
+    eff = jnp.where(jnp.isnan(ftag), 1.0, ftag)
+
+    overflow = np.int32(0)
+    # body vs ALL facts (host: eval_rule_body with delta=None)
+    order, keys = rule.plans[0]
+    table, valid = _scan_premise(rule.premises[order[0]], fcols, fvalid)
+    tag = eff * gtag
+    for step, j in enumerate(order[1:]):
+        ptable, pm = _scan_premise(rule.premises[j], fcols, fvalid)
+        kv = keys[step]
+        lkey, rkey = _join_keys(table, ptable, kv, valid, pm)
+        li, ri, jvalid, total = join_indices(lkey, rkey, J)
+        overflow = overflow | jnp.where(total > J, np.int32(1), 0)
+        new_table = {}
+        for v, c in table.items():
+            new_table[v] = c[li]
+        for v, c in ptable.items():
+            if v not in new_table:
+                new_table[v] = c[ri]
+        ptag = eff[ri]
+        tag = tag[li] * ptag
+        table, valid = new_table, jvalid
+    valid = _eval_filters(rule, table, valid, masks)
+
+    # ---- seen-set: dedup + membership + maintenance in one sort ----------
+    var_names = tuple(sorted(table))  # host sig order: sorted(row.items())
+    n_cand = valid.shape[0]
+    sent = np.uint32(0xFFFFFFFF)
+    seen_valid = jnp.arange(scap, dtype=jnp.int32) < n_seen
+    ops = []
+    for k, v in enumerate(var_names):
+        cand = jnp.where(valid, table[v], sent)
+        seen = jnp.where(seen_valid, seen_cols[k], sent)
+        ops.append(jnp.concatenate([seen, cand]))
+    # flag sorts seen (0) before equal-binding candidates (1)
+    flag = jnp.concatenate(
+        [
+            jnp.zeros(scap, dtype=jnp.uint32),
+            jnp.ones(n_cand, dtype=jnp.uint32),
+        ]
+    )
+    payload_tag = jnp.concatenate([jnp.zeros(scap, jnp.float64), tag])
+    sorted_all = lax.sort(
+        (*ops, flag, payload_tag), num_keys=len(var_names) + 1
+    )
+    scols = sorted_all[: len(var_names)]
+    sflag = sorted_all[len(var_names)]
+    stag = sorted_all[len(var_names) + 1]
+    live = scols[0] != sent  # all-sentinel rows (invalid) sort last
+    head = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            jnp.any(
+                jnp.stack([c[1:] != c[:-1] for c in scols]), axis=0
+            ),
+        ]
+    )
+    # a candidate FIRES iff it heads its equal-binding group: no seen row
+    # (flag 0 sorts first) and no duplicate candidate precedes it
+    fire = live & head & (sflag == 1)
+    # next seen relation = the distinct bindings of the union
+    keep = live & head
+    n_seen_next = jnp.sum(keep)
+    overflow = overflow | jnp.where(n_seen_next > scap, np.int32(8), 0)
+    kdest = jnp.where(keep, jnp.cumsum(keep) - 1, scap)
+    seen_next = tuple(
+        jnp.full(scap, sent, dtype=jnp.uint32).at[kdest].set(c, mode="drop")
+        for c in scols
+    )
+
+    # ---- negated premises over the firing rows ---------------------------
+    bind = {v: scols[k] for k, v in enumerate(var_names)}
+    n_all = scap + n_cand
+    tag2 = stag
+    for neg in rule.negs:
+        qcol: list = [None, None, None]
+        for pos_i, c in enumerate(neg.consts):
+            if c is not None:
+                qcol[pos_i] = jnp.full(n_all, c, dtype=jnp.uint32)
+        for v, pos_i in neg.vars:
+            qcol[pos_i] = bind[v]
+        for a, b in neg.eq_pairs:
+            qcol[b] = qcol[a]
+        found, fidx = _fact_lookup(
+            qcol[0], qcol[1], qcol[2], fire, fs, fp, fo, fvalid, F
+        )
+        ntag = 1.0 - eff[jnp.clip(fidx, 0, F - 1)]  # addmult ⊖ = 1 − t
+        tag2 = tag2 * jnp.where(found, ntag, 1.0)
+    fire = fire & (tag2 > 0.0)  # zero-tag pruning
+
+    parts = []
+    for concl in rule.concls:
+        out = []
+        for kind, v in concl:
+            if kind == "var":
+                out.append(bind[v])
+            else:
+                out.append(jnp.full(n_all, v, dtype=jnp.uint32))
+        parts.append((out[0], out[1], out[2], tag2, fire))
+
+    (
+        nfs,
+        nfp,
+        nfo,
+        nftag,
+        n_facts_next,
+        ndidx,
+        n_dnext,
+        overflow,
+    ) = _addmult_commit(
+        parts, caps, fs, fp, fo, ftag, n_facts, overflow,
+        fresh_delta_only=True,
+    )
     ok = overflow == 0
 
     def sel(new, old):
@@ -820,8 +1058,10 @@ def _prov_round_addmult(
         sel(nfo, fo),
         sel(nftag, ftag),
         sel(n_facts_next, n_facts),
-        sel(ndidx, didx),
+        ndidx,
         sel(n_dnext.astype(jnp.int32), np.int32(0)),
+        tuple(sel(ns, os_) for ns, os_ in zip(seen_next, seen_cols)),
+        sel(n_seen_next.astype(jnp.int32), n_seen),
         overflow,
     )
 
@@ -845,15 +1085,14 @@ def infer_provenance_device(
     """
     if not supports(provenance):
         return None
-    naf = any(r.negative_premise for r in reasoner.rules)
-    if naf and provenance.name not in _IDEMPOTENT:
-        # the host pass's exactly-once derivation accounting (naf_seen) is
-        # load-bearing for non-idempotent ⊕ — stays host-side
-        return None
     if provenance.name == "addmult" and _addmult_order_sensitive(
-        reasoner.rules
+        [r for r in reasoner.rules if not r.negative_premise]
     ):
-        return None  # order-dependent accumulation: host semantics win
+        # order-dependent accumulation WITHIN the positive round program:
+        # host semantics win.  NAF rules are excluded — the stratified
+        # driver dispatches them one at a time in host order, so cross-rule
+        # visibility matches the host pass by construction.
+        return None
     try:
         rules, bank = lower_rules(reasoner, reasoner.rules)
     except Unsupported:
@@ -874,18 +1113,26 @@ def infer_provenance_device(
         return {}  # every rule statically dead: nothing to derive
     pos_rules = tuple(r for r in rules if not r.negs)
     naf_rules = tuple(r for r in rules if r.negs)
-    if naf_rules and _naf_cross_blocking(naf_rules):
-        # the host pass commits facts SEQUENTIALLY within one negative
-        # pass, so a NAF rule can block (or feed) another NAF rule fired
-        # later in the same pass; the device pass evaluates all NAF rules
-        # against one pre-pass snapshot and its later max-merge cannot
-        # retract the stale derivation — keep those programs host-side
+    if naf_rules and _naf_self_blocking(naf_rules):
+        # a rule whose conclusion unifies its OWN negated premise: the
+        # host's per-ROW sequential commits within that rule's evaluation
+        # are load-bearing (row k can block row k+1 of the same rule) —
+        # no snapshot or per-rule sequencing reproduces that order
         return None
     if naf_rules and _naf_premise_drift(rules, naf_rules):
         # a NAF body reading DERIVED predicates can see its premise tags
         # improve between passes; host freezes each derivation's first
         # read (naf_seen) — keep those programs host-side
         return None
+    # CROSS-rule blocking (rule A's conclusion unifying rule B's negated
+    # premise) no longer gates: the drivers dispatch NAF rules one at a
+    # time in host order, so each rule's commits are visible to later
+    # rules' body joins and negated-premise checks exactly like the host
+    # pass's sequential commits (round 5; addmult is ALWAYS sequential —
+    # its per-rule seen-sets need the partition anyway)
+    naf_sequential = bool(naf_rules) and (
+        provenance.name == "addmult" or _naf_cross_blocking(naf_rules)
+    )
 
     import jax.numpy as jnp
 
@@ -918,7 +1165,8 @@ def infer_provenance_device(
             reasoner,
             provenance,
             tag_store,
-            rules,
+            pos_rules,
+            naf_rules,
             masks,
             s,
             p,
@@ -1020,6 +1268,7 @@ def infer_provenance_device(
                 n0,
                 nd0,
                 max_attempts,
+                sequential=naf_sequential,
             )
         if st is None:
             return None  # graceful host fallback (reasoner state untouched)
@@ -1127,12 +1376,19 @@ def _drive_naf(
     n0,
     nd0,
     max_attempts,
+    sequential: bool = False,
 ):
     """Stratified-NAF driver (host loop parity, provenance_seminaive.py):
     alternate one device NAF pass with a positive fixpoint re-run seeded by
     the pass's delta, until a pass derives nothing new.  Shares the
     doubling overflow protocol; ``round_fn is None`` means the program has
-    no positive stratum."""
+    no positive stratum.
+
+    ``sequential`` (cross-blocking rule sets): dispatch ONE rule at a
+    time in host rule order — a rule's committed facts are then visible
+    to later rules' negated-premise checks and body joins within the same
+    pass, exactly like the host's sequential commits; the pass delta is
+    the union of the per-rule deltas."""
     import jax.numpy as jnp
 
     neg_kind = "expiration" if provenance.name == "expiration" else "complement"
@@ -1141,52 +1397,91 @@ def _drive_naf(
     # NAF bodies join over ALL facts, not a delta — start J at fact scale
     J = _round_cap(max(st["n_facts"], 1024), 1024)
     attempts = 0
+    rule_groups = (
+        [((r,), gtags[i : i + 1]) for i, r in enumerate(naf_rules)]
+        if sequential
+        else [(naf_rules, gtags)]
+    )
     for _pass in range(10_000):
-        out = _prov_naf_pass(
-            naf_rules,
-            _Caps(F, D, J),
-            st["fs"],
-            st["fp"],
-            st["fo"],
-            st["ftag"],
-            jnp.int32(st["n_facts"]),
-            st["ds"],
-            st["dp"],
-            st["do"],
-            st["dt"],
-            jnp.float64(one_enc),
-            masks,
-            neg_kind,
-            gtags,
-        )
-        code = int(out[10])  # one sync per pass
-        if code != 0:
-            attempts += 1
-            if attempts > max_attempts:
-                return None
-            if code & 1:
-                J *= 2
-            if code & 2:
-                D *= 2
-                st = pad_delta(st, D)
-            if code & 4:
-                F *= 2
-                for k in ("fs", "fp", "fo"):
-                    st[k] = _pad_u32(st[k], F)
-                st["ftag"] = _pad_f64(st["ftag"], F)
-            continue  # retry the pass (it did not commit)
-        st = {
-            "fs": out[0],
-            "fp": out[1],
-            "fo": out[2],
-            "ftag": out[3],
-            "n_facts": int(out[4]),
-            "ds": out[5],
-            "dp": out[6],
-            "do": out[7],
-            "dt": out[8],
-            "n_delta": int(out[9]),
-        }
+        pass_start = st["n_facts"]
+        committed = [False] * len(rule_groups)
+        while True:  # per-pass retry loop: only NOT-yet-committed groups
+            failed = False
+            for gi, (grules, ggtags) in enumerate(rule_groups):
+                if committed[gi]:
+                    # a group that committed before an overflow keeps its
+                    # commit — its appended facts are recovered from the
+                    # fact buffers at pass end, so nothing is lost
+                    continue
+                out = _prov_naf_pass(
+                    grules,
+                    _Caps(F, D, J),
+                    st["fs"],
+                    st["fp"],
+                    st["fo"],
+                    st["ftag"],
+                    jnp.int32(st["n_facts"]),
+                    st["ds"],
+                    st["dp"],
+                    st["do"],
+                    st["dt"],
+                    jnp.float64(one_enc),
+                    masks,
+                    neg_kind,
+                    ggtags,
+                )
+                code = int(out[10])  # one sync per dispatch
+                if code != 0:
+                    attempts += 1
+                    if attempts > max_attempts:
+                        return None
+                    if code & 1:
+                        J *= 2
+                    if code & 2:
+                        D *= 2
+                        st = pad_delta(st, D)
+                    if code & 4:
+                        F *= 2
+                        for k in ("fs", "fp", "fo"):
+                            st[k] = _pad_u32(st[k], F)
+                        st["ftag"] = _pad_f64(st["ftag"], F)
+                    failed = True
+                    break  # retry the remaining groups at bigger caps
+                st = {
+                    "fs": out[0],
+                    "fp": out[1],
+                    "fo": out[2],
+                    "ftag": out[3],
+                    "n_facts": int(out[4]),
+                    "ds": out[5],
+                    "dp": out[6],
+                    "do": out[7],
+                    "dt": out[8],
+                    "n_delta": int(out[9]),
+                }
+                if sequential:
+                    committed[gi] = True
+            if not failed:
+                break
+        if sequential:
+            # the pass delta = EXACTLY the facts appended during the pass
+            # (host naf_new), read back from the fact buffers WITH their
+            # current tags — a later rule may have ⊕-improved an earlier
+            # rule's fresh fact, and the positive re-run must see the
+            # merged value (the host reads the tag store live)
+            nd = st["n_facts"] - pass_start
+            if nd > D:
+                D = _round_cap(nd)
+            if nd:
+                sl = slice(pass_start, st["n_facts"])
+                dt = np.asarray(st["ftag"][sl])
+                st["ds"] = _pad_u32(np.asarray(st["fs"][sl]), D)
+                st["dp"] = _pad_u32(np.asarray(st["fp"][sl]), D)
+                st["do"] = _pad_u32(np.asarray(st["fo"][sl]), D)
+                st["dt"] = _pad_f64(
+                    np.where(np.isnan(dt), one_enc, dt), D
+                )
+            st["n_delta"] = int(nd)
         if st["n_delta"] == 0:
             return st
         # NAF-derived facts feed back into the positive stratum
@@ -1200,6 +1495,125 @@ def _drive_naf(
             st["n_delta"] = 0
         F = st["fs"].shape[0]
         D = st["ds"].shape[0]
+    return None  # pass limit
+
+
+def _drive_naf_addmult(
+    naf_rules,
+    st,
+    round_fn,
+    pad_delta,
+    provenance,
+    tag_store,
+    masks,
+    n0,
+    max_attempts,
+):
+    """Stratified-NAF driver for the NON-idempotent addmult semiring:
+    one rule per dispatch in host order (sequential commits visible to
+    later rules), each rule carrying its own device-resident seen-set
+    (exactly-once across passes), the pass's union delta re-seeding the
+    positive protocol until a pass derives nothing new."""
+    import jax.numpy as jnp
+
+    F = st["fs"].shape[0]
+    D = st["didx"].shape[0]
+    # NAF bodies join over ALL facts, not a delta — start J at fact scale
+    J = _round_cap(max(st["n_facts"], 1024), 1024)
+    gtags = np.asarray(_guard_tag_array(naf_rules, provenance, tag_store))
+    scaps = [
+        _round_cap(max(2 * st["n_facts"], 1024)) for _ in naf_rules
+    ]
+    seen: List[Optional[tuple]] = [None] * len(naf_rules)
+    attempts = 0
+    for _pass in range(10_000):
+        pass_start = st["n_facts"]
+        committed = [False] * len(naf_rules)
+        while True:  # per-pass retry loop: only NOT-yet-committed rules
+            failed = False
+            for gi, rule in enumerate(naf_rules):
+                if committed[gi]:
+                    continue
+                nvars = len(
+                    {v for prem in rule.premises for v, _pos in prem.vars}
+                )
+                if seen[gi] is None:
+                    cols = tuple(
+                        jnp.full(scaps[gi], 0xFFFFFFFF, dtype=jnp.uint32)
+                        for _ in range(nvars)
+                    )
+                    ns = 0
+                else:
+                    cols, ns = seen[gi]
+                if cols and cols[0].shape[0] != scaps[gi]:
+                    cols = tuple(_pad_u32(c, scaps[gi]) for c in cols)
+                out = _prov_naf_pass_addmult(
+                    rule,
+                    _Caps(F, D, J),
+                    scaps[gi],
+                    st["fs"],
+                    st["fp"],
+                    st["fo"],
+                    st["ftag"],
+                    jnp.int32(st["n_facts"]),
+                    cols,
+                    jnp.int32(ns),
+                    masks,
+                    jnp.float64(gtags[gi]),
+                )
+                code = int(out[9])  # one sync per dispatch
+                if code != 0:
+                    attempts += 1
+                    if attempts > max_attempts:
+                        return None
+                    if code & 1:
+                        J *= 2
+                    if code & 2:
+                        D *= 2
+                        st = pad_delta(st, D)
+                    if code & 4:
+                        F *= 2
+                        for k in ("fs", "fp", "fo"):
+                            st[k] = _pad_u32(st[k], F)
+                        st["ftag"] = _pad_f64(st["ftag"], F)
+                    if code & 8:
+                        scaps[gi] *= 2
+                    failed = True
+                    break  # retry the remaining rules at bigger caps
+                st = {
+                    "fs": out[0],
+                    "fp": out[1],
+                    "fo": out[2],
+                    "ftag": out[3],
+                    "n_facts": int(out[4]),
+                    "didx": out[5],
+                    "n_delta": int(out[6]),
+                }
+                seen[gi] = (out[7], int(out[8]))
+                committed[gi] = True
+            if not failed:
+                break
+        # the pass delta = EXACTLY the facts appended during the pass
+        # (host naf_new: newly ADDED keys only — an improved pre-existing
+        # conclusion must not re-enter the positive stratum), as fact-row
+        # indices; their tags are read from the live buffers by the round
+        if st["n_facts"] == pass_start:
+            return st
+        didx = np.arange(pass_start, st["n_facts"], dtype=np.int32)
+        if didx.size > D:
+            D = _round_cap(didx.size)
+        st["didx"] = _pad_i32(didx, D)
+        st["n_delta"] = int(didx.size)
+        if round_fn is not None:
+            st = _run_overflow_protocol(
+                round_fn, st, n0, st["n_delta"], pad_delta, max_attempts
+            )
+            if st is None:
+                return None
+            F = st["fs"].shape[0]
+            D = st["didx"].shape[0]
+        else:
+            st["n_delta"] = 0
     return None  # pass limit
 
 
@@ -1232,7 +1646,8 @@ def _drive_addmult(
     reasoner,
     provenance,
     tag_store,
-    rules,
+    pos_rules,
+    naf_rules,
     masks,
     s,
     p,
@@ -1243,7 +1658,11 @@ def _drive_addmult(
     max_attempts: int,
 ) -> Optional[Dict[Tuple[int, int, int], float]]:
     """Host driver for the exactly-once addmult rounds: the shared overflow
-    protocol with the delta carried as fact-row INDICES."""
+    protocol with the delta carried as fact-row INDICES.  NAF rules run as
+    the stratified loop — positive protocol to quiescence, then ONE rule
+    per dispatch in host order (:func:`_prov_naf_pass_addmult`, each rule
+    carrying its own device-resident seen-set), the pass's union delta
+    feeding the positive stratum again until a pass derives nothing."""
     import jax.numpy as jnp
 
     nd0 = int(didx0.size)
@@ -1258,11 +1677,13 @@ def _drive_addmult(
             "didx": _pad_i32(didx0, 0),
             "n_delta": nd0,
         }
-        gtags = jnp.asarray(_guard_tag_array(rules, provenance, tag_store))
+        gtags = jnp.asarray(
+            _guard_tag_array(pos_rules, provenance, tag_store)
+        )
 
         def round_fn(caps, st):
             out = _prov_round_addmult(
-                rules,
+                pos_rules,
                 caps,
                 st["fs"],
                 st["fp"],
@@ -1291,9 +1712,30 @@ def _drive_addmult(
             st["didx"] = _pad_i32(st["didx"], D)
             return st
 
-        st = _run_overflow_protocol(
-            round_fn, st, n0, nd0, pad_delta, max_attempts
-        )
+        if pos_rules:
+            st = _run_overflow_protocol(
+                round_fn, st, n0, nd0, pad_delta, max_attempts
+            )
+        else:
+            F = max(_round_cap(4 * n0, 2048), st["fs"].shape[0])
+            D = _round_cap(max(2 * nd0, n0 // 2, 1024))
+            for k in ("fs", "fp", "fo"):
+                st[k] = _pad_u32(st[k], F)
+            st["ftag"] = _pad_f64(st["ftag"], F)
+            st = pad_delta(st, D)
+            st["n_delta"] = 0
+        if st is not None and naf_rules:
+            st = _drive_naf_addmult(
+                naf_rules,
+                st,
+                round_fn if pos_rules else None,
+                pad_delta,
+                provenance,
+                tag_store,
+                masks,
+                n0,
+                max_attempts,
+            )
         if st is None:
             return None  # graceful host fallback (reasoner state untouched)
         _write_back(

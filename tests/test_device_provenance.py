@@ -616,24 +616,59 @@ def test_three_shared_var_join_agreement():
     assert ht == dt
 
 
-def test_naf_cross_blocking_falls_back():
-    """A NAF rule whose conclusion unifies with another NAF rule's negated
-    premise depends on the host's sequential within-pass commits — the
-    snapshot-based device pass must refuse it."""
+def test_naf_cross_blocking_sequential_agreement():
+    """A NAF rule whose conclusion unifies with a LATER NAF rule's negated
+    premise depends on the host's sequential within-pass commits.  Since
+    round 5 the driver reproduces that order by dispatching one rule at a
+    time (earlier rules' commits visible to later rules) instead of
+    refusing — rows and tags must equal the host pass exactly."""
+
+    def build():
+        r = Reasoner()
+        r.add_abox_triple("a", "p", "b")
+        r.add_abox_triple("c", "p", "d")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?y", "blocked", "yes")],
+                negative=[("dummy", "d", "d")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "blocked", "yes")],
+            )
+        )
+        return r
+
+    for prov_cls in (BooleanProvenance, MinMaxProbability):
+        host, dev = both_paths(build, prov_cls())
+        assert host == dev
+    # rule 1 blocked every rule-2 derivation: no "ok" facts anywhere
+    r_chk = build()
+    chk_store = seed_tag_store(r_chk, BooleanProvenance())
+    out = infer_provenance_device(r_chk, BooleanProvenance(), chk_store)
+    assert out is not None
+    ok_p = r_chk.dictionary.lookup("ok")
+    assert not [
+        t for t in r_chk.facts.triples_set() if t[1] == ok_p
+    ], "later NAF rule must see the earlier rule's blocking commits"
+
+
+def test_naf_self_blocking_falls_back():
+    """A rule whose conclusion unifies its OWN negated premise: the host's
+    per-ROW commit order within one rule evaluation is load-bearing — the
+    device must still refuse this shape."""
     r = Reasoner()
     r.add_abox_triple("a", "p", "b")
+    r.add_abox_triple("b", "p", "c")
     r.add_rule(
         r.rule_from_strings(
             [("?x", "p", "?y")],
             [("?y", "blocked", "yes")],
-            negative=[("dummy", "d", "d")],
-        )
-    )
-    r.add_rule(
-        r.rule_from_strings(
-            [("?x", "p", "?y")],
-            [("?x", "ok", "?y")],
-            negative=[("?y", "blocked", "yes")],
+            negative=[("?x", "blocked", "yes")],
         )
     )
     prov = BooleanProvenance()
@@ -789,18 +824,253 @@ def test_naf_fuzz_agreement():
     assert accepted >= 8, f"only {accepted} fuzz trials took the device path"
 
 
-def test_naf_addmult_falls_back():
-    """Non-idempotent ⊕ keeps the host's exactly-once NAF accounting."""
+def test_naf_round5_fuzz_agreement():
+    """Round-5 surface fuzz: AddMult NAF (device seen-set) and cross-
+    blocking NAF rule PAIRS (sequential per-rule dispatch) over random
+    tagged graphs — device facts and tags must equal the host's, or the
+    driver must decline.  Seeded for reproducibility."""
+    import random
+
+    rng = random.Random(20260731)
+    provs = [AddMultProbability, MinMaxProbability, BooleanProvenance]
+    accepted = 0
+
+    for trial in range(12):
+        n_nodes = rng.randrange(5, 16)
+        base = [
+            (
+                f"n{rng.randrange(n_nodes)}",
+                rng.choice(["p", "r"]),
+                f"n{rng.randrange(n_nodes)}",
+                round(rng.uniform(0.2, 1.0), 2),
+            )
+            for _ in range(rng.randrange(8, 30))
+        ]
+        blockers = [
+            (f"n{rng.randrange(n_nodes)}", "broken", "yes",
+             round(rng.uniform(0.1, 1.0), 2))
+            for _ in range(rng.randrange(0, 5))
+        ]
+        cross = rng.random() < 0.6  # rule 1's conclusion blocks rule 2
+
+        def build():
+            r = Reasoner()
+            for s, p, o, t in base + blockers:
+                r.add_tagged_triple(s, p, o, t)
+            r.add_rule(
+                r.rule_from_strings(
+                    [("?x", "p", "?y")],
+                    [("?y", "flag", "yes")]
+                    if cross
+                    else [("?x", "d1", "?y")],
+                    negative=[("?y", "broken", "yes")],
+                )
+            )
+            r.add_rule(
+                r.rule_from_strings(
+                    [("?x", "r", "?y")],
+                    [("?x", "d2", "?y")],
+                    negative=[
+                        ("?y", "flag", "yes") if cross
+                        else ("?x", "broken", "yes")
+                    ],
+                )
+            )
+            return r
+
+        prov_cls = provs[trial % len(provs)]
+        r_host = build()
+        host_store = seed_tag_store(r_host, prov_cls())
+        infer_with_provenance(r_host, prov_cls(), host_store)
+        r_dev = build()
+        dev_store = seed_tag_store(r_dev, prov_cls())
+        out = infer_provenance_device(r_dev, prov_cls(), dev_store)
+        if out is None:
+            continue
+        accepted += 1
+        assert r_host.facts.triples_set() == r_dev.facts.triples_set(), trial
+        assert set(host_store.tags) == set(dev_store.tags), trial
+        for k, v in host_store.tags.items():
+            dv = dev_store.tags[k]
+            if isinstance(v, float):
+                assert abs(dv - v) < 1e-9, (trial, k, dv, v)
+            else:
+                assert dv == v, (trial, k, dv, v)
+    assert accepted >= 10, f"only {accepted} fuzz trials took the device path"
+
+
+def test_naf_addmult_agreement():
+    """AddMult (noisy-OR) NAF runs ON DEVICE since round 5: the per-rule
+    seen-set reproduces the host's exactly-once derivation accounting
+    (naf_seen), so tags must match to float precision."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.9)
+        r.add_tagged_triple("b", "p", "c", 0.8)
+        r.add_tagged_triple("c", "broken", "yes", 0.4)
+        for i in range(6):
+            r.add_tagged_triple(f"u{i}", "p", f"v{i % 3}", 0.3 + 0.1 * i)
+        r.add_tagged_triple("v1", "broken", "yes", 0.25)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        return r
+
+    host, dev = both_paths(build, AddMultProbability())
+    assert host[0] == dev[0]
+    assert set(host[1]) == set(dev[1])
+    for k, v in host[1].items():
+        assert abs(dev[1][k] - v) < 1e-9, (k, dev[1][k], v)
+
+
+def test_naf_addmult_exactly_once_across_passes():
+    """The seen-set must survive PASSES: the second stratified pass
+    re-evaluates every NAF rule against ALL facts, and without the host's
+    naf_seen semantics each re-derivation would noisy-OR-inflate its
+    conclusion tag.  Shape: two base-body NAF rules + a positive consumer
+    of one conclusion; the consumer's output lands in the OTHER NAF rule's
+    NEGATED premise (absent at first processing — host freezes that
+    first-read one() contribution, and so must the device)."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.6)
+        r.add_tagged_triple("c", "p", "d", 0.5)
+        r.add_tagged_triple("d", "blocked", "yes", 0.3)
+        r.add_tagged_triple("a", "r", "b", 0.7)
+        r.add_tagged_triple("e", "r", "f", 0.4)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "q", "?y")],
+                negative=[("?y", "blocked", "yes")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?x", "q", "?y")], [("?x", "s", "?y")])
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "r", "?y")],
+                [("?x", "w", "?y")],
+                negative=[("?x", "s", "?y")],
+            )
+        )
+        return r
+
+    host, dev = both_paths(build, AddMultProbability())
+    assert host[0] == dev[0]
+    assert set(host[1]) == set(dev[1])
+    for k, v in host[1].items():
+        assert abs(dev[1][k] - v) < 1e-9, (k, dev[1][k], v)
+
+
+def test_naf_addmult_improved_existing_conclusion_stays_out_of_delta():
+    """Host parity (code-review r5): _negative_pass returns only NEWLY
+    ADDED keys, so a NAF derivation that merely IMPROVES a pre-existing
+    conclusion's tag must NOT re-enter the positive stratum — downstream
+    tags stay at the positive stratum's value on BOTH paths."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.6)
+        r.add_tagged_triple("a", "q", "b", 0.5)  # pre-existing conclusion
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "q", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?x", "q", "?y")], [("?x", "s", "?y")])
+        )
+        return r
+
+    host, dev = both_paths(build, AddMultProbability())
+    assert host[0] == dev[0]
+    assert set(host[1]) == set(dev[1])
+    for k, v in host[1].items():
+        assert abs(dev[1][k] - v) < 1e-9, (k, dev[1][k], v)
+    # and the downstream s-tag specifically kept the stale 0.5
+    rr = build()
+    s_key = (
+        rr.dictionary.encode("a"),
+        rr.dictionary.encode("s"),
+        rr.dictionary.encode("b"),
+    )
+    assert abs(host[1][s_key] - 0.5) < 1e-9
+
+
+def test_naf_sequential_later_rule_improves_earlier_fresh_fact():
+    """Host parity (code-review r5): in a sequential (cross-blocking)
+    pass, a later rule can ⊕-improve a fact an earlier rule appended
+    fresh; the positive re-run must see the MERGED tag (the host reads
+    the tag store live), not the tag at the first rule's commit."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.3)
+        r.add_tagged_triple("c", "r", "b", 0.9)
+        r.add_tagged_triple("m", "q", "n", 0.8)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?y", "f", "hit")],
+                negative=[("k", "d", "k")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "r", "?y")],
+                [("?y", "f", "hit")],
+                negative=[("k", "d", "k")],
+            )
+        )
+        # cross-blocking: a rule negating f forces the sequential driver
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "q", "?y")],
+                [("?x", "out", "?y")],
+                negative=[("?x", "f", "hit")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?y", "f", "hit")], [("?y", "g", "hit")])
+        )
+        return r
+
+    host, dev = both_paths(build, MinMaxProbability())
+    assert host == dev
+    rr = build()
+    g_key = (
+        rr.dictionary.encode("b"),
+        rr.dictionary.encode("g"),
+        rr.dictionary.encode("hit"),
+    )
+    # g must carry the MERGED max(0.3, 0.9), not rule 1's commit-time 0.3
+    assert abs(host[1][g_key] - 0.9) < 1e-9
+
+
+def test_naf_addmult_premise_drift_still_falls_back():
+    """AddMult NAF whose conclusions REACH a NAF body premise (tag
+    feedback between passes) keeps the host fallback — the frozen
+    first-read semantics of naf_seen cannot be replayed by snapshot."""
     r = Reasoner()
-    r.add_abox_triple("a", "p", "b")
-    r.add_abox_triple("b", "broken", "yes")
+    r.add_tagged_triple("a", "p", "b", 0.5)
     r.add_rule(
         r.rule_from_strings(
             [("?x", "p", "?y")],
-            [("?x", "ok", "?y")],
-            negative=[("?y", "broken", "yes")],
+            [("?x", "q", "?y")],
+            negative=[("nowhere", "broken", "yes")],
         )
     )
+    r.add_rule(r.rule_from_strings([("?x", "q", "?y")], [("?x", "p", "?y")]))
     prov = AddMultProbability()
     store = seed_tag_store(r, prov)
     assert infer_provenance_device(r, prov, store) is None

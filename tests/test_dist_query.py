@@ -451,6 +451,37 @@ def test_order_by_mixed_key_types_global_decision(mesh):
     assert dist == host
 
 
+def test_order_by_pure_string_keys_mesh_topk(mesh):
+    """Non-numeric ORDER BY + LIMIT stays a MESH top-k over global string
+    ranks (readback k rows/shard) — not a full-result host re-order."""
+    import numpy as np
+
+    db = SparqlDatabase()
+    words = ["apple", "banana", "cherry", "date", "elder",
+             "fig", "grape", "kiwi", "lemon", "mango"]
+    lines = []
+    for i in range(200):
+        e = f"<http://x.e/e{i}>"
+        lines.append(f"{e} <http://x.e/works> <http://x.e/o{i % 5}> .")
+        lines.append(f'{e} <http://x.e/tag> "{words[i % 10]}_{i:03d}" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "host"
+    q = """SELECT ?e ?t WHERE {
+        ?e <http://x.e/works> ?o . ?e <http://x.e/tag> ?t
+    } ORDER BY ?t LIMIT 7"""
+    ex = DistQueryExecutor(mesh, db, q)
+    dist = ex.run()
+    host = execute_query_volcano(q, db)
+    assert len(host) == 7
+    assert dist == host
+    # the rank-aware mesh program's readback is k rows per shard, not the
+    # 200-row result: the top-k stage really ran on device
+    outs, valid, _t, _nan = ex.run_device(
+        topk=(8, (1,), (False,)), with_ranks=True
+    )
+    assert np.asarray(outs[0]).shape == (8, 8)
+
+
 # ---------------------------------------------------------------------------
 # MINUS / NOT as mesh anti-joins (round 4)
 # ---------------------------------------------------------------------------
