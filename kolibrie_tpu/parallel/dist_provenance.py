@@ -34,9 +34,13 @@ positive stratum quiesces, a :func:`_naf_pass` mesh program evaluates each
 NAF rule's body over the full fact block and resolves negated premises
 with a two-hop exchange (ground keys to their subject owner, negated tags
 back), then the pass's delta re-enters the positive stratum — the same
-stratified alternation as the single-chip driver.  NAF over addmult and
-cross-blocking NAF programs stay host-side (`Unsupported`), as do the
-structural semirings.
+stratified alternation as the single-chip driver.  Cross-blocking NAF
+rule sets (a conclusion unifying another rule's negated premise) dispatch
+ONE rule per mesh program in host rule order, with the pass delta
+recovered from the per-shard appended rows at pass end (round 5; same
+semantics as the single-chip sequential driver).  NAF over addmult and
+rules whose conclusion unifies their OWN negated premise stay host-side
+(`Unsupported`), as do the structural semirings.
 
 Parity: ``datalog/.../provenance_semi_naive.rs:26-34,134-197`` over
 ``semi_naive_parallel.rs``'s partitioning — redesigned as mesh-partitioned
@@ -64,6 +68,7 @@ from kolibrie_tpu.parallel.dist_join import (
     _RPAD32,
     exchange,
     local_join_u32,
+    mix32,
     shard_of_dev,
 )
 from kolibrie_tpu.parallel.dist_general import (
@@ -79,6 +84,7 @@ from kolibrie_tpu.reasoner.device_provenance import (
     _decode_tags,
     _guard_tag_array,
     _naf_cross_blocking,
+    _naf_self_blocking,
     _naf_premise_drift,
     _seed_tag_arrays,
     supports_idempotent,
@@ -477,6 +483,75 @@ def _commit_candidates(
     return out_state, new_count[None], overflow[None]
 
 
+def _naf_body(
+    lr,
+    plans,
+    fcols,
+    fv,
+    gside,
+    eff_f,
+    eff_g,
+    start_tag,
+    combine,
+    masks,
+    n,
+    axis,
+    join_cap,
+    bucket_cap,
+):
+    """Shared NAF-rule body evaluation over ALL facts: seed scan, routed
+    joins with the per-row tag folded by ``combine`` (⊗ = min for the
+    idempotent family, product for addmult), extra-var equality, filters.
+    Returns ``(table, tag, valid, overflow)`` — the negated premises and
+    commit differ per pass and stay with the callers."""
+    gs, gp, go, gv = gside
+    fs = fcols[0]
+    overflow = jnp.int32(0)
+    seed, steps = plans[0]
+    table, valid = _scan_premise(lr.premises[seed], fcols, fv)
+    tag = start_tag
+    for (j, kv, kpos, extra) in steps:
+        prem = lr.premises[j]
+        table, tag, valid, dropped = _exchange_tagged(
+            table, tag, valid, table[kv], n, axis, bucket_cap
+        )
+        overflow = overflow + dropped.astype(jnp.int32)
+        if kpos == 0:
+            side_cols, side_key, side_eff, side_valid = fcols, fs, eff_f, fv
+        else:
+            side_cols, side_key, side_eff, side_valid = (
+                (gs, gp, go),
+                go,
+                eff_g,
+                gv,
+            )
+        ptable, pmask = _scan_premise(prem, side_cols, side_valid)
+        li, ri, jvalid, total = local_join_u32(
+            table[kv], side_key, join_cap, valid, pmask
+        )
+        overflow = overflow + lax.psum(
+            jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+        )
+        new_table = {v: c[li] for v, c in table.items()}
+        for v, c in ptable.items():
+            if v not in new_table:
+                new_table[v] = c[ri]
+            elif v in extra:
+                jvalid = jvalid & (new_table[v] == c[ri])
+        tag = combine(tag[li], side_eff[ri])
+        table, valid = new_table, jvalid
+    for f in lr.filters:
+        col = table[f.var]
+        if f.kind == "eq":
+            valid = valid & (col == np.uint32(f.const_id))
+        elif f.kind == "ne":
+            valid = valid & (col != np.uint32(f.const_id))
+        else:
+            m = masks[f.mask_idx]
+            valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+    return table, tag, valid, overflow
+
+
 def _naf_pass(
     state,
     masks,
@@ -530,48 +605,23 @@ def _naf_pass(
     parts: List[tuple] = []
 
     for r_idx, (lr, plans) in enumerate(rules):
-        seed, steps = plans[0]  # one plan: the body runs over ALL facts
-        table, valid = _scan_premise(lr.premises[seed], fcols, fv)
-        tag = jnp.minimum(eff_f, gtags[r_idx])
-        for (j, kv, kpos, extra) in steps:
-            prem = lr.premises[j]
-            table, tag, valid, dropped = _exchange_tagged(
-                table, tag, valid, table[kv], n, axis, bucket_cap
-            )
-            overflow = overflow + dropped.astype(jnp.int32)
-            if kpos == 0:
-                side_cols, side_key, side_eff, side_valid = fcols, fs, eff_f, fv
-            else:
-                side_cols, side_key, side_eff, side_valid = (
-                    (gs, gp, go),
-                    go,
-                    eff_g,
-                    gv,
-                )
-            ptable, pmask = _scan_premise(prem, side_cols, side_valid)
-            li, ri, jvalid, total = local_join_u32(
-                table[kv], side_key, join_cap, valid, pmask
-            )
-            overflow = overflow + lax.psum(
-                jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
-            )
-            new_table = {v: c[li] for v, c in table.items()}
-            for v, c in ptable.items():
-                if v not in new_table:
-                    new_table[v] = c[ri]
-                elif v in extra:
-                    jvalid = jvalid & (new_table[v] == c[ri])
-            tag = jnp.minimum(tag[li], side_eff[ri])
-            table, valid = new_table, jvalid
-        for f in lr.filters:
-            col = table[f.var]
-            if f.kind == "eq":
-                valid = valid & (col == np.uint32(f.const_id))
-            elif f.kind == "ne":
-                valid = valid & (col != np.uint32(f.const_id))
-            else:
-                m = masks[f.mask_idx]
-                valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+        table, tag, valid, ovf_b = _naf_body(
+            lr,
+            plans,
+            fcols,
+            fv,
+            (gs, gp, go, gv),
+            eff_f,
+            eff_g,
+            jnp.minimum(eff_f, gtags[r_idx]),
+            jnp.minimum,
+            masks,
+            n,
+            axis,
+            join_cap,
+            bucket_cap,
+        )
+        overflow = overflow + ovf_b
         L = valid.shape[0]
         me = lax.axis_index(axis).astype(jnp.int32)
         for neg in lr.negs:
@@ -639,6 +689,222 @@ def _naf_pass(
     )
 
 
+def _naf_pass_addmult(
+    state,
+    seen,
+    n_seen,
+    masks,
+    one_enc,
+    gtag,
+    *,
+    rule,
+    n,
+    axis,
+    fact_cap,
+    delta_cap,
+    join_cap,
+    bucket_cap,
+    seen_cap,
+):
+    """ONE NAF rule's stratified pass for the addmult semiring, as a mesh
+    program (single-chip :func:`device_provenance._prov_naf_pass_addmult`
+    twin).  The driver dispatches rules sequentially in host order.
+
+    Exactly-once accounting on the mesh: candidate derivation rows route
+    by a hash of their FULL variable binding to a binding-owner shard, so
+    the owner-local [seen ∥ candidates] multi-operand sort (dedup +
+    membership + next-seen in one sort, exactly the single-chip trick) is
+    globally exact — the same binding always lands on the same owner.
+    ``seen`` is one sorted u32 column per rule variable, sharded
+    ``(n, seen_cap)``; ``n_seen`` is the per-shard count.
+
+    Negated premises resolve from the binding owner with the same two-hop
+    exchange as the idempotent pass (⊖ = 1 − t); conclusions instantiate
+    from the owned binding columns and flow into the shared commit with
+    ``kind="addmult"`` (segment noisy-OR at the subject owner) and
+    ``fresh_delta_only`` (host ``naf_new`` parity).
+    """
+    lr, plans = rule
+    (
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag_blk,
+        gv,
+        _ds,
+        _dp,
+        _do,
+        _dt,
+        _dv,
+    ) = (a[0] for a in state)
+    seen = tuple(a[0] for a in seen)
+    n_seen = n_seen[0][0]
+    masks = tuple(m for m in masks)
+    # one_enc rides only for signature symmetry with the idempotent pass
+    # (addmult's ⊗/⊕ identities are the literals 1.0 / 0.0 below)
+    g_scalar = gtag[0]
+
+    fcols = (fs, fp, fo)
+    eff_f = jnp.where(jnp.isnan(ftag), 1.0, ftag)
+    eff_g = jnp.where(jnp.isnan(gtag_blk), 1.0, gtag_blk)
+
+    # ---- body over ALL facts, ⊗ = product --------------------------------
+    table, tag, valid, overflow = _naf_body(
+        lr,
+        plans,
+        fcols,
+        fv,
+        (gs, gp, go, gv),
+        eff_f,
+        eff_g,
+        eff_f * g_scalar,
+        lambda a, b: a * b,
+        masks,
+        n,
+        axis,
+        join_cap,
+        bucket_cap,
+    )
+
+    # ---- route candidates to their binding owner -------------------------
+    var_names = tuple(sorted(table))
+    bhash = jnp.zeros(valid.shape[0], dtype=jnp.uint32)
+    for v in var_names:
+        bhash = mix32(bhash ^ table[v])
+    routed, rvalid, d_route = exchange(
+        tuple(table[v] for v in var_names) + (tag,),
+        valid,
+        (bhash % np.uint32(n)).astype(jnp.int32),
+        n,
+        axis,
+        bucket_cap,
+    )
+    overflow = overflow + d_route.astype(jnp.int32)
+    bind_in = routed[: len(var_names)]
+    tag_in = routed[len(var_names)]
+    n_cand = rvalid.shape[0]
+
+    # ---- owner-local seen/dedup: one multi-operand sort ------------------
+    sent = _RPAD32
+    seen_valid = jnp.arange(seen_cap, dtype=jnp.int32) < n_seen
+    ops = []
+    for k in range(len(var_names)):
+        cand = jnp.where(rvalid, bind_in[k], sent)
+        sc = jnp.where(seen_valid, seen[k], sent)
+        ops.append(jnp.concatenate([sc, cand]))
+    flag = jnp.concatenate(
+        [
+            jnp.zeros(seen_cap, dtype=jnp.uint32),
+            jnp.ones(n_cand, dtype=jnp.uint32),
+        ]
+    )
+    payload_tag = jnp.concatenate([jnp.zeros(seen_cap, jnp.float64), tag_in])
+    sorted_all = lax.sort(
+        (*ops, flag, payload_tag), num_keys=len(var_names) + 1
+    )
+    scols = sorted_all[: len(var_names)]
+    sflag = sorted_all[len(var_names)]
+    stag = sorted_all[len(var_names) + 1]
+    live = scols[0] != sent
+    head = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            jnp.any(jnp.stack([c[1:] != c[:-1] for c in scols]), axis=0),
+        ]
+    )
+    fire = live & head & (sflag == 1)
+    keep = live & head
+    n_seen_next = jnp.sum(keep)
+    overflow = overflow + lax.psum(
+        jnp.maximum(n_seen_next.astype(jnp.int32) - seen_cap, 0), axis
+    )
+    kdest = jnp.where(keep, jnp.cumsum(keep) - 1, seen_cap)
+    seen_next = tuple(
+        jnp.full(seen_cap, sent, dtype=jnp.uint32)
+        .at[kdest]
+        .set(c, mode="drop")
+        for c in scols
+    )
+    bind = {v: scols[k] for k, v in enumerate(var_names)}
+    L = seen_cap + n_cand
+    tag2 = stag
+
+    # ---- negated premises from the binding owner (two-hop) ---------------
+    me = lax.axis_index(axis).astype(jnp.int32)
+    for neg in lr.negs:
+        term_map = _pos2var(neg)
+        qs, qp, qo = _instantiate(term_map, neg.consts, bind, L)
+        rowid = jnp.arange(L, dtype=jnp.int32)
+        origin = jnp.full(L, 0, jnp.int32) + me
+        (rqs, rqp, rqo, rrow, rorig), rqv, d1 = exchange(
+            (qs, qp, qo, rowid, origin),
+            fire,
+            shard_of_dev(qs, n),
+            n,
+            axis,
+            bucket_cap,
+        )
+        overflow = overflow + d1.astype(jnp.int32)
+        idx, found = _index3((rqs, rqp, rqo), rqv, fcols, fv, fact_cap)
+        t = eff_f[jnp.clip(idx, 0, fact_cap - 1)]
+        ntag = jnp.where(found, 1.0 - t, 1.0)  # addmult ⊖ = 1 − t
+        (brow, bnt), bv, d2 = exchange(
+            (rrow, ntag), rqv, rorig, n, axis, bucket_cap
+        )
+        overflow = overflow + d2.astype(jnp.int32)
+        ntag_buf = (
+            jnp.full(L, 1.0, jnp.float64)
+            .at[jnp.where(bv, brow, L)]
+            .set(bnt, mode="drop")
+        )
+        tag2 = tag2 * ntag_buf
+    fire = fire & (tag2 > 0.0)  # zero-tag pruning
+
+    parts = []
+    for concl in lr.concls:
+        cols = []
+        for tkind, v in concl:
+            if tkind == "const":
+                cols.append(jnp.full(L, v, dtype=jnp.uint32))
+            else:
+                cols.append(bind[v])
+        parts.append((cols[0], cols[1], cols[2], tag2, fire))
+
+    out_state, new_count, ovf = _commit_candidates(
+        parts,
+        overflow,
+        fs,
+        fp,
+        fo,
+        ftag,
+        fv,
+        gs,
+        gp,
+        go,
+        gtag_blk,
+        gv,
+        kind="addmult",
+        n=n,
+        axis=axis,
+        fact_cap=fact_cap,
+        delta_cap=delta_cap,
+        bucket_cap=bucket_cap,
+        fresh_delta_only=True,
+    )
+    return (
+        out_state,
+        new_count,
+        ovf,
+        tuple(s[None] for s in seen_next),
+        n_seen_next.astype(jnp.int32)[None, None],
+    )
+
+
 def _compact(flags, mask, dest, cap):
     """Compact ``flags`` (u32 0/1) through the same scatter that built the
     next-delta columns, so row i of the delta carries its fresh/changed
@@ -679,7 +945,12 @@ class DistProvenanceReasoner:
         if supports_idempotent(provenance):
             self.kind = "idem"
         elif getattr(provenance, "name", None) == "addmult":
-            if _addmult_order_sensitive(reasoner.rules):
+            if _addmult_order_sensitive(
+                [r for r in reasoner.rules if not r.negative_premise]
+            ):
+                # POSITIVE rules only: NAF rules never run inside the
+                # round program (they dispatch sequentially in host order),
+                # and NAF→premise feedback is gated by _naf_premise_drift
                 raise Unsupported(
                     "addmult accumulation is rule-evaluation-order-dependent"
                     " for this rule set (a rule's conclusions feed a later"
@@ -690,12 +961,9 @@ class DistProvenanceReasoner:
             raise Unsupported(
                 f"semiring {provenance.name!r} has no distributed tag algebra"
             )
-        if self.kind == "addmult" and any(
-            r.negative_premise for r in reasoner.rules
-        ):
-            # non-idempotent ⊕: the host pass's exactly-once accounting
-            # (naf_seen) is load-bearing — stays host-side
-            raise Unsupported("stratified NAF over addmult stays host-side")
+        # (round 5: stratified NAF over addmult runs on the mesh — per-rule
+        # sequential dispatch with a binding-owner-routed seen relation
+        # reproducing the host's exactly-once naf_seen accounting)
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n = mesh.devices.size
@@ -714,13 +982,22 @@ class DistProvenanceReasoner:
             (lr, pl) for lr, pl in self.rules if not lr.negs
         )
         self.naf_rules = tuple((lr, pl) for lr, pl in self.rules if lr.negs)
-        if self.naf_rules and _naf_cross_blocking(
+        if self.naf_rules and _naf_self_blocking(
             [lr for lr, _ in self.naf_rules]
         ):
             raise Unsupported(
-                "a NAF conclusion unifies with a NAF negated premise: the"
-                " host's sequential within-pass commits are load-bearing"
+                "a NAF conclusion unifies with the SAME rule's negated"
+                " premise: the host's per-row sequential commits are"
+                " load-bearing"
             )
+        # CROSS-rule blocking runs SEQUENTIALLY (one rule per mesh
+        # dispatch, host rule order) instead of gating — round-5 parity
+        # with the single-chip driver; addmult NAF is ALWAYS sequential
+        # (its per-rule seen relations need the partition anyway)
+        self.naf_sequential = bool(self.naf_rules) and (
+            self.kind == "addmult"
+            or _naf_cross_blocking([lr for lr, _ in self.naf_rules])
+        )
         if self.naf_rules and _naf_premise_drift(
             [lr for lr, _ in self.rules], [lr for lr, _ in self.naf_rules]
         ):
@@ -738,6 +1015,8 @@ class DistProvenanceReasoner:
         self.delta_cap = delta_cap or round_cap(4 * n_local, 256)
         self.join_cap = join_cap or round_cap(4 * n_local, 256)
         self.bucket_cap = bucket_cap or round_cap(4 * n_local, 256)
+        # per-rule NAF seen-relation capacity (addmult exactly-once)
+        self.seen_cap = round_cap(4 * n_local, 256)
 
     def _wrap_body(self, body):
         spec = P(self.axis, None)
@@ -770,11 +1049,11 @@ class DistProvenanceReasoner:
             )
         )
 
-    def _naf_fn(self):
+    def _naf_fn(self, rules=None):
         return self._wrap_body(
             partial(
                 _naf_pass,
-                rules=self.naf_rules,
+                rules=self.naf_rules if rules is None else rules,
                 neg_kind=self.neg_kind,
                 n=self.n,
                 axis=self.axis,
@@ -782,6 +1061,53 @@ class DistProvenanceReasoner:
                 delta_cap=self.delta_cap,
                 join_cap=self.join_cap,
                 bucket_cap=self.bucket_cap,
+            )
+        )
+
+    @staticmethod
+    def _rule_vars(lr) -> int:
+        return len({v for prem in lr.premises for v, _pos in prem.vars})
+
+    def _naf_addmult_fn(self, rule):
+        """Wrap :func:`_naf_pass_addmult` for one rule: the state specs
+        plus this rule's seen-relation columns (one per rule variable)."""
+        k = self._rule_vars(rule[0])
+        spec = P(self.axis, None)
+        rep = P()
+        n_masks = len(self.bank.exprs)
+        body = partial(
+            _naf_pass_addmult,
+            rule=rule,
+            n=self.n,
+            axis=self.axis,
+            fact_cap=self.fact_cap,
+            delta_cap=self.delta_cap,
+            join_cap=self.join_cap,
+            bucket_cap=self.bucket_cap,
+            seen_cap=self.seen_cap,
+        )
+        return jax.jit(
+            jax.shard_map(
+                lambda state, seen, n_seen, masks, one, gtag: body(
+                    state, seen, n_seen, masks, one, gtag
+                ),
+                mesh=self.mesh,
+                check_vma=_dist_check_vma(),
+                in_specs=(
+                    (spec,) * 15,
+                    (spec,) * k,
+                    spec,
+                    (rep,) * n_masks,
+                    P(self.axis),
+                    rep,
+                ),
+                out_specs=(
+                    (spec,) * 15,
+                    P(self.axis),
+                    P(self.axis),
+                    (spec,) * k,
+                    spec,
+                ),
             )
         )
 
@@ -801,6 +1127,7 @@ class DistProvenanceReasoner:
             if result is not None:
                 return self._write_back(s, p, o, tags0, *result)
             self.fact_cap *= 2
+            self.seen_cap *= 2
             self.delta_cap *= 2
             self.join_cap *= 2
             self.bucket_cap *= 2
@@ -875,7 +1202,37 @@ class DistProvenanceReasoner:
             masks = tuple(jnp.asarray(m) for m in self.bank.materialize())
             one_arr = put(np.full((n, 1), one_enc, np.float64))
             round_fn = self._round_fn() if self.pos_rules else None
-            naf_fn = self._naf_fn() if self.naf_rules else None
+            if not self.naf_rules:
+                naf_fns = None
+            elif self.kind == "addmult":
+                # one mesh program per rule, each threading its own seen
+                # relation (exactly-once accounting across passes)
+                naf_fns = [self._naf_addmult_fn(nr) for nr in self.naf_rules]
+            elif self.naf_sequential:
+                # cross-blocking: one mesh program per rule, dispatched in
+                # host rule order so earlier rules' commits are visible
+                naf_fns = [
+                    self._naf_fn(rules=(nr,)) for nr in self.naf_rules
+                ]
+            else:
+                naf_fns = [self._naf_fn()]
+            if self.kind == "addmult" and self.naf_rules:
+                seen_state = [
+                    (
+                        tuple(
+                            put(
+                                np.full(
+                                    (n, self.seen_cap),
+                                    0xFFFFFFFF,
+                                    np.uint32,
+                                )
+                            )
+                            for _ in range(self._rule_vars(lr))
+                        ),
+                        put(np.zeros((n, 1), np.int32)),
+                    )
+                    for lr, _pl in self.naf_rules
+                ]
             gt_pos = jnp.asarray(
                 _guard_tag_array(
                     [lr for lr, _ in self.pos_rules],
@@ -913,15 +1270,87 @@ class DistProvenanceReasoner:
                 # positive stratum drained: fire one NAF pass (host
                 # stratified-loop parity); its delta re-enters the
                 # positive stratum
-                if naf_fn is None:
+                if naf_fns is None:
                     return extract(state)
-                state, count, overflow = naf_fn(
-                    state, masks, one_arr, gt_naf
-                )
-                if int(overflow[0]) > 0:
-                    return None
-                if int(count[0]) == 0:
-                    return extract(state)
+                if not self.naf_sequential:
+                    state, count, overflow = naf_fns[0](
+                        state, masks, one_arr, gt_naf
+                    )
+                    if int(overflow[0]) > 0:
+                        return None
+                    if int(count[0]) == 0:
+                        return extract(state)
+                else:
+                    # sequential pass: per-shard fact counts BEFORE, one
+                    # dispatch per rule, then the pass delta = exactly the
+                    # rows each shard appended during the pass, read back
+                    # WITH their final tags (a later rule may have
+                    # ⊕-improved an earlier rule's fresh fact — the host
+                    # reads the tag store live, and so must the re-run).
+                    # The readback is O(fact block) per PASS, not per rule
+                    # — passes are few (stratified quiescence) and the
+                    # sync-per-dispatch driver already reads counts; a
+                    # device-side slice extraction would save bandwidth if
+                    # NAF-heavy workloads ever show up in profiles
+                    n_before = np.asarray(state[4]).sum(axis=1)
+                    for i, fn in enumerate(naf_fns):
+                        if self.kind == "addmult":
+                            cols, cnt = seen_state[i]
+                            (
+                                state,
+                                count,
+                                overflow,
+                                cols2,
+                                cnt2,
+                            ) = fn(
+                                state,
+                                cols,
+                                cnt,
+                                masks,
+                                one_arr,
+                                gt_naf[i : i + 1],
+                            )
+                            seen_state[i] = (cols2, cnt2)
+                        else:
+                            state, count, overflow = fn(
+                                state, masks, one_arr, gt_naf[i : i + 1]
+                            )
+                        if int(overflow[0]) > 0:
+                            return None
+                    fs_h = np.asarray(state[0])
+                    fp_h = np.asarray(state[1])
+                    fo_h = np.asarray(state[2])
+                    ft_h = np.asarray(state[3])
+                    n_after = np.asarray(state[4]).sum(axis=1)
+                    per_shard = (n_after - n_before).astype(np.int64)
+                    if int(per_shard.sum()) == 0:
+                        return extract(state)
+                    if int(per_shard.max()) > self.delta_cap:
+                        return None  # retry at doubled caps
+                    dsl = np.zeros((n, self.delta_cap), np.uint32)
+                    dpl = np.zeros((n, self.delta_cap), np.uint32)
+                    dol = np.zeros((n, self.delta_cap), np.uint32)
+                    dtl = np.zeros((n, self.delta_cap), np.float64)
+                    dvl = np.zeros((n, self.delta_cap), bool)
+                    for si in range(n):
+                        b, a = int(n_before[si]), int(n_after[si])
+                        m = a - b
+                        if m == 0:
+                            continue
+                        dsl[si, :m] = fs_h[si, b:a]
+                        dpl[si, :m] = fp_h[si, b:a]
+                        dol[si, :m] = fo_h[si, b:a]
+                        t = ft_h[si, b:a]
+                        dtl[si, :m] = np.where(np.isnan(t), one_enc, t)
+                        dvl[si, :m] = True
+                    state = (
+                        *state[:10],
+                        put(dsl),
+                        put(dpl),
+                        put(dol),
+                        put(dtl),
+                        put(dvl),
+                    )
                 quiesced = round_fn is None
             raise RuntimeError(
                 "distributed tagged fixpoint hit the round limit"

@@ -2,8 +2,11 @@
 loop, on the virtual 8-device CPU mesh (conftest.py).
 
 Covers the idempotent scalar semirings (minmax / boolean / expiration)
-with multi-premise rules, filters, cross-shard tag improvement, and the
-Unsupported fallbacks (NAF, AddMult).
+with multi-premise rules, filters, cross-shard tag improvement; the
+exactly-once AddMult rounds; stratified NAF incl. the round-5 sequential
+cross-blocking dispatch and AddMult NAF (binding-owner seen relations);
+and the remaining Unsupported gates (self-blocking NAF, premise drift,
+order-sensitive positive addmult, structural semirings).
 """
 
 import pytest
@@ -239,39 +242,271 @@ def test_naf_only_program_agreement(mesh):
     assert host == dist
 
 
-def test_naf_addmult_unsupported(mesh):
-    r = Reasoner()
-    r.add_abox_triple("a", "p", "b")
-    r.add_rule(
-        r.rule_from_strings(
-            [("?x", "p", "?y")],
-            [("?x", "ok", "?y")],
-            negative=[("?y", "broken", "yes")],
+def test_naf_addmult_agreement_dist(mesh):
+    """AddMult (noisy-OR) NAF on the MESH (round 5): binding-owner-routed
+    seen relations reproduce the host's exactly-once naf_seen accounting;
+    tags must match to float precision."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.9)
+        r.add_tagged_triple("b", "p", "c", 0.8)
+        r.add_tagged_triple("c", "broken", "yes", 0.4)
+        for i in range(8):
+            r.add_tagged_triple(f"u{i}", "p", f"v{i % 3}", 0.3 + 0.08 * i)
+        r.add_tagged_triple("v1", "broken", "yes", 0.25)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
         )
+        return r
+
+    host, dist = both_paths(mesh, build, AddMultProbability())
+    assert host[0] == dist[0]
+    _close_tags(host[1], dist[1])
+
+
+def test_naf_addmult_exactly_once_across_passes_dist(mesh):
+    """The mesh seen relation must survive PASSES: pass 2 re-evaluates
+    every NAF rule, and without exactly-once accounting each re-derivation
+    would noisy-OR-inflate its conclusion."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.6)
+        r.add_tagged_triple("c", "p", "d", 0.5)
+        r.add_tagged_triple("d", "blocked", "yes", 0.3)
+        r.add_tagged_triple("a", "r", "b", 0.7)
+        r.add_tagged_triple("e", "r", "f", 0.4)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "q", "?y")],
+                negative=[("?y", "blocked", "yes")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?x", "q", "?y")], [("?x", "s", "?y")])
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "r", "?y")],
+                [("?x", "w", "?y")],
+                negative=[("?x", "s", "?y")],
+            )
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, AddMultProbability())
+    assert host[0] == dist[0]
+    _close_tags(host[1], dist[1])
+
+
+def test_naf_round5_fuzz_agreement_dist(mesh):
+    """Mesh twin of the single-chip round-5 NAF fuzz: addmult NAF and
+    cross-blocking rule pairs over random tagged graphs — mesh facts and
+    tags must equal the host loop's, or the driver must decline.  Fewer
+    trials than single-chip (each accepts pays mesh compiles)."""
+    import random
+
+    rng = random.Random(20260732)
+    provs = [AddMultProbability, MinMaxProbability, BooleanProvenance]
+    accepted = 0
+
+    for trial in range(6):
+        n_nodes = rng.randrange(5, 14)
+        base = [
+            (
+                f"n{rng.randrange(n_nodes)}",
+                rng.choice(["p", "r"]),
+                f"n{rng.randrange(n_nodes)}",
+                round(rng.uniform(0.2, 1.0), 2),
+            )
+            for _ in range(rng.randrange(8, 24))
+        ]
+        blockers = [
+            (f"n{rng.randrange(n_nodes)}", "broken", "yes",
+             round(rng.uniform(0.1, 1.0), 2))
+            for _ in range(rng.randrange(0, 4))
+        ]
+        cross = rng.random() < 0.6
+
+        def build():
+            r = Reasoner()
+            for s, p, o, t in base + blockers:
+                r.add_tagged_triple(s, p, o, t)
+            r.add_rule(
+                r.rule_from_strings(
+                    [("?x", "p", "?y")],
+                    [("?y", "flag", "yes")]
+                    if cross
+                    else [("?x", "d1", "?y")],
+                    negative=[("?y", "broken", "yes")],
+                )
+            )
+            r.add_rule(
+                r.rule_from_strings(
+                    [("?x", "r", "?y")],
+                    [("?x", "d2", "?y")],
+                    negative=[
+                        ("?y", "flag", "yes") if cross
+                        else ("?x", "broken", "yes")
+                    ],
+                )
+            )
+            return r
+
+        prov_cls = provs[trial % len(provs)]
+        r_host = build()
+        hs = seed_tag_store(r_host, prov_cls())
+        infer_with_provenance(r_host, prov_cls(), hs)
+        r_dist = build()
+        ds = seed_tag_store(r_dist, prov_cls())
+        try:
+            DistProvenanceReasoner(mesh, r_dist, prov_cls(), ds).infer()
+        except Unsupported:
+            continue
+        accepted += 1
+        assert r_host.facts.triples_set() == r_dist.facts.triples_set(), trial
+        assert set(hs.tags) == set(ds.tags), trial
+        for k, v in hs.tags.items():
+            dv = ds.tags[k]
+            if isinstance(v, float):
+                assert abs(dv - v) < 1e-9, (trial, k, dv, v)
+            else:
+                assert dv == v, (trial, k, dv, v)
+    assert accepted >= 5, f"only {accepted} fuzz trials took the mesh path"
+
+
+def test_naf_addmult_improved_existing_stays_out_of_delta_dist(mesh):
+    """Host naf_new parity on the mesh: a NAF derivation that only
+    IMPROVES a pre-existing conclusion must not re-enter the positive
+    stratum (downstream tags keep the stratum's value)."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.6)
+        r.add_tagged_triple("a", "q", "b", 0.5)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "q", "?y")],
+                negative=[("?y", "broken", "yes")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?x", "q", "?y")], [("?x", "s", "?y")])
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, AddMultProbability())
+    assert host[0] == dist[0]
+    _close_tags(host[1], dist[1])
+    rr = build()
+    s_key = (
+        rr.dictionary.encode("a"),
+        rr.dictionary.encode("s"),
+        rr.dictionary.encode("b"),
     )
-    prov = AddMultProbability()
-    store = seed_tag_store(r, prov)
-    with pytest.raises(Unsupported):
-        DistProvenanceReasoner(mesh, r, prov, store)
+    assert abs(host[1][s_key] - 0.5) < 1e-9
 
 
-def test_naf_cross_blocking_unsupported(mesh):
-    """A NAF conclusion unifying with a NAF negated premise depends on the
-    host's sequential within-pass commits — the mesh pass must refuse."""
+def test_naf_cross_blocking_sequential_agreement(mesh):
+    """A NAF conclusion unifying a LATER NAF rule's negated premise: since
+    round 5 the mesh driver dispatches one rule per program in host order
+    (sequential commits visible to later rules) instead of refusing."""
+
+    def build():
+        r = Reasoner()
+        r.add_abox_triple("a", "p", "b")
+        r.add_abox_triple("c", "p", "d")
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?y", "blocked", "yes")],
+                negative=[("dummy", "d", "d")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?x", "ok", "?y")],
+                negative=[("?y", "blocked", "yes")],
+            )
+        )
+        return r
+
+    for prov_cls in (BooleanProvenance, MinMaxProbability):
+        host, dist = both_paths(mesh, build, prov_cls())
+        assert host == dist
+    # rule 1's blocking commits must have reached rule 2
+    host_r = build()
+    hs = seed_tag_store(host_r, BooleanProvenance())
+    infer_with_provenance(host_r, BooleanProvenance(), hs)
+    ok_p = host_r.dictionary.lookup("ok")
+    assert not [t for t in host_r.facts.triples_set() if t[1] == ok_p]
+
+
+def test_naf_sequential_later_rule_improves_fresh_fact_dist(mesh):
+    """Sequential mesh pass: a later rule ⊕-improves a fact an earlier
+    rule appended; the positive re-run must see the merged tag (the pass
+    delta is read back from the fact block with final tags)."""
+
+    def build():
+        r = Reasoner()
+        r.add_tagged_triple("a", "p", "b", 0.3)
+        r.add_tagged_triple("c", "r", "b", 0.9)
+        r.add_tagged_triple("m", "q", "n", 0.8)
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "p", "?y")],
+                [("?y", "f", "hit")],
+                negative=[("k", "d", "k")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings(
+                [("?x", "r", "?y")],
+                [("?y", "f", "hit")],
+                negative=[("k", "d", "k")],
+            )
+        )
+        r.add_rule(  # cross-blocking: forces the sequential driver
+            r.rule_from_strings(
+                [("?x", "q", "?y")],
+                [("?x", "out", "?y")],
+                negative=[("?x", "f", "hit")],
+            )
+        )
+        r.add_rule(
+            r.rule_from_strings([("?y", "f", "hit")], [("?y", "g", "hit")])
+        )
+        return r
+
+    host, dist = both_paths(mesh, build, MinMaxProbability())
+    assert host == dist
+    rr = build()
+    g_key = (
+        rr.dictionary.encode("b"),
+        rr.dictionary.encode("g"),
+        rr.dictionary.encode("hit"),
+    )
+    assert abs(host[1][g_key] - 0.9) < 1e-9
+
+
+def test_naf_self_blocking_unsupported_dist(mesh):
+    """A rule whose conclusion unifies its OWN negated premise still
+    refuses (per-row host commit order is not reproducible)."""
     r = Reasoner()
     r.add_abox_triple("a", "p", "b")
     r.add_rule(
         r.rule_from_strings(
             [("?x", "p", "?y")],
             [("?y", "blocked", "yes")],
-            negative=[("dummy", "d", "d")],
-        )
-    )
-    r.add_rule(
-        r.rule_from_strings(
-            [("?x", "p", "?y")],
-            [("?x", "ok", "?y")],
-            negative=[("?y", "blocked", "yes")],
+            negative=[("?x", "blocked", "yes")],
         )
     )
     prov = BooleanProvenance()
