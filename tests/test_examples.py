@@ -25,6 +25,8 @@ TUTORIAL_EXAMPLES = [
     "19_fraud_detection_system.py",
     "20_mqtt_stream_bridge.py",
     "21_saving_predictor.py",
+    "22_http_client.py",
+    "23_real_dataset_lowlevel.py",
 ]
 
 

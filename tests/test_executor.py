@@ -563,3 +563,88 @@ class TestFormatDisplayCache:
             ["http://z.x/a", "hello"],
             ["http://z.x/new", "http://z.x/also_new"],
         ]
+
+
+def test_plan_cache_interleave_fuzz():
+    """Randomized INSERT / SELECT / mode-flip / UDF interleavings: the
+    cached-plan path must always return exactly what a cache-free database
+    returns for the same history.  Exercises slot invalidation (store
+    version bumps), per-mode slots, AST retention across mutations, and
+    eviction (cache capped), with device mode in the mix."""
+    import random
+
+    from kolibrie_tpu.query import executor as ex
+
+    rng = random.Random(20260733)
+    queries = [
+        "SELECT ?e ?w WHERE { ?e <http://f.z/works> ?w }",
+        "SELECT ?e ?s WHERE { ?e <http://f.z/works> ?w . "
+        "?e <http://f.z/sal> ?s }",
+        "SELECT DISTINCT ?w WHERE { ?e <http://f.z/works> ?w } ORDER BY ?w",
+        "SELECT ?w (COUNT(?e) AS ?n) WHERE { ?e <http://f.z/works> ?w } "
+        "GROUP BY ?w ORDER BY ?w",
+        "SELECT ?e ?s WHERE { ?e <http://f.z/sal> ?s FILTER(?s > 1050) }",
+        "SELECT ?y WHERE { ?e <http://f.z/sal> ?s . BIND(TAG(?s) AS ?y) }",
+    ]
+
+    def apply(db, kind, payload, outs):
+        if kind == "insert":
+            db.parse_ntriples(payload)
+        elif kind == "mode":
+            db.execution_mode = payload
+        elif kind == "udf":
+            db.register_udf("TAG", lambda s, v=payload: f"v{v}:{s}")
+        else:
+            outs.append(execute_query_volcano(payload, db))
+
+    def fresh(history):
+        """Replay a history on a brand-new db with the cache DISABLED
+        (entry lookups bypassed by clearing after every call)."""
+        db = SparqlDatabase()
+        db.register_udf("TAG", lambda s: f"v0:{s}")
+        outs: list = []
+        for kind, payload in history:
+            apply(db, kind, payload, outs)
+            db.__dict__.pop("_plan_cache", None)  # never reuse
+        return outs
+
+    # cap the cache at 3 entries so the 6-query rotation also exercises
+    # LRU eviction, not just hits
+    cap0 = ex._PLAN_CACHE_MAX
+    ex._PLAN_CACHE_MAX = 3
+    try:
+        for trial in range(6):
+            history = []
+            n_tr = 0
+            n_udf = 0
+            db = SparqlDatabase()
+            db.register_udf("TAG", lambda s: f"v0:{s}")
+            cached_outs: list = []
+            for step in range(rng.randrange(10, 18)):
+                r = rng.random()
+                if r < 0.22:
+                    lines = []
+                    for _ in range(rng.randrange(1, 5)):
+                        e = f"<http://f.z/e{n_tr}>"
+                        lines.append(
+                            f"{e} <http://f.z/works> <http://f.z/c{n_tr % 3}> ."
+                        )
+                        lines.append(
+                            f'{e} <http://f.z/sal> "{1000 + n_tr}" .'
+                        )
+                        n_tr += 1
+                    step_ = ("insert", "\n".join(lines))
+                elif r < 0.34:
+                    step_ = ("mode", rng.choice(["host", "device"]))
+                elif r < 0.42:
+                    # re-register the UDF with new semantics: cached plans
+                    # whose filters/binds bound v(n) must not serve v(n+1)
+                    n_udf += 1
+                    step_ = ("udf", n_udf)
+                else:
+                    step_ = ("query", rng.choice(queries))
+                history.append(step_)
+                apply(db, *step_, cached_outs)
+            assert cached_outs == fresh(history), (trial, history)
+    finally:
+        ex._PLAN_CACHE_MAX = cap0
