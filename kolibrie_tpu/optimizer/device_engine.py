@@ -2165,11 +2165,13 @@ def _order_limit(
     return out, valid[top], jnp.sum(valid), nan_seen
 
 
-def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
+def try_device_execute_ordered(db, q, cache_entry=None) -> Optional[List[List[str]]]:
     """ORDER BY + LIMIT entirely on device: plan execution, numeric-key
     top-k sort, O(limit) readback (SURVEY §7 step 3 "ORDER BY (device
     sort)").  ``None`` → host fallback (shape not expressible, or a sort
-    key is non-numeric — host orders those by decoded-string rank)."""
+    key is non-numeric — host orders those by decoded-string rank).
+    ``cache_entry``: plan-cache slot — repeat ordered queries reuse the
+    lowered program instead of re-planning/lowering."""
     from kolibrie_tpu.query.ast import Var
     from kolibrie_tpu.query.executor import (
         _device_routed,
@@ -2214,40 +2216,62 @@ def try_device_execute_ordered(db, q) -> Optional[List[List[str]]]:
     from kolibrie_tpu.optimizer.engine import resolve_pattern
     from kolibrie_tpu.optimizer.planner import Streamertail, build_logical_plan
 
-    resolved = [resolve_pattern(db, p) for p in w.patterns]
-    try:
-        logical = build_logical_plan(resolved, list(w.filters), [], w.values)
-        planner = Streamertail(db.get_or_build_stats())
-        plan = planner.find_best_plan(logical)
-        # UNION/OPTIONAL/MINUS/NOT fuse exactly as on the unordered path
-        from kolibrie_tpu.query.ast import WhereClause as _WC
-        from kolibrie_tpu.query.executor import _branch_plan
+    lowered = None
+    if cache_entry is not None and cache_entry["lowered"] not in (None, False):
+        clow = cache_entry["lowered"]
+        # a slot can hold a plain-BGP lowering captured by the host
+        # fallback (its UNION/OPTIONAL/MINUS/NOT ran as host post-passes,
+        # which this path does not apply) — only replay a program that
+        # actually FUSED the clause branches, or one for a clause-free
+        # WHERE
+        if getattr(clow, "fused_clauses", False) or not (
+            w.unions or w.optionals or w.minus or w.not_blocks
+        ):
+            lowered = clow  # repeat query: skip plan + lower
+    if lowered is None:
+        resolved = [resolve_pattern(db, p) for p in w.patterns]
+        try:
+            logical = build_logical_plan(
+                resolved, list(w.filters), [], w.values
+            )
+            planner = Streamertail(db.get_or_build_stats())
+            plan = planner.find_best_plan(logical)
+            # UNION/OPTIONAL/MINUS/NOT fuse exactly as on the unordered path
+            from kolibrie_tpu.query.ast import WhereClause as _WC
+            from kolibrie_tpu.query.executor import _branch_plan
 
-        union_groups, optional_plans, anti_plans = [], [], []
-        for groups in w.unions:
-            g = [_branch_plan(db, planner, bw) for bw in groups]
-            if any(bp is None for bp in g):
-                return None
-            union_groups.append(tuple(g))
-        for ow in w.optionals:
-            bp = _branch_plan(db, planner, ow)
-            if bp is None:
-                return None
-            optional_plans.append(bp)
-        for bw in list(w.minus) + [
-            _WC(patterns=nb.patterns) for nb in w.not_blocks
-        ]:
-            bp = _branch_plan(db, planner, bw)
-            if bp is None:
-                return None
-            anti_plans.append(bp)
-        lowered = lower_plan(
-            db, plan, tuple(anti_plans), tuple(union_groups), tuple(optional_plans)
-        )
-        if not lowered.const_ok():
-            return []  # a failed constant guard empties the result
-    except Unsupported:
-        return None
+            union_groups, optional_plans, anti_plans = [], [], []
+            for groups in w.unions:
+                g = [_branch_plan(db, planner, bw) for bw in groups]
+                if any(bp is None for bp in g):
+                    return None
+                union_groups.append(tuple(g))
+            for ow in w.optionals:
+                bp = _branch_plan(db, planner, ow)
+                if bp is None:
+                    return None
+                optional_plans.append(bp)
+            for bw in list(w.minus) + [
+                _WC(patterns=nb.patterns) for nb in w.not_blocks
+            ]:
+                bp = _branch_plan(db, planner, bw)
+                if bp is None:
+                    return None
+                anti_plans.append(bp)
+            lowered = lower_plan(
+                db,
+                plan,
+                tuple(anti_plans),
+                tuple(union_groups),
+                tuple(optional_plans),
+            )
+        except Unsupported:
+            return None
+        if cache_entry is not None:
+            cache_entry["plan"] = plan
+            cache_entry["lowered"] = lowered
+    if not lowered.const_ok():
+        return []  # a failed constant guard empties the result
     out_vars = lowered.out_vars
     if q.select_all():
         # ``*`` covers branch-bound vars too; internal (renamed) vars stay

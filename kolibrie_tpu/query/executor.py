@@ -328,7 +328,7 @@ def eval_select_to_table(
     prebuilt_lowered = None
     if q.group_by or any(i.kind == "agg" for i in q.select):
         table, prebuilt_plan, prebuilt_lowered = _try_device_aggregate(
-            db, q, use_optimizer
+            db, q, use_optimizer, cache_entry=cache_entry
         )
         if table is not None:
             if q.distinct:
@@ -370,14 +370,18 @@ def eval_select_to_table(
 
 
 def _try_device_aggregate(
-    db, q: SelectQuery, use_optimizer: bool
+    db, q: SelectQuery, use_optimizer: bool, cache_entry=None
 ) -> Tuple[Optional[BindingTable], Optional[object], Optional[object]]:
     """Aggregate query fused ON DEVICE (plan + GROUP BY segment-reduce in
     one device pipeline; readback is one row per group).  Returns
     ``(table, plan, lowered)``: table None → the normal eval_where + host
     aggregation path, which reuses the returned plan AND device-lowered
     plan when present (neither the optimizer nor plan lowering runs
-    twice on fallback; lowered False = lowering failed, don't retry)."""
+    twice on fallback; lowered False = lowering failed, don't retry).
+
+    ``cache_entry``: plan-cache slot — a populated slot replays the
+    cached plan + lowered program (repeat aggregate queries skip the
+    optimizer and lowering entirely); a fresh one captures them."""
     if not use_optimizer or not _device_routed(db):
         return None, None, None
     from kolibrie_tpu.query.subquery_inline import inline_subqueries
@@ -392,6 +396,25 @@ def _try_device_aggregate(
         lower_plan,
         try_device_execute_aggregated,
     )
+
+    if cache_entry is not None and cache_entry["plan"] is not None:
+        cplan, clow = cache_entry["plan"], cache_entry["lowered"]
+        if clow is False:
+            return None, cplan, False  # lowering known-failed this state
+        if clow is not None:
+            if not getattr(clow, "fused_clauses", False) and (
+                w.unions or w.optionals or w.minus or w.not_blocks
+            ):
+                # plain-BGP lowering for a clause-carrying WHERE: its
+                # UNION/OPTIONAL/MINUS/NOT ran as host post-passes on the
+                # first call — hand it back as prebuilts so eval_where
+                # replays exactly that route (device BGP + host clauses +
+                # host aggregation), never the fused aggregate pipeline
+                return None, cplan, clow
+            table = try_device_execute_aggregated(db, cplan, q, lowered=clow)
+            # table None here means the AGGREGATE stage declined (shape);
+            # the caller's host fallback still reuses plan+lowered
+            return table, cplan, clow
 
     resolved = [resolve_pattern(db, p) for p in w.patterns]
     logical = build_logical_plan(resolved, list(w.filters), [], w.values)
@@ -426,6 +449,11 @@ def _try_device_aggregate(
         anti_plans.append(bp)
     if not fusable and (w.unions or w.optionals or w.minus or w.not_blocks):
         return None, None, None
+    def _capture(p, low):
+        if cache_entry is not None:
+            cache_entry["plan"] = p
+            cache_entry["lowered"] = low
+
     try:
         lowered = lower_plan(
             db, plan, tuple(anti_plans), tuple(union_groups), tuple(optional_plans)
@@ -433,10 +461,14 @@ def _try_device_aggregate(
     except Unsupported:
         if anti_plans or union_groups or optional_plans:
             try:  # the plain BGP may still lower even if a branch cannot
-                return None, plan, lower_plan(db, plan)
+                plain = lower_plan(db, plan)
+                _capture(plan, plain)
+                return None, plan, plain
             except Unsupported:
                 pass
+        _capture(plan, False)
         return None, plan, False
+    _capture(plan, lowered)
     return (
         try_device_execute_aggregated(db, plan, q, lowered=lowered),
         plan,
@@ -740,7 +772,7 @@ def execute_select(
             try_device_execute_ordered,
         )
 
-        rows = try_device_execute_ordered(db, q)
+        rows = try_device_execute_ordered(db, q, cache_entry=cache_entry)
         if rows is not None:
             return rows
     table = eval_select_to_table(db, q, use_optimizer, cache_entry=cache_entry)

@@ -405,6 +405,100 @@ class TestPlanCache:
         (slot2,) = db.__dict__["_plan_cache"][self.Q]["by_state"].values()
         assert slot2["lowered"] is lowered_obj
 
+    def test_aggregate_query_reuses_lowered(self):
+        db = self._db()
+        db.execution_mode = "device"
+        q = (
+            "SELECT ?w (COUNT(?e) AS ?n) WHERE "
+            "{ ?e <http://e.x/works> ?w } GROUP BY ?w ORDER BY ?w"
+        )
+        r1 = execute_query_volcano(q, db)
+        (slot,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        assert slot["lowered"] not in (None, False)
+        lowered_obj = slot["lowered"]
+        r2 = execute_query_volcano(q, db)
+        assert r2 == r1 and len(r1) == 7
+        (slot2,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        assert slot2["lowered"] is lowered_obj
+        # mutation invalidates the slot but the answer stays correct
+        db.parse_ntriples(
+            "<http://e.x/zz> <http://e.x/works> <http://e.x/c0> ."
+        )
+        r3 = execute_query_volcano(q, db)
+        assert r3 != r1 and len(r3) == 7
+
+    def test_ordered_limit_query_reuses_lowered(self):
+        db = self._db()
+        db.execution_mode = "device"
+        q = (
+            "SELECT ?e ?s WHERE { ?e <http://e.x/sal> ?s } "
+            "ORDER BY DESC(?s) LIMIT 5"
+        )
+        r1 = execute_query_volcano(q, db)
+        (slot,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        assert slot["lowered"] not in (None, False)
+        lowered_obj = slot["lowered"]
+        r2 = execute_query_volcano(q, db)
+        assert r2 == r1 and len(r1) == 5
+        assert r1[0][1] == "1199"  # top salary of the 200-employee db
+        (slot2,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        assert slot2["lowered"] is lowered_obj
+
+    def test_ordered_replay_keeps_host_clause_postpasses(self):
+        """Code-review r5: the ordered path must NOT replay a plain-BGP
+        lowering (captured by the host fallback) for a clause-carrying
+        WHERE — run 2 would silently drop the MINUS."""
+        db = SparqlDatabase()
+        lines = []
+        for i in range(10):
+            e = f"<http://e.x/e{i}>"
+            lines.append(f'{e} <http://e.x/sal> "{1000 + i}" .')
+            if i % 2 == 0:
+                lines.append(f"{e} <http://e.x/flag> <http://e.x/y> .")
+        db.parse_ntriples("\n".join(lines))
+        db.execution_mode = "device"
+        # the OPTIONAL inside MINUS keeps the branch un-fusable, so the
+        # device path lowers only the plain BGP and the MINUS runs host-side
+        q = (
+            "SELECT ?e ?s WHERE { ?e <http://e.x/sal> ?s "
+            "MINUS { ?e <http://e.x/flag> ?f "
+            "OPTIONAL { ?f <http://e.x/nothing> ?z } } } "
+            "ORDER BY DESC(?s) LIMIT 3"
+        )
+        r1 = execute_query_volcano(q, db)
+        r2 = execute_query_volcano(q, db)
+        assert r1 == r2
+        assert [r[0] for r in r1] == [
+            "http://e.x/e9",
+            "http://e.x/e7",
+            "http://e.x/e5",
+        ]
+
+    def test_aggregate_replay_keeps_host_clause_postpasses(self):
+        """Code-review r5: the aggregate path must NOT replay a plain-BGP
+        lowering through the fused aggregate pipeline when the WHERE
+        carries clauses the first call applied host-side."""
+        db = SparqlDatabase()
+        db.parse_ntriples(
+            "<http://e.x/a> <http://e.x/works> <http://e.x/c1> .\n"
+            "<http://e.x/b> <http://e.x/works> <http://e.x/c1> .\n"
+            "<http://e.x/c> <http://e.x/works> <http://e.x/c2> .\n"
+            "<http://e.x/t1> <http://e.x/tag> <http://e.x/v> .\n"
+            "<http://e.x/t2> <http://e.x/tag> <http://e.x/v> .\n"
+        )
+        db.execution_mode = "device"
+        # OPTIONAL sharing no variable with the BGP: un-fusable → host
+        # post-pass cross-product doubles every count
+        q = (
+            "SELECT ?w (COUNT(?e) AS ?n) WHERE { "
+            "?e <http://e.x/works> ?w "
+            "OPTIONAL { ?x <http://e.x/tag> ?t } } GROUP BY ?w ORDER BY ?w"
+        )
+        r1 = execute_query_volcano(q, db)
+        r2 = execute_query_volcano(q, db)
+        assert r1 == r2
+        assert r1 == [["http://e.x/c1", "4"], ["http://e.x/c2", "2"]]
+
     def test_mode_flip_keeps_both_lowered_states(self):
         db = self._db()
         db.execution_mode = "device"
@@ -585,6 +679,17 @@ def test_plan_cache_interleave_fuzz():
         "GROUP BY ?w ORDER BY ?w",
         "SELECT ?e ?s WHERE { ?e <http://f.z/sal> ?s FILTER(?s > 1050) }",
         "SELECT ?y WHERE { ?e <http://f.z/sal> ?s . BIND(TAG(?s) AS ?y) }",
+        # clause shapes: fusable MINUS, un-fusable MINUS (nested OPTIONAL),
+        # and an un-fusable OPTIONAL under aggregation — the cache must
+        # never replay a plain-BGP lowering past host clause post-passes
+        "SELECT ?e ?s WHERE { ?e <http://f.z/sal> ?s "
+        "MINUS { ?e <http://f.z/works> <http://f.z/c0> } } ORDER BY ?s "
+        "LIMIT 4",
+        "SELECT ?e ?s WHERE { ?e <http://f.z/sal> ?s "
+        "MINUS { ?e <http://f.z/works> ?w "
+        "OPTIONAL { ?w <http://f.z/none> ?z } } } ORDER BY DESC(?s) LIMIT 3",
+        "SELECT ?w (COUNT(?e) AS ?n) WHERE { ?e <http://f.z/works> ?w "
+        "OPTIONAL { ?x <http://f.z/sal> ?t } } GROUP BY ?w ORDER BY ?w",
     ]
 
     def apply(db, kind, payload, outs):
