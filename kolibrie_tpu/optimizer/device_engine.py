@@ -2165,6 +2165,18 @@ def _order_limit(
     return out, valid[top], jnp.sum(valid), nan_seen
 
 
+def clause_replayable(lowered, w) -> bool:
+    """True when a cached lowered program may be replayed WITHOUT the host
+    clause post-passes: it either fused the WHERE's
+    UNION/OPTIONAL/MINUS/NOT branches itself, or the WHERE has none.  A
+    plain-BGP lowering for a clause-carrying WHERE must instead replay
+    through ``eval_where`` (device BGP + host post-passes) — THE shared
+    eligibility rule for every cache-replay site."""
+    return getattr(lowered, "fused_clauses", False) or not (
+        w.unions or w.optionals or w.minus or w.not_blocks
+    )
+
+
 def try_device_execute_ordered(db, q, cache_entry=None) -> Optional[List[List[str]]]:
     """ORDER BY + LIMIT entirely on device: plan execution, numeric-key
     top-k sort, O(limit) readback (SURVEY §7 step 3 "ORDER BY (device
@@ -2219,15 +2231,14 @@ def try_device_execute_ordered(db, q, cache_entry=None) -> Optional[List[List[st
     lowered = None
     if cache_entry is not None and cache_entry["lowered"] not in (None, False):
         clow = cache_entry["lowered"]
-        # a slot can hold a plain-BGP lowering captured by the host
-        # fallback (its UNION/OPTIONAL/MINUS/NOT ran as host post-passes,
-        # which this path does not apply) — only replay a program that
-        # actually FUSED the clause branches, or one for a clause-free
-        # WHERE
-        if getattr(clow, "fused_clauses", False) or not (
-            w.unions or w.optionals or w.minus or w.not_blocks
-        ):
+        if clause_replayable(clow, w):
             lowered = clow  # repeat query: skip plan + lower
+        else:
+            # a plain-BGP lowering in the slot for a clause-carrying WHERE
+            # proves the fused attempt FAILED at this state — re-planning
+            # here would fail identically, so memoize the negative and let
+            # eval_where replay the cached program with host post-passes
+            return None
     if lowered is None:
         resolved = [resolve_pattern(db, p) for p in w.patterns]
         try:

@@ -397,14 +397,14 @@ def _try_device_aggregate(
         try_device_execute_aggregated,
     )
 
+    from kolibrie_tpu.optimizer.device_engine import clause_replayable
+
     if cache_entry is not None and cache_entry["plan"] is not None:
         cplan, clow = cache_entry["plan"], cache_entry["lowered"]
         if clow is False:
             return None, cplan, False  # lowering known-failed this state
         if clow is not None:
-            if not getattr(clow, "fused_clauses", False) and (
-                w.unions or w.optionals or w.minus or w.not_blocks
-            ):
+            if not clause_replayable(clow, w):
                 # plain-BGP lowering for a clause-carrying WHERE: its
                 # UNION/OPTIONAL/MINUS/NOT ran as host post-passes on the
                 # first call — hand it back as prebuilts so eval_where
@@ -447,12 +447,23 @@ def _try_device_aggregate(
             fusable = False
             break
         anti_plans.append(bp)
-    if not fusable and (w.unions or w.optionals or w.minus or w.not_blocks):
-        return None, None, None
     def _capture(p, low):
         if cache_entry is not None:
             cache_entry["plan"] = p
             cache_entry["lowered"] = low
+
+    if not fusable and (w.unions or w.optionals or w.minus or w.not_blocks):
+        # branches un-fusable: eval_where will run the plain device BGP
+        # with host clause post-passes + host aggregation — lower and
+        # cache that program HERE so repeats (and this call's fallback)
+        # skip the second optimizer pass and the re-lowering
+        try:
+            plain = lower_plan(db, plan)
+            _capture(plan, plain)
+            return None, plan, plain
+        except Unsupported:
+            _capture(plan, False)
+            return None, plan, False
 
     try:
         lowered = lower_plan(
