@@ -421,13 +421,16 @@ def supervise():
                 "taken earlier in the round (see captured_at)"
             )
             sec["tpu_failures_live"] = failures
+            # print the in-hand record IMMEDIATELY — the driver records
+            # the LAST JSON line, so if anything below is cut short by an
+            # external deadline this line still stands as the capture
+            print(json.dumps(cand), flush=True)
             if "value_single_dispatch" not in cand:
                 # the cached capture predates this round's co-reported
                 # fields (unamortized pair, native twin, plan-cache e2e):
                 # attach a LIVE forced-CPU run so the round still records
-                # the new shape's host-side numbers honestly.  Nothing in
-                # this attempt may lose the cached record in hand — a
-                # spawn failure just skips the augmentation.
+                # the new shape's host-side numbers honestly, re-printing
+                # the augmented record as the new last line
                 try:
                     line, _fail = _run_child({"KOLIBRIE_BENCH_CPU": "1"})
                 except Exception:
@@ -442,9 +445,9 @@ def supervise():
                             ),
                             "secondary": cpu_rec.get("secondary"),
                         }
+                        print(json.dumps(cand), flush=True)
                     except ValueError:
                         pass
-            print(json.dumps(cand))
             return 0
     except (OSError, ValueError):
         pass

@@ -393,11 +393,10 @@ def _try_device_aggregate(
         return None, None, None
     from kolibrie_tpu.optimizer.device_engine import (
         Unsupported,
+        clause_replayable,
         lower_plan,
         try_device_execute_aggregated,
     )
-
-    from kolibrie_tpu.optimizer.device_engine import clause_replayable
 
     if cache_entry is not None and cache_entry["plan"] is not None:
         cplan, clow = cache_entry["plan"], cache_entry["lowered"]

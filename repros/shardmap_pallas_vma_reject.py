@@ -37,6 +37,15 @@ def main() -> int:
     import jaxlib
     from jax.sharding import Mesh, PartitionSpec as P
 
+    # This repro is CPU-by-design (the vma checker rejects at TRACE time;
+    # no chip involved) — pin the backend so a dead TPU tunnel can never
+    # hang it at device discovery (the env preloads the axon platform,
+    # and jax.config is the only override that still works then).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized by the caller
+
     # version pin: upstream behavior — see repros/OBSERVED_VERSIONS.md
     print(f"jax {jax.__version__} / jaxlib {jaxlib.__version__}", flush=True)
 
