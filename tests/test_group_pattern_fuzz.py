@@ -123,13 +123,21 @@ def test_group_pattern_fuzz(db):
         db.execution_mode = "device"
         try:
             dev = execute_query_volcano(q, db)
+            # second run replays through the plan cache (round 5): the
+            # cached lowered program — fused, plain-BGP + host post-pass,
+            # aggregate, or ordered — must reproduce the first answer for
+            # EVERY clause mix in the corpus
+            dev2 = execute_query_volcano(q, db)
         except Exception as e:
             raise AssertionError(f"trial {trial} device: {q!r} raised {e}") from e
+        assert dev2 == dev, (trial, q, "device cache replay diverged")
         db.execution_mode = "host"
         try:
             host = execute_query_volcano(q, db)
+            host2 = execute_query_volcano(q, db)
         except Exception as e:
             raise AssertionError(f"trial {trial} host: {q!r} raised {e}") from e
+        assert host2 == host, (trial, q, "host cache replay diverged")
         if mode == 1:
             # the device top-k may keep a DIFFERENT representative of rows
             # tied at the LIMIT boundary (documented; both are valid
