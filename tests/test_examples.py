@@ -27,6 +27,7 @@ TUTORIAL_EXAMPLES = [
     "21_saving_predictor.py",
     "22_http_client.py",
     "23_real_dataset_lowlevel.py",
+    "24_sparql_syntax_tour.py",
 ]
 
 
