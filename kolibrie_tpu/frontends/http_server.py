@@ -117,9 +117,158 @@ class EngineSession:
                 self.subscribers.remove(q)
 
 
+def _pct(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+class _BatchRequest:
+    __slots__ = ("text", "done", "result", "error")
+
+    def __init__(self, text: str):
+        self.text = text
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class TemplateBatcher:
+    """Serving-side micro-batcher over one persistent store.
+
+    Handler threads call :meth:`submit`; requests that land within the
+    batching window ride one dispatch.  Inside a dispatch, identical
+    query texts are deduplicated (one execution, shared result) and
+    same-template queries are stacked into a single vmap program by
+    ``execute_queries_batched`` — under load, N constant-variants of one
+    query shape cost one device call, not N.
+
+    The first waiter whose window expires claims ``dispatch_lock`` and
+    drains the whole pending list (leader election); followers just wait
+    on their request event.  All database access — dispatch, loads,
+    stats — serializes on ``dispatch_lock``, so the engine itself never
+    sees concurrency."""
+
+    def __init__(self, db, window_ms: float = 5.0):
+        self.db = db
+        self.window = window_ms / 1000.0
+        self.lock = threading.Lock()  # guards pending + counters
+        self.dispatch_lock = threading.Lock()  # serializes db access
+        self.pending: List[_BatchRequest] = []
+        self.requests = 0
+        self.dispatches = 0
+        self.dedup_hits = 0
+        self.max_batch = 0
+        # fp -> {"requests", "dedup_hits", "lat": [dispatch ms, ...]}
+        self.templates: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------- dispatch
+
+    def submit(self, text: str):
+        req = _BatchRequest(text)
+        with self.lock:
+            self.pending.append(req)
+            self.requests += 1
+        # collect followers for one window, then elect a dispatcher; loop
+        # covers the race where a drain happened between append and wait
+        while not req.done.wait(timeout=self.window):
+            if self.dispatch_lock.acquire(blocking=False):
+                try:
+                    with self.lock:
+                        batch, self.pending = self.pending, []
+                    if batch:
+                        self._run_batch(batch)
+                finally:
+                    self.dispatch_lock.release()
+            if req.done.is_set():
+                break
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _run_batch(self, batch: List[_BatchRequest]) -> None:
+        from kolibrie_tpu.query.executor import (
+            execute_queries_batched,
+            execute_query_volcano,
+        )
+
+        texts = [r.text for r in batch]
+        uniq = list(dict.fromkeys(texts))
+        start = time.perf_counter()
+        try:
+            by_text = dict(zip(uniq, execute_queries_batched(self.db, uniq)))
+        except Exception:
+            # one bad member must not fail its batch-mates: solo retries
+            for r in batch:
+                try:
+                    r.result = execute_query_volcano(r.text, self.db)
+                except Exception as e:
+                    r.error = e
+                r.done.set()
+            self._count(batch, texts, uniq, time.perf_counter() - start)
+            return
+        for r in batch:
+            r.result = by_text[r.text]
+            r.done.set()
+        self._count(batch, texts, uniq, time.perf_counter() - start)
+
+    def _count(self, batch, texts, uniq, elapsed: float) -> None:
+        ms = elapsed * 1000.0
+        parse_cache = self.db.__dict__.get("_plan_cache", {})
+        by_fp: Dict[str, List[str]] = {}
+        for text in uniq:
+            ent = parse_cache.get(text)
+            by_fp.setdefault((ent or {}).get("fp") or "unparsed", []).append(text)
+        with self.lock:
+            self.dispatches += 1
+            self.dedup_hits += len(texts) - len(uniq)
+            self.max_batch = max(self.max_batch, len(batch))
+            for fp, members in by_fp.items():
+                rec = self.templates.setdefault(
+                    fp, {"requests": 0, "dedup_hits": 0, "lat": []}
+                )
+                for text in members:
+                    rec["requests"] += texts.count(text)
+                    rec["dedup_hits"] += texts.count(text) - 1
+                rec["lat"].append(ms)
+                del rec["lat"][:-256]  # bounded latency window
+
+    # ---------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        from kolibrie_tpu.optimizer.device_engine import device_compile_stats
+        from kolibrie_tpu.query.executor import plan_cache_info
+
+        with self.lock:
+            per = {
+                fp: {
+                    "requests": rec["requests"],
+                    "dedup_hits": rec["dedup_hits"],
+                    "dispatches": len(rec["lat"]),
+                    "dispatch_ms_p50": _pct(rec["lat"], 0.50),
+                    "dispatch_ms_p95": _pct(rec["lat"], 0.95),
+                }
+                for fp, rec in self.templates.items()
+            }
+            out = {
+                "requests": self.requests,
+                "dispatches": self.dispatches,
+                "dedup_hits": self.dedup_hits,
+                "max_batch": self.max_batch,
+                "per_template": per,
+            }
+        with self.dispatch_lock:
+            out["triples"] = len(self.db.store)
+            out["plan_cache"] = plan_cache_info(self.db)
+        out["device_compiles"] = device_compile_stats()
+        return out
+
+
 class _ServerState:
     def __init__(self):
         self.sessions: Dict[str, EngineSession] = {}
+        self.stores: Dict[str, TemplateBatcher] = {}
         self.lock = threading.Lock()
         self.counter = itertools.count(1)
 
@@ -244,11 +393,18 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         if self.path.startswith("/rsp/events/"):
             self._handle_sse(self.path[len("/rsp/events/"):])
             return
+        if self.path == "/stats":
+            self._handle_stats()
+            return
         self._send_error_json("not found", 404)
 
     def do_POST(self):
         if self.path == "/query":
             self._handle_query()
+        elif self.path == "/store/load":
+            self._handle_store_load()
+        elif self.path == "/store/query":
+            self._handle_store_query()
         elif self.path == "/explain":
             self._handle_explain()
         elif self.path == "/rsp-query":
@@ -361,6 +517,89 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 }
             )
         self._send_json({"results": results})
+
+    # ----------------------------------------------------- persistent stores
+
+    def _handle_store_load(self):
+        """Create or extend a persistent store: {"store_id"?, "rdf",
+        "format"?, "mode"?} → {"store_id", "loaded", "triples"}.  Unlike
+        /query (fresh database per request), the store survives across
+        requests so repeat queries hit the warm plan-template cache and
+        concurrent same-template queries micro-batch."""
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        req = self._read_json()
+        if req is None:
+            return
+        state = self.state
+        sid = str(req.get("store_id") or "")
+        with state.lock:
+            if not sid:
+                sid = f"store-{next(state.counter)}"
+            batcher = state.stores.get(sid)
+            if batcher is None:
+                db = SparqlDatabase()
+                db.execution_mode = req.get("mode") or "device"
+                batcher = TemplateBatcher(db)
+                state.stores[sid] = batcher
+        try:
+            with batcher.dispatch_lock:
+                if req.get("mode"):
+                    batcher.db.execution_mode = req["mode"]
+                n = _load_rdf_into(
+                    batcher.db, req.get("rdf") or "", req.get("format", "ntriples")
+                )
+        except Exception as e:
+            self._send_error_json(f"RDF parse error: {e}")
+            return
+        self._send_json(
+            {"store_id": sid, "loaded": n, "triples": len(batcher.db.store)}
+        )
+
+    def _handle_store_query(self):
+        """Query a persistent store through the template batcher:
+        {"store_id", "sparql"} → {"data", "execution_time_ms"}.  In-flight
+        identical queries are answered by one execution; same-template
+        variants within the batching window share one device dispatch."""
+        req = self._read_json()
+        if req is None:
+            return
+        if not req.get("sparql"):
+            self._send_error_json("No query provided")
+            return
+        state = self.state
+        with state.lock:
+            batcher = state.stores.get(str(req.get("store_id") or ""))
+        if batcher is None:
+            self._send_error_json("store not found", 404)
+            return
+        start = time.perf_counter()
+        try:
+            rows = batcher.submit(strip_hash_comments(req["sparql"]))
+        except Exception as e:
+            self._send_error_json(f"Query failed: {e}")
+            return
+        self._send_json(
+            {
+                "data": rows,
+                "execution_time_ms": (time.perf_counter() - start) * 1000.0,
+            }
+        )
+
+    def _handle_stats(self):
+        """Serving metrics per store: request/dedup/batch counters, per-
+        template dispatch latency percentiles, the two-level plan-cache
+        snapshot, and jit compile counts."""
+        state = self.state
+        with state.lock:
+            stores = dict(state.stores)
+            n_sessions = len(state.sessions)
+        self._send_json(
+            {
+                "stores": {sid: b.stats() for sid, b in stores.items()},
+                "rsp_sessions": n_sessions,
+            }
+        )
 
     # ------------------------------------------------------------ /rsp-query
 

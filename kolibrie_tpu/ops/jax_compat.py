@@ -1,0 +1,38 @@
+"""Version-portable jax API surface.
+
+The engine targets the modern top-level spellings (``jax.enable_x64``,
+``jax.shard_map``); older installs (<= 0.4.x) only ship them under
+``jax.experimental``.  Every in-tree consumer imports the two names from
+here so the whole device path keeps one compatibility seam.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # jax <= 0.4.x
+    from jax.experimental import enable_x64  # noqa: F401
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax <= 0.4.x: also translate the modern ``check_vma`` kwarg to its
+    # old spelling ``check_rep``
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+    @_functools.wraps(_shard_map_raw)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_raw(*args, **kwargs)
+
+if hasattr(jax, "typeof"):
+    typeof = jax.typeof
+else:  # jax <= 0.4.x: the abstract value carries the same attributes the
+    # callers probe for (they getattr with a default, so pre-vma avals work)
+    from jax.core import get_aval as typeof  # noqa: F401
+
+__all__ = ["enable_x64", "shard_map", "typeof"]

@@ -50,6 +50,7 @@ from functools import partial
 from typing import Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64, typeof as _typeof
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -90,7 +91,7 @@ def _pallas_call(*args, **kwargs):
     inner = pl.pallas_call(*args, **kwargs)
 
     def launch(*operands):
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             return inner(*operands)
 
     return launch
@@ -209,7 +210,7 @@ def _join_prepass(lkey_u, lval, rkey_u):
     low = jnp.searchsorted(rkey_u, lkey_u, side="left").astype(jnp.int32)
     high = jnp.searchsorted(rkey_u, lkey_u, side="right").astype(jnp.int32)
     counts = high - low
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         total64 = jnp.sum(counts.astype(jnp.int64))
     # Compact to rows with ≥1 match (stable: False sorts before True).
     order = jnp.argsort(counts == 0, stable=True)
@@ -303,7 +304,7 @@ def _pallas_join_core(
     # rejects the kernel's internal dynamic_slice), making this branch
     # dormant — it exists so the escape hatch can be dropped the moment
     # jax accepts pallas_call under vma checking.
-    vma = getattr(jax.typeof(lkey_u), "vma", None)
+    vma = getattr(_typeof(lkey_u), "vma", None)
     kwargs = {"vma": vma} if vma else {}
     out_shape = [
         jax.ShapeDtypeStruct((n_tiles, TILE), jnp.int32, **kwargs)
@@ -393,7 +394,7 @@ def _pallas_join_core_chunked(
         out_specs=[out_block] * 4,
         scratch_shapes=[pltpu.VMEM((2 * BW, _NCOLS), jnp.int32)],
     )
-    vma = getattr(jax.typeof(lkey_u), "vma", None)
+    vma = getattr(_typeof(lkey_u), "vma", None)
     kwargs = {"vma": vma} if vma else {}
     out_shape = [
         jax.ShapeDtypeStruct((t_c, TILE), jnp.int32, **kwargs)

@@ -47,6 +47,7 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64
 import numpy as np
 
 from kolibrie_tpu.optimizer import plan as P
@@ -64,7 +65,15 @@ from kolibrie_tpu.query.ast import (
     Var,
 )
 
-__all__ = ["Unsupported", "lower_plan", "try_device_execute", "PreparedQuery"]
+__all__ = [
+    "Unsupported",
+    "lower_plan",
+    "try_device_execute",
+    "PreparedQuery",
+    "execute_plan_batch",
+    "device_compile_stats",
+    "template_scan_cap",
+]
 
 from kolibrie_tpu.ops import round_cap as _round_cap
 
@@ -196,9 +205,28 @@ class QuotedCheck:
 
 @dataclass(frozen=True)
 class IdCmp:
+    """ID equality against a runtime parameter: the constant lives in the
+    uint32 parameter vector (``uparams[param_idx]``), NOT in the spec —
+    ``?v = <iri>`` and ``?v = <other-iri>`` share one compiled program."""
+
     op: str  # '=' | '!='
     var: str
-    const_id: int
+    param_idx: int
+
+
+@dataclass(frozen=True)
+class NumConstCmp:
+    """Numeric compare of a variable's value against a runtime parameter
+    (``fparams[param_idx]``, f64).  Replaces the host-precomputed per-ID
+    :class:`MaskRef` masks for constant numeric filters: same semantics as
+    :func:`numeric_filter_mask` (NaN = non-numeric, always excluded) but
+    the constant is a traced operand, so ``?age > 30`` and ``?age > 40``
+    are ONE executable — and the O(dictionary) host mask build per
+    constant disappears."""
+
+    op: str
+    var: str
+    param_idx: int
 
 
 @dataclass(frozen=True)
@@ -251,12 +279,14 @@ def _plan_body(
     values,
     numf,
     quoted,
+    params,
     use_pallas=False,
 ):
     import jax.numpy as jnp
 
     from kolibrie_tpu.ops.device_join import _LPAD, _RPAD, join_indices
 
+    uparams, fparams = params
     counts: List = []
 
     def eval_expr(expr, cols, valid):
@@ -280,8 +310,25 @@ def _plan_body(
 
             return (cols[expr.var] & jnp.uint32(QUOTED_BIT)) != 0
         if isinstance(expr, IdCmp):
-            eq = cols[expr.var] == jnp.uint32(expr.const_id)
+            eq = cols[expr.var] == uparams[expr.param_idx]
             return eq if expr.op == "=" else ~eq
+        if isinstance(expr, NumConstCmp):
+            vals = numf[jnp.minimum(cols[expr.var], numf.shape[0] - 1)]
+            c = fparams[expr.param_idx]
+            op = expr.op
+            if op == "=":
+                res = vals == c
+            elif op == "!=":
+                res = vals != c
+            elif op == "<":
+                res = vals < c
+            elif op == "<=":
+                res = vals <= c
+            elif op == ">":
+                res = vals > c
+            else:
+                res = vals >= c
+            return res & ~jnp.isnan(vals)
         if isinstance(expr, NumCmp):
             a = numf[jnp.minimum(cols[expr.lvar], numf.shape[0] - 1)]
             b = numf[jnp.minimum(cols[expr.rvar], numf.shape[0] - 1)]
@@ -347,8 +394,8 @@ def _plan_body(
                 & ((qcol & jnp.uint32(QUOTED_BIT)) != 0)
             )
             inner = (qs[posc], qp[posc], qo[posc])
-            for ipos, cid in node.const_checks:
-                valid = valid & (inner[ipos] == jnp.uint32(cid))
+            for ipos, pidx in node.const_checks:
+                valid = valid & (inner[ipos] == uparams[pidx])
             for var, ipos in node.out_vars:
                 cols[var] = inner[ipos]
             for ipos, var in node.eq_checks:
@@ -502,10 +549,52 @@ def _run_plan(
     values,
     numf,
     quoted,
+    params,
 ):
     return _plan_body(
-        spec, order_arrays, scalars, masks, values, numf, quoted, use_pallas
+        spec, order_arrays, scalars, masks, values, numf, quoted, params, use_pallas
     )
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _run_plan_batch(
+    spec: PlanSpec,
+    order_arrays,
+    scalars_b,
+    masks,
+    values,
+    numf,
+    quoted,
+    params_b,
+):
+    """Stacked-parameter dispatch: ONE executable evaluating the same plan
+    template for a whole batch of constant-variants (vmap over the scan
+    ranges and the packed parameter vectors; store operands broadcast).
+    The serving layer's micro-batcher lands here.  Pallas kernels don't
+    vmap, so the batch always takes the pure-XLA join formulation."""
+
+    def one(scalars, params):
+        return _plan_body(
+            spec, order_arrays, scalars, masks, values, numf, quoted, params, False
+        )
+
+    return jax.vmap(one, in_axes=(0, (0, 0)))(scalars_b, params_b)
+
+
+def device_compile_stats() -> Dict[str, int]:
+    """Per-entry-point jit cache sizes — the compile counter the template
+    tests/bench assert on (a recompile ⇒ a new cache entry)."""
+    out = {}
+    for name, fn in (
+        ("run_plan", _run_plan),
+        ("run_plan_k", _run_plan_k),
+        ("run_plan_batch", _run_plan_batch),
+    ):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:  # cache API absent on some jax versions
+            out[name] = -1
+    return out
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "use_pallas"))
@@ -519,6 +608,7 @@ def _run_plan_k(
     values,
     numf,
     quoted,
+    params,
 ):
     """Execute the SAME compiled plan body ``k`` times in one dispatch with a
     loop-carried dependency (benchmark amortization: the shared-TPU tunnel's
@@ -533,7 +623,7 @@ def _run_plan_k(
         # hoist the iteration body because scalars depends on the carry
         sc = scalars + (carry >> jnp.int64(62)).astype(scalars.dtype)
         out, valid, _counts = _plan_body(
-            spec, order_arrays, sc, masks, values, numf, quoted, use_pallas
+            spec, order_arrays, sc, masks, values, numf, quoted, params, use_pallas
         )
         checksum = sum(c.astype(jnp.uint64).sum() for c in out)
         nrows = jnp.sum(valid).astype(jnp.int64)
@@ -571,6 +661,11 @@ class LoweredPlan:
         self.join_count = 0
         self.need_numf = False
         self.need_quoted = False
+        # packed runtime parameter vectors: query constants live HERE (one
+        # slot per syntactic constant site, traversal order — never
+        # deduplicated by value, so the slot layout is a template property)
+        self.u_params: List[int] = []  # uint32 term-id constants
+        self.f_params: List[float] = []  # f64 numeric comparands
         self.quoted_specs: List[str] = []  # synthetic qid column names
         # fully-constant patterns: hoisted out of the join tree as host
         # membership guards — a failed guard empties the whole result
@@ -719,10 +814,21 @@ class LoweredPlan:
         if not self.out_vars:
             raise Unsupported("no output variables")
         self._compact_orders()
-        # stable key for the db-level join-capacity cache — scan constants
-        # included so structurally identical plans over different predicates
-        # don't share capacity entries
-        self.cap_key = (self.root, self.out_vars, tuple(self.scan_descs))
+        # stable key for the db-level capacity caches.  TEMPLATE-level on
+        # purpose: constants live in the parameter vectors (the spec tree
+        # only carries param indices), and the scan descriptors contribute
+        # only their (order, bound-position) shape — so every constant
+        # variant of one query template shares capacities, which is what
+        # keeps the assembled PlanSpec (a static jit argument) bit-identical
+        # across variants: ONE compile per template.
+        self.cap_key = (
+            self.root,
+            self.out_vars,
+            tuple(
+                (name, tuple(c is not None for c in consts))
+                for name, consts in self.scan_descs
+            ),
+        )
 
     def _compact_orders(self) -> None:
         """Drop sort orders no longer referenced after join-driven order
@@ -889,9 +995,11 @@ class LoweredPlan:
         quoted_at: List[tuple] = []  # (outer_pos, synthetic var, inner terms)
         for pos, t in enumerate(terms):
             if t.kind == "id":
-                if t.value is None:
-                    raise Unsupported("unknown constant (empty scan)")
-                consts.append(int(t.value))
+                # a constant not in the dictionary can never match: keep the
+                # scan (template shape is a structural property, not a
+                # property of this variant's constants) and mark the slot so
+                # _scan_ranges emits an empty (lo, 0) range
+                consts.append(-1 if t.value is None else int(t.value))
             elif t.kind == "var":
                 consts.append(None)
             else:
@@ -943,9 +1051,10 @@ class LoweredPlan:
         newly: set = set()
         for ipos, it in enumerate(inner):
             if it.kind == "id":
-                if it.value is None:
-                    raise Unsupported("unknown constant in quoted pattern")
-                q_const.append((ipos, int(it.value)))
+                # unknown inner constant: parameterize with the never-an-ID
+                # sentinel (dictionary.rs:36-40) — the check can never pass
+                cid = 0xFFFFFFFF if it.value is None else int(it.value)
+                q_const.append((ipos, self._uparam(cid)))
             elif it.kind == "var":
                 name = it.value
                 if name in bound_vars or name in newly:
@@ -1032,6 +1141,16 @@ class LoweredPlan:
 
     # ---------------------------------------------------------- filter lowering
 
+    def _uparam(self, value: int) -> int:
+        """Allocate the next uint32 parameter slot; returns its index."""
+        self.u_params.append(int(value) & 0xFFFFFFFF)
+        return len(self.u_params) - 1
+
+    def _fparam(self, value: float) -> int:
+        """Allocate the next f64 parameter slot; returns its index."""
+        self.f_params.append(float(value))
+        return len(self.f_params) - 1
+
     def _compute_mask(self, key: tuple) -> np.ndarray:
         if key[0] == "str":
             _tag, name, pattern, which = key
@@ -1051,12 +1170,6 @@ class LoweredPlan:
 
     def _store_sizes(self) -> tuple:
         return (len(self.db.dictionary.id_to_str), len(self.db.quoted))
-
-    def _numeric_mask(self, op: str, const: float, flip: bool) -> MaskRef:
-        """Host-precomputed per-ID mask for ``var op const`` (exact f64)."""
-        if flip:
-            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
-        return MaskRef(self._mask_index((op, const)), "")  # var by caller
 
     def _refresh_masks(self) -> None:
         """Rebuild per-ID filter masks if the dictionary (or quoted store —
@@ -1106,7 +1219,7 @@ class LoweredPlan:
             if name == "BOUND":
                 from kolibrie_tpu.ops.join import UNBOUND
 
-                return IdCmp("!=", args[0].name, int(UNBOUND))
+                return IdCmp("!=", args[0].name, self._uparam(int(UNBOUND)))
             return QuotedCheck(args[0].name)
         if (
             name in self._STR_FUNCS
@@ -1150,8 +1263,13 @@ class LoweredPlan:
             return NumCmp(op, lhs.name, rhs.name)
         num = self._as_number(rhs)
         if num is not None:
-            ref = self._numeric_mask(op, num, flip)
-            return MaskRef(ref.mask_idx, lhs.name)
+            if flip:
+                op = {
+                    "<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                    "=": "=", "!=": "!=",
+                }[op]
+            self.need_numf = True
+            return NumConstCmp(op, lhs.name, self._fparam(num))
         if op not in ("=", "!="):
             raise Unsupported("ordered comparison with non-numeric constant")
         if isinstance(rhs, IriRef):
@@ -1160,7 +1278,9 @@ class LoweredPlan:
             tid = self.db.dictionary.lookup(rhs.value)
         else:
             raise Unsupported(f"filter rhs {type(rhs).__name__}")
-        return IdCmp(op, lhs.name, 0xFFFFFFFF if tid is None else int(tid))
+        return IdCmp(
+            op, lhs.name, self._uparam(0xFFFFFFFF if tid is None else int(tid))
+        )
 
     # ------------------------------------------------------------- assembly
 
@@ -1176,6 +1296,8 @@ class LoweredPlan:
                 for c in order.perm
                 if consts[pos_of[c]] is not None
             ]
+            if any(k < 0 for k in keys):
+                continue  # unknown constant: (0, 0) — matches nothing
             if not keys:
                 lo, hi = 0, len(order)
             elif len(keys) == 1:
@@ -1297,8 +1419,19 @@ class LoweredPlan:
         """Assemble (spec, array_args) for the current store/capacities."""
         self._refresh_masks()
         scan_ranges = self._scan_ranges()
+        # scan capacities are a TEMPLATE property: the largest key-group of
+        # the order's bound-column prefix bounds the live range for ANY
+        # constant, so every variant assembles the same ScanSpec.cap (the
+        # variant's true range rides in the traced scalars)
         scan_caps = {
-            i: _round_cap(int(scan_ranges[i, 1])) for i in range(len(self.scan_descs))
+            i: _round_cap(
+                template_scan_cap(
+                    self.db,
+                    name,
+                    sum(c is not None for c in consts),
+                )
+            )
+            for i, (name, consts) in enumerate(self.scan_descs)
         }
         join_caps = self._initial_join_caps(scan_caps)
         self._scan_ranges_np = scan_ranges
@@ -1329,7 +1462,21 @@ class LoweredPlan:
             if self.need_quoted
             else tuple(jnp.zeros(1, dtype=jnp.uint32) for _ in range(4))
         )
-        return spec, (order_arrays, scalars, masks, values, numf, quoted)
+        params = self.device_params()
+        return spec, (order_arrays, scalars, masks, values, numf, quoted, params)
+
+    def device_params(self):
+        """Pack the query constants as the (uparams, fparams) traced
+        operands — the parameter-vector ABI: one uint32 slot per term-id
+        constant site and one f64 slot per numeric comparand site, in
+        lowering traversal order (padded to length >= 1 so empty templates
+        keep a stable operand shape)."""
+        import jax.numpy as jnp
+
+        u = np.asarray(self.u_params or [0], dtype=np.uint32)
+        f = np.asarray(self.f_params or [0.0], dtype=np.float64)
+        with _enable_x64(True):
+            return (jnp.asarray(u), jnp.asarray(f, dtype=jnp.float64))
 
     def _device_numf(self):
         return device_numf(self.db)
@@ -1373,8 +1520,22 @@ class LoweredPlan:
 
                 return (cols[expr.var] & np.uint32(QUOTED_BIT)) != 0
             if isinstance(expr, IdCmp):
-                eq = cols[expr.var] == np.uint32(expr.const_id)
+                eq = cols[expr.var] == np.uint32(self.u_params[expr.param_idx])
                 return eq if expr.op == "=" else ~eq
+            if isinstance(expr, NumConstCmp):
+                vals = numf[np.minimum(cols[expr.var], len(numf) - 1)]
+                const = self.f_params[expr.param_idx]
+                ops = {
+                    "=": np.equal,
+                    "!=": np.not_equal,
+                    "<": np.less,
+                    "<=": np.less_equal,
+                    ">": np.greater,
+                    ">=": np.greater_equal,
+                }
+                with np.errstate(invalid="ignore"):
+                    res = ops[expr.op](vals, const)
+                return res & ~np.isnan(vals)
             if isinstance(expr, NumCmp):
                 a = numf[np.minimum(cols[expr.lvar], len(numf) - 1)]
                 b = numf[np.minimum(cols[expr.rvar], len(numf) - 1)]
@@ -1456,8 +1617,8 @@ class LoweredPlan:
                 posc = np.minimum(pos, len(qid) - 1)
                 mask = (qid[posc] == qcol) & ((qcol & QUOTED_BIT) != 0)
                 inner = [qs_[posc], qp_[posc], qo_[posc]]
-                for ipos, cid in node.const_checks:
-                    mask = mask & (inner[ipos] == cid)
+                for ipos, pidx in node.const_checks:
+                    mask = mask & (inner[ipos] == np.uint32(self.u_params[pidx]))
                 for var, ipos in node.out_vars:
                     cols[var] = inner[ipos]
                 for ipos, var in node.eq_checks:
@@ -1528,8 +1689,9 @@ class LoweredPlan:
         self._scan_ranges_np = self._scan_ranges()
         _table, counts = self.host_execute()
         self._join_caps = [_round_cap(c) for c in counts]
-        self.db.__dict__.setdefault("_device_cap_cache", {})[self.cap_key] = tuple(
-            self._join_caps
+        self._store_caps()
+        self._join_caps = list(
+            self.db.__dict__["_device_cap_cache"][self.cap_key]
         )
         return counts
 
@@ -1540,7 +1702,7 @@ class LoweredPlan:
         from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
 
         spec, args = self.build(tag)
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             return _run_plan(spec, pallas_join_enabled(), *args)
 
     def run_k(self, k: int, tag: int = 0):
@@ -1549,13 +1711,21 @@ class LoweredPlan:
         from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
 
         spec, args = self.build(tag)
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             return _run_plan_k(spec, k, pallas_join_enabled(), *args)
 
     def _store_caps(self) -> None:
-        self.db.__dict__.setdefault("_device_cap_cache", {})[self.cap_key] = tuple(
-            self._join_caps
-        )
+        """Publish join capacities to the per-db template cache.  Merge is
+        a MONOTONIC max: the cache is shared by every constant variant of
+        the template, and shrinking a cap for one variant would recompile
+        (and possibly overflow) the next."""
+        cache = self.db.__dict__.setdefault("_device_cap_cache", {})
+        prev = cache.get(self.cap_key)
+        caps = tuple(self._join_caps)
+        if prev is not None and len(prev) == len(caps):
+            caps = tuple(max(a, b) for a, b in zip(prev, caps))
+        cache[self.cap_key] = caps
+        self._join_caps = list(caps)
 
     def converge(self, out, max_attempts: int = 12):
         """Validate join counts against the capacities ``out`` ran with;
@@ -1664,6 +1834,10 @@ class LoweredPlan:
         walk(self.root, 0)
         for s, p, o in self.const_checks:
             lines.append(f"const-guard ({s} {p} {o})")
+        if self.u_params or self.f_params:
+            lines.append(
+                f"params u32={list(self.u_params)} f64={list(self.f_params)}"
+            )
         lines.append(f"project -> {' '.join('?' + v for v in self.out_vars)}")
         return "\n".join(lines)
 
@@ -1749,8 +1923,128 @@ def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:
     return m & ~np.isnan(vals)
 
 
+def template_scan_cap(db, order_name: str, n_bound: int) -> int:
+    """Upper bound on ANY constant-variant's live range for a scan whose
+    ``order_name`` prefix binds ``n_bound`` columns: the largest key-group
+    of that prefix.  This is what makes ``ScanSpec.cap`` a property of the
+    TEMPLATE rather than of one variant's constants (shape-stable
+    compilation).  O(store) to compute, cached per (order, prefix, store
+    size) on the database."""
+    store = db.store
+    n = len(store)
+    if n == 0:
+        return 1
+    if n_bound <= 0:
+        return n
+    cache = db.__dict__.setdefault("_device_group_cap_cache", {})
+    key = (order_name, n_bound, n)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    order = store.order(order_name)
+    rows = order.slice_rows(0, n)
+    change = np.zeros(n, dtype=bool)
+    change[0] = True
+    for c in order.perm[:n_bound]:
+        col = rows[c]
+        change[1:] |= col[1:] != col[:-1]
+    bounds = np.append(np.flatnonzero(change), n)
+    cap = int(np.max(np.diff(bounds)))
+    cache[key] = cap
+    return cap
+
+
 def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> LoweredPlan:
     return LoweredPlan(db, plan, anti_plans, union_groups, optional_plans)
+
+
+def execute_plan_batch(
+    lowereds: List[LoweredPlan], max_attempts: int = 12
+) -> List[BindingTable]:
+    """Run MANY constant-variants of ONE plan template as a single
+    stacked-parameter device dispatch (:func:`_run_plan_batch`): the scan
+    ranges and packed parameter vectors stack along a batch axis, the
+    store operands broadcast.  Returns one host table per input, each
+    identical to that plan's own ``execute()``.
+
+    Every member must have lowered to the same template (equal assembled
+    spec — guaranteed when they share a fingerprint); members with string
+    masks must carry identical patterns, and VALUES templates are not
+    batchable (their rows are per-variant constants outside the parameter
+    ABI).  Join-capacity convergence is max-over-batch: one overflow
+    doubles the shared template cap for everyone."""
+    import jax.numpy as jnp
+
+    if not lowereds:
+        return []
+    base = lowereds[0]
+    for lp in lowereds[1:]:
+        if lp.mask_exprs != base.mask_exprs:
+            raise Unsupported("batch members differ in string-mask patterns")
+        if lp.values_tables or base.values_tables:
+            raise Unsupported("VALUES templates are not batchable")
+    results: List[Optional[BindingTable]] = [None] * len(lowereds)
+    live = []
+    for i, lp in enumerate(lowereds):
+        if lp.const_ok():
+            live.append(i)
+        else:
+            results[i] = lp.empty_table()
+    if not live:
+        return results
+    for _attempt in range(max_attempts):
+        spec0 = None
+        base_args = None
+        scal, ups, fps = [], [], []
+        for i in live:
+            lp = lowereds[i]
+            spec, args = lp.build(tag=0)
+            if spec0 is None:
+                spec0, base_args = spec, args
+            elif spec != spec0:
+                raise Unsupported(
+                    "batch members lowered to different templates"
+                )
+            scal.append(np.asarray(lp._scan_ranges_np))
+            ups.append(np.asarray(lp.u_params or [0], dtype=np.uint32))
+            fps.append(np.asarray(lp.f_params or [0.0], dtype=np.float64))
+        order_arrays, _sc, masks, values, numf, quoted, _pp = base_args
+        with _enable_x64(True):
+            params_b = (
+                jnp.asarray(np.stack(ups)),
+                jnp.asarray(np.stack(fps), dtype=jnp.float64),
+            )
+            out_cols, valid, counts = _run_plan_batch(
+                spec0,
+                order_arrays,
+                jnp.asarray(np.stack(scal)),
+                masks,
+                values,
+                numf,
+                quoted,
+                params_b,
+            )
+        lp0 = lowereds[live[0]]
+        caps = lp0._join_caps
+        maxc = [int(np.max(np.asarray(c))) for c in counts]
+        over = [j for j, c in enumerate(maxc) if c > caps[j]]
+        if not over:
+            break
+        for j in over:
+            lp0._join_caps[j] = _round_cap(2 * maxc[j])
+        lp0._store_caps()
+    else:
+        raise RuntimeError("batched plan capacities failed to converge")
+    cols_h = [np.asarray(c) for c in out_cols]
+    valid_h = np.asarray(valid)
+    for b, i in enumerate(live):
+        lp = lowereds[i]
+        v = valid_h[b]
+        results[i] = {
+            var: ch[b][v].astype(np.uint32)
+            for var, ch in zip(lp.out_vars, cols_h)
+        }
+    return results
 
 
 def try_device_execute(
@@ -1966,7 +2260,7 @@ def try_device_execute_aggregated(
             return None
         funcs.append(a.func)
 
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         out_cols, valid = lowered.converge(lowered.run())
     return aggregate_table(
         db, tuple(out_cols), valid, q.group_by, agg_items, gpos, funcs, apos
@@ -2030,7 +2324,7 @@ def device_string_ranks(db):
     ]
     _, inv = np.unique(np.array(strs), return_inverse=True)
     ranks = inv.astype(np.float64)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         arrs = (
             jnp.asarray(ranks[:n_d]),
             jnp.asarray(
@@ -2051,7 +2345,7 @@ def device_numf(db):
     vals = db.numeric_values()
     if cache is not None and cache[0] == len(vals):
         return cache[1]
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         arr = jnp.asarray(vals, dtype=jnp.float64)
     db.__dict__["_device_numf_cache"] = (len(vals), arr)
     return arr
@@ -2067,7 +2361,7 @@ def aggregate_table(
     from kolibrie_tpu.query.executor import _encode_numbers
 
     cap = 1024
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         numf_dev = device_numf(db)
         for _attempt in range(8):
             gcols, aggs, n_groups = _segment_aggregate(
@@ -2277,6 +2571,11 @@ def try_device_execute_ordered(db, q, cache_entry=None) -> Optional[List[List[st
                 tuple(optional_plans),
             )
         except Unsupported:
+            if cache_entry is not None:
+                # sticky negative: re-planning this template at this store
+                # state would fail identically on every call — memoize so
+                # repeat queries skip the plan+lower attempt entirely
+                cache_entry["ordered_failed"] = True
             return None
         if cache_entry is not None:
             cache_entry["plan"] = plan
@@ -2295,7 +2594,7 @@ def try_device_execute_ordered(db, q, cache_entry=None) -> Optional[List[List[st
         opos.append(out_vars.index(cond.expr.name))
         descs.append(bool(cond.descending))
     k = _round_cap((q.offset or 0) + q.limit, 8)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         numf_dev = lowered._device_numf()
         out_cols, valid = lowered.converge(lowered.run())
         # phase 1: numeric keys only — no host rank build

@@ -29,6 +29,7 @@ from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -338,7 +339,7 @@ class DistributedReasoner:
             bucket_cap=bucket_cap,
         )
         self._round = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 lambda *state: body(state),
                 mesh=mesh,
                 check_vma=_dist_check_vma(),

@@ -39,6 +39,7 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -346,7 +347,7 @@ class DistGeneralReasoner:
         rep = P()
         n_masks = len(self.bank.exprs)
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 lambda state, masks: body(state, masks),
                 mesh=self.mesh,
                 check_vma=_dist_check_vma(),

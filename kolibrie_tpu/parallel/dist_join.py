@@ -22,6 +22,7 @@ from functools import lru_cache, partial
 from typing import Sequence, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64, shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -222,7 +223,7 @@ def _equi_join_fn(mesh, nl, nr, lkey_i, rkey_i, bucket_cap, out_cap):
         out_cap=out_cap,
     )
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             check_vma=_dist_check_vma(),
@@ -295,7 +296,7 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
     same executable ~3000x); this variant lets callers defer the read."""
     store.ensure_subj_index()
     fn = _bgp_count_fn(store.mesh)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return fn(
             np.uint32(p1),
             np.uint32(p2),
@@ -331,7 +332,7 @@ def _bgp_count_fn(mesh):
 
     spec = P(axis, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P()) + (spec,) * 4,

@@ -59,6 +59,7 @@ from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64, shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -574,7 +575,7 @@ def _query_fn(
     )
     spec = P(axis, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             lambda state, masks, numf, vals, dranks, qranks: body(
                 state, masks, numf, vals, dranks, qranks
             ),
@@ -1103,7 +1104,7 @@ class DistQueryExecutor:
                 self.union_specs,
                 self.optional_specs,
             )
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 outs, valid, total, overflow, nan_flag = fn(
                     state, masks, numf, vals, dranks, qranks
                 )

@@ -16,6 +16,7 @@ import weakref
 from typing import Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -126,7 +127,7 @@ class ShardedTripleStore:
         explicit refresh after a ``by_subj`` write-back cannot produce
         wrong results — only a lazy rebuild.
         """
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             self.subj_packed_sorted = _pack_sort_device(
                 self.by_subj[0], self.by_subj[1], self.by_subj_valid
             )

@@ -398,10 +398,14 @@ def _try_device_aggregate(
         try_device_execute_aggregated,
     )
 
+    if cache_entry is not None and cache_entry["lowered"] is False:
+        # lowering known-failed for this template+state.  The sentinel is
+        # sticky across parameter rebinds (the slot's plan is dropped when
+        # the constants change, but lowerability is a property of the
+        # template, so the False survives and no retry happens here).
+        return None, cache_entry["plan"], False
     if cache_entry is not None and cache_entry["plan"] is not None:
         cplan, clow = cache_entry["plan"], cache_entry["lowered"]
-        if clow is False:
-            return None, cplan, False  # lowering known-failed this state
         if clow is not None:
             if not clause_replayable(clow, w):
                 # plain-BGP lowering for a clause-carrying WHERE: its
@@ -776,8 +780,16 @@ def _apply_limit_offset(rows: Rows, q: SelectQuery) -> Rows:
 def execute_select(
     db, q: SelectQuery, use_optimizer: bool = True, cache_entry=None
 ) -> Rows:
-    if use_optimizer and q.order_by and q.limit is not None:
-        # ORDER BY + LIMIT fused on device: top-k sort, O(limit) readback
+    if (
+        use_optimizer
+        and q.order_by
+        and q.limit is not None
+        and not (cache_entry is not None and cache_entry.get("ordered_failed"))
+    ):
+        # ORDER BY + LIMIT fused on device: top-k sort, O(limit) readback.
+        # ``ordered_failed`` is the sticky per-template negative: once the
+        # fused lowering raised Unsupported for this template+state, repeat
+        # calls (any constants) skip the doomed plan+lower attempt.
         from kolibrie_tpu.optimizer.device_engine import (
             try_device_execute_ordered,
         )
@@ -843,73 +855,304 @@ def process_delete_clause(db, delete: DeleteClause) -> int:
     return count
 
 
-_PLAN_CACHE_MAX = 128
+_PLAN_CACHE_MAX = 128  # parsed-AST entries (query text → template key)
 
 
-_PLAN_STATES_MAX = 4  # per-query (store version, udfs, mode) slots kept
+_TEMPLATE_CACHE_MAX = 64  # plan templates (fingerprint → per-state slots)
+
+
+_PLAN_STATES_MAX = 4  # per-template (store version, udfs, mode) slots kept
+
+
+def _plan_caches(db):
+    """The two cache levels + counters, lazily attached to the database."""
+    from collections import OrderedDict
+
+    parse = db.__dict__.get("_plan_cache")
+    if parse is None:
+        parse = OrderedDict()
+        db.__dict__["_plan_cache"] = parse
+    templates = db.__dict__.get("_template_cache")
+    if templates is None:
+        templates = OrderedDict()
+        db.__dict__["_template_cache"] = templates
+    stats = db.__dict__.get("_plan_cache_stats")
+    if stats is None:
+        stats = {
+            "hits": 0,
+            "misses": 0,
+            "param_rebinds": 0,
+            "evictions": 0,
+            "batched": 0,
+            "batch_groups": 0,
+        }
+        db.__dict__["_plan_cache_stats"] = stats
+    return parse, templates, stats
 
 
 def _plan_cache_entry(db, sparql: str):
-    """Automatic plan cache on the database.  Two granularities:
+    """Automatic plan cache on the database.  Three granularities:
 
     - the parsed AST is keyed by (query text, prefix map) — it survives
-      store mutations, so INSERT/SELECT workloads never re-parse;
-    - the physical plan + device-lowered program live in per-state slots
-      keyed by (store version, UDF registry, execution mode), so e.g.
-      host/device alternation keeps BOTH compiled programs warm instead
-      of evicting on every flip.
+      store mutations, so INSERT/SELECT workloads never re-parse; parsing
+      also canonicalizes the query into a constant-free *template*
+      fingerprint plus its parameter tuple
+      (:func:`kolibrie_tpu.query.template.fingerprint_query`);
+    - plan slots are keyed by the TEMPLATE fingerprint, not the query
+      text: the thousand constant-variants of one query shape share a
+      single cache entry (and, downstream, a single jit executable —
+      the lowered program carries its constants in a traced parameter
+      vector);
+    - within a template, the physical plan + device-lowered program live
+      in per-state slots keyed by (store version, UDF registry,
+      execution mode), so e.g. host/device alternation keeps BOTH
+      compiled programs warm instead of evicting on every flip.
 
-    Repeat queries through the plain public API get PreparedQuery
-    economics — parse, Streamertail plan, and device
-    lowering/compilation all happen once per state — without opting in
-    (the reference's nom parse + plan is sub-millisecond per call,
-    parser.rs:1036 / optimizer.rs:186; re-lowering a device program here
-    costs far more, so caching is the engine-appropriate answer rather
-    than a faster parser alone).  Returns ``(entry, slot)``; ``slot`` has
-    the ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
-    from collections import OrderedDict
+    A slot replays its plan/lowered program only when the stored
+    parameter binding matches the incoming one; on mismatch the plan is
+    rebuilt (host-side, cheap) while the device executable — keyed on
+    the constant-free ``PlanSpec`` — is reused without recompiling.
+    Known-failure sentinels (``lowered is False``, ``ordered_failed``)
+    are properties of the template and survive parameter rebinds.
 
-    cache = db.__dict__.get("_plan_cache")
-    if cache is None:
-        cache = OrderedDict()
-        db.__dict__["_plan_cache"] = cache
+    Both levels are LRU-bounded (``_PLAN_CACHE_MAX`` parse entries,
+    ``_TEMPLATE_CACHE_MAX`` templates); ``plan_cache_info`` reports
+    occupancy and hit/miss/eviction counters.  Returns ``(entry, slot)``;
+    ``entry`` carries the parsed ``cq``, ``slot`` has the
+    ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
+    from kolibrie_tpu.query.template import fingerprint_query
+
+    parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
-    ent = cache.get(sparql)
+    ent = parse.get(sparql)
     if ent is None or ent["prefix_sig"] != prefix_sig:
-        ent = {"prefix_sig": prefix_sig, "cq": None, "by_state": {}}
-        cache[sparql] = ent
-    cache.move_to_end(sparql)
-    while len(cache) > _PLAN_CACHE_MAX:
-        cache.popitem(last=False)
+        ent = {"prefix_sig": prefix_sig, "cq": None, "fp": None, "params": ()}
+        parse[sparql] = ent
+    parse.move_to_end(sparql)
+    while len(parse) > _PLAN_CACHE_MAX:
+        parse.popitem(last=False)
+    if ent["cq"] is None:
+        ent["cq"] = parse_combined_query(sparql, db.prefixes)
+        ent["fp"], ent["params"] = fingerprint_query(ent["cq"])
+    fp, params = ent["fp"], ent["params"]
+    tent = templates.get(fp)
+    if tent is None:
+        tent = {"by_state": {}, "hits": 0, "misses": 0}
+        templates[fp] = tent
+    templates.move_to_end(fp)
+    while len(templates) > _TEMPLATE_CACHE_MAX:
+        templates.popitem(last=False)
+        stats["evictions"] += 1
     version = db.store.version
     state = (
         version,
         db.__dict__.get("_udf_version", 0),
         db.execution_mode,
     )
-    slot = ent["by_state"].get(state)
+    slot = tent["by_state"].get(state)
     if slot is None:
         # stale-version slots pin device-resident copies of OLD store
         # orders (a LoweredPlan holds full sorted-store copies): drop
         # them, keeping only the live version's udf/mode variants (same
         # policy as dist_query's _dist_cap_cache)
-        for k in [k for k in ent["by_state"] if k[0] != version]:
-            ent["by_state"].pop(k)
-        slot = {"plan": None, "lowered": None}
-        ent["by_state"][state] = slot
-        while len(ent["by_state"]) > _PLAN_STATES_MAX:
+        for k in [k for k in tent["by_state"] if k[0] != version]:
+            tent["by_state"].pop(k)
+        slot = {
+            "plan": None,
+            "lowered": None,
+            "params": params,
+            "ordered_failed": False,
+        }
+        tent["by_state"][state] = slot
+        while len(tent["by_state"]) > _PLAN_STATES_MAX:
             # dicts iterate in insertion order: drop the oldest state
-            ent["by_state"].pop(next(iter(ent["by_state"])))
+            tent["by_state"].pop(next(iter(tent["by_state"])))
+        stats["misses"] += 1
+        tent["misses"] += 1
+    elif slot["params"] != params:
+        # same template, new constants: the cached plan/lowered program
+        # embed the OLD parameter binding, so they cannot replay — drop
+        # them and rebind.  The jit executable is keyed on the
+        # constant-free PlanSpec, so the re-lowering triggered downstream
+        # rebinds the parameter vector WITHOUT a device recompile.  The
+        # known-failure sentinels stay: lowerability is decided by the
+        # template's shape, never by the constant values.
+        failed = slot["lowered"] is False
+        slot["plan"] = None
+        slot["lowered"] = False if failed else None
+        slot["params"] = params
+        stats["param_rebinds"] += 1
+        tent["misses"] += 1
+    else:
+        stats["hits"] += 1
+        tent["hits"] += 1
     return ent, slot
+
+
+def plan_cache_info(db) -> dict:
+    """Inspection snapshot of the two-level plan cache: occupancy,
+    hit/miss/eviction/rebind counters, sticky-failure counts, and a
+    per-template breakdown (keyed by fingerprint)."""
+    parse, templates, stats = _plan_caches(db)
+    per = {}
+    sticky = 0
+    for fp, tent in templates.items():
+        failed = sum(
+            1 for s in tent["by_state"].values() if s["lowered"] is False
+        )
+        sticky += failed
+        per[fp] = {
+            "states": len(tent["by_state"]),
+            "hits": tent["hits"],
+            "misses": tent["misses"],
+            "failed_states": failed,
+        }
+    return {
+        "parse_entries": len(parse),
+        "templates": len(templates),
+        "hits": stats["hits"],
+        "misses": stats["misses"],
+        "param_rebinds": stats["param_rebinds"],
+        "evictions": stats["evictions"],
+        "batched": stats["batched"],
+        "batch_groups": stats["batch_groups"],
+        "sticky_failures": sticky,
+        "per_template": per,
+        "limits": {
+            "parse": _PLAN_CACHE_MAX,
+            "templates": _TEMPLATE_CACHE_MAX,
+            "states": _PLAN_STATES_MAX,
+        },
+    }
 
 
 def execute_query_volcano(sparql: str, db) -> Rows:
     """The main query path (execute_query.rs:356 parity)."""
     db.register_prefixes_from_query(sparql)
     ent, slot = _plan_cache_entry(db, sparql)
-    if ent["cq"] is None:
-        ent["cq"] = parse_combined_query(sparql, db.prefixes)
     return execute_combined(db, ent["cq"], cache_entry=slot)
+
+
+def _batchable_select(db, cq):
+    """Return ``(q, folded_where)`` when the query is a plain SELECT the
+    batched device dispatch can run — single BGP + filters, projection of
+    variables only, all post-processing (DISTINCT, LIMIT/OFFSET,
+    formatting) host-side per member.  ``None`` → run it solo."""
+    from kolibrie_tpu.query.subquery_inline import inline_subqueries
+
+    if (
+        cq.select is None
+        or cq.register is not None
+        or cq.rules
+        or cq.insert is not None
+        or cq.delete is not None
+        or cq.models
+        or cq.neural_relations
+        or cq.train_decls
+        or cq.ml_predict is not None
+        or cq.retrieve is not None
+    ):
+        return None
+    if db.neural_relations:
+        return None
+    q = cq.select
+    if q.group_by or q.order_by or any(i.kind != "var" for i in q.select):
+        return None
+    w = inline_subqueries(q.where)
+    if (
+        w.subqueries
+        or w.binds
+        or w.window_blocks
+        or w.unions
+        or w.optionals
+        or w.minus
+        or w.not_blocks
+        or w.values is not None
+        or not w.patterns
+    ):
+        return None
+    return q, w
+
+
+def _finish_select_table(db, q: SelectQuery, table: BindingTable) -> Rows:
+    """The host tail of a plain SELECT (projection → DISTINCT → format →
+    LIMIT/OFFSET), mirroring eval_select_to_table + execute_select."""
+    if not q.select_all():
+        keep = [i.var for i in q.select if i.kind == "var" and i.var in table]
+        table = {v: table[v] for v in keep}
+    elif any(k.startswith("__") for k in table):
+        table = {k: v for k, v in table.items() if not k.startswith("__")}
+    if q.distinct:
+        table = unique_table(table)
+    rows = format_results(db, table, q, sort_rows=True)
+    return _apply_limit_offset(rows, q)
+
+
+def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
+    """Execute a batch of queries, dispatching same-template plain SELECTs
+    as ONE stacked-parameter vmap program (``execute_plan_batch``): the
+    device runs every member of a template group in a single jit call
+    instead of one dispatch per query.  Everything else — singleton
+    templates, aggregates, ordered queries, updates — falls back to
+    ``execute_query_volcano`` per query.  Results come back in input
+    order; per-query host post-processing (DISTINCT, LIMIT/OFFSET,
+    formatting) is identical to the solo path."""
+    from kolibrie_tpu.optimizer.device_engine import (
+        Unsupported,
+        execute_plan_batch,
+        lower_plan,
+    )
+
+    results: List[Optional[Rows]] = [None] * len(queries)
+    for text in queries:
+        db.register_prefixes_from_query(text)
+    groups: Dict[str, List[int]] = {}
+    members: List[Optional[tuple]] = [None] * len(queries)
+    if _device_routed(db):
+        for i, text in enumerate(queries):
+            ent, slot = _plan_cache_entry(db, text)
+            if slot["lowered"] is False:
+                continue  # template known un-lowerable: solo (host) path
+            eligible = _batchable_select(db, ent["cq"])
+            if eligible is None:
+                continue
+            q, w = eligible
+            members[i] = (ent, slot, q, w)
+            groups.setdefault(ent["fp"], []).append(i)
+    _, _, stats = _plan_caches(db)
+    for fp, idxs in groups.items():
+        if len(idxs) < 2:
+            continue  # solo dispatch is already optimal for singletons
+        lowereds, ok = [], True
+        for i in idxs:
+            ent, slot, q, w = members[i]
+            try:
+                resolved = [resolve_pattern(db, p) for p in w.patterns]
+                logical = build_logical_plan(resolved, list(w.filters), [], None)
+                planner = Streamertail(db.get_or_build_stats())
+                plan = planner.find_best_plan(logical)
+                lowered = lower_plan(db, plan)
+            except Unsupported:
+                ok = False
+                break
+            lowereds.append((i, q, plan, lowered))
+        if not ok:
+            continue
+        try:
+            tables = execute_plan_batch([low for _, _, _, low in lowereds])
+        except Unsupported:
+            continue  # shape/plan divergence inside the group: solo path
+        stats["batched"] += len(idxs)
+        stats["batch_groups"] += 1
+        for (i, q, plan, lowered), table in zip(lowereds, tables):
+            ent, slot, _, _ = members[i]
+            if slot["params"] == ent["params"] and slot["lowered"] is None:
+                slot["plan"], slot["lowered"] = plan, lowered
+            results[i] = _finish_select_table(db, q, table)
+    for i, text in enumerate(queries):
+        if results[i] is None:
+            results[i] = execute_query_volcano(text, db)
+    return results
 
 
 def collect_all_patterns(where: WhereClause) -> List[PatternTriple]:

@@ -1,0 +1,142 @@
+"""Query → (template fingerprint, parameter tuple) canonicalization.
+
+A *template* is the parsed AST with every constant leaf (IRIs, string and
+numeric literals, pattern-position terms, VALUES cells) replaced by a typed
+placeholder.  Two queries that differ only in those constants share one
+fingerprint, and therefore one plan-cache entry and — because the lowered
+plan carries the constants in a traced parameter vector
+(:mod:`kolibrie_tpu.optimizer.device_engine`) — one device executable.
+
+The constants themselves come back as an ordered tuple of ``params``; the
+order is the deterministic AST traversal order, which is also the order the
+lowering pass consumes them in, so equal fingerprints imply positionally
+comparable parameter tuples.
+
+Structure-relevant scalars stay in the fingerprint:
+
+* variable / alias names, operators, DISTINCT, GROUP BY keys;
+* whether a string literal parses as a number (the lowering pass branches
+  on that when it sits on one side of a comparison);
+* for ordered+limited queries, the power-of-two bucket of
+  ``offset + limit`` (the top-k ``k`` is a static jit argument, quantized
+  exactly like :func:`try_device_execute_ordered` quantizes it);
+* the VALUES row/column shape and its UNDEF mask (the device VALUES table
+  is shape-static; only the cell contents are parameters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hashlib
+from typing import Any, List, Tuple
+
+from kolibrie_tpu.query.ast import (
+    CombinedQuery,
+    IriRef,
+    NumberLit,
+    PatternTerm,
+    SelectQuery,
+    StringLit,
+    ValuesClause,
+)
+
+__all__ = ["fingerprint_query", "template_key"]
+
+
+def _as_number(text: str) -> bool:
+    try:
+        float(text.strip('"'))
+        return True
+    except (ValueError, AttributeError):
+        return False
+
+
+def _k_bucket(n: int, lo: int = 8) -> int:
+    c = lo
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _ser(node: Any, params: List[Any]) -> Any:
+    """Serialize ``node`` into a hashable structure, appending constant
+    leaves to ``params`` and emitting typed placeholders in their place."""
+    if isinstance(node, NumberLit):
+        params.append(node.value)
+        return ("#num",)
+    if isinstance(node, StringLit):
+        params.append(node.value)
+        # lowering treats numeric-looking strings as numeric comparands
+        return ("#str", _as_number(node.value))
+    if isinstance(node, IriRef):
+        params.append(node.iri)
+        return ("#iri",)
+    if isinstance(node, PatternTerm):
+        if node.kind == "var":
+            return ("pv", node.value)
+        if node.kind == "quoted":
+            s, p, o = node.value  # type: ignore[misc]
+            return ("pq", _ser(s, params), _ser(p, params), _ser(o, params))
+        params.append(node.value)
+        return ("#pt",)
+    if isinstance(node, ValuesClause):
+        rows = tuple(
+            tuple("U" if c is None else "#vc" for c in row) for row in node.rows
+        )
+        for row in node.rows:
+            for c in row:
+                if c is not None:
+                    params.append(c)
+        return ("values", tuple(node.variables), rows)
+    if isinstance(node, SelectQuery):
+        body = tuple(
+            (f.name, _ser(getattr(node, f.name), params))
+            for f in dataclasses.fields(node)
+            if f.name not in ("prefixes", "limit", "offset")
+        )
+        if node.order_by and node.limit is not None:
+            # static top-k bucket: same quantization as the ordered device path
+            lim = ("kbucket", _k_bucket((node.offset or 0) + node.limit))
+        else:
+            lim = ("lim", node.limit is None, node.offset is None)
+        params.append(node.limit)
+        params.append(node.offset)
+        return ("SelectQuery", body, lim)
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return (
+            type(node).__name__,
+            tuple(
+                (f.name, _ser(getattr(node, f.name), params))
+                for f in dataclasses.fields(node)
+                if f.name != "prefixes"
+            ),
+        )
+    if isinstance(node, enum.Enum):
+        return ("enum", type(node).__name__, node.value)
+    if isinstance(node, dict):
+        return (
+            "dict",
+            tuple(sorted((str(k), _ser(v, params)) for k, v in node.items())),
+        )
+    if isinstance(node, (list, tuple)):
+        return tuple(_ser(x, params) for x in node)
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return node
+    return ("repr", repr(node))  # unknown node kinds stay fully structural
+
+
+def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
+    """Return ``(structure, params)`` for a parsed query: the hashable
+    template skeleton and the ordered tuple of extracted constants."""
+    params: List[Any] = []
+    structure = _ser(cq, params)
+    return structure, tuple(params)
+
+
+def fingerprint_query(cq: CombinedQuery) -> Tuple[str, Tuple[Any, ...]]:
+    """Return ``(fingerprint, params)``: a stable hex digest of the query's
+    template skeleton plus the constants stripped from it."""
+    structure, params = template_key(cq)
+    digest = hashlib.sha1(repr(structure).encode("utf-8")).hexdigest()
+    return digest, params

@@ -38,6 +38,7 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64
 import numpy as np
 
 from kolibrie_tpu.core.rule import FilterCondition, Rule
@@ -783,7 +784,7 @@ class DeviceFixpoint:
 
         from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
 
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             return _device_fixpoint(
                 self.rules, caps, pad(s), pad(p), pad(o), jnp.int32(n0), masks,
                 pallas_join_enabled(),
@@ -835,7 +836,7 @@ class DeviceFixpoint:
                 return x[: caps.fact].astype(jnp.uint32)
 
             fs, fp, fo = pad(fs), pad(fp), pad(fo)
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 ofs, ofp, ofo, on, rounds, code = _device_fixpoint(
                     self.rules, caps, fs, fp, fo, n_facts, masks, use_pallas
                 )
@@ -950,7 +951,7 @@ class DeviceFixpoint:
         F = _round_cap(n0 + D, 2048)
         attempts = 0
 
-        with jax.enable_x64(True):
+        with _enable_x64(True):
 
             def pad(x, cap):
                 x = jnp.asarray(x, dtype=jnp.uint32)

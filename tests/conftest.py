@@ -77,3 +77,11 @@ else:
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute mesh tests, excluded from the tier-1 "
+        "`-m 'not slow'` gate (run explicitly with `-m slow`)",
+    )

@@ -305,6 +305,7 @@ def test_naf_addmult_exactly_once_across_passes_dist(mesh):
     _close_tags(host[1], dist[1])
 
 
+@pytest.mark.slow
 def test_naf_round5_fuzz_agreement_dist(mesh):
     """Mesh twin of the single-chip round-5 NAF fuzz: addmult NAF and
     cross-blocking rule pairs over random tagged graphs — mesh facts and
@@ -414,6 +415,7 @@ def test_naf_addmult_improved_existing_stays_out_of_delta_dist(mesh):
     assert abs(host[1][s_key] - 0.5) < 1e-9
 
 
+@pytest.mark.slow
 def test_naf_cross_blocking_sequential_agreement(mesh):
     """A NAF conclusion unifying a LATER NAF rule's negated premise: since
     round 5 the mesh driver dispatches one rule per program in host order
@@ -450,6 +452,7 @@ def test_naf_cross_blocking_sequential_agreement(mesh):
     assert not [t for t in host_r.facts.triples_set() if t[1] == ok_p]
 
 
+@pytest.mark.slow
 def test_naf_sequential_later_rule_improves_fresh_fact_dist(mesh):
     """Sequential mesh pass: a later rule ⊕-improves a fact an earlier
     rule appended; the positive re-run must see the merged tag (the pass
@@ -521,6 +524,7 @@ def _close_tags(ht, dt, tol=1e-9):
         assert abs(v - dt[k]) <= tol, (k, v, dt[k])
 
 
+@pytest.mark.slow
 def test_addmult_chain_agreement(mesh):
     """Non-idempotent ⊕ over the mesh: transitive chain, exactly-once
     derivation accounting across shards."""

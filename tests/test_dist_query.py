@@ -662,6 +662,7 @@ def test_union_optional_minus_compose_dist(mesh):
     assert dist == host
 
 
+@pytest.mark.slow
 def test_dist_clause_fuzz(mesh):
     """Random BGP + subquery/union/optional/minus tails: distributed vs
     host, exercising clause composition over the mesh."""

@@ -389,20 +389,27 @@ class TestPlanCache:
         "?e <http://e.x/sal> ?s }"
     )
 
+    @staticmethod
+    def _slots(db, q):
+        """Round-6 layout: parse entries carry the template fingerprint;
+        the per-state plan/lowered slots live under the template cache."""
+        fp = db.__dict__["_plan_cache"][q]["fp"]
+        return db.__dict__["_template_cache"][fp]["by_state"]
+
     def test_repeat_query_reuses_plan_and_lowered(self):
         db = self._db()
         db.execution_mode = "device"
         r1 = execute_query_volcano(self.Q, db)
         ent = db.__dict__["_plan_cache"][self.Q]
         assert ent["cq"] is not None
-        (slot,) = ent["by_state"].values()
+        (slot,) = self._slots(db, self.Q).values()
         assert slot["plan"] is not None
         assert slot["lowered"] not in (None, False)
         lowered_obj = slot["lowered"]
         r2 = execute_query_volcano(self.Q, db)
         assert r2 == r1 and len(r1) == 200
         # same object still cached — the second run reused it
-        (slot2,) = db.__dict__["_plan_cache"][self.Q]["by_state"].values()
+        (slot2,) = self._slots(db, self.Q).values()
         assert slot2["lowered"] is lowered_obj
 
     def test_aggregate_query_reuses_lowered(self):
@@ -413,12 +420,12 @@ class TestPlanCache:
             "{ ?e <http://e.x/works> ?w } GROUP BY ?w ORDER BY ?w"
         )
         r1 = execute_query_volcano(q, db)
-        (slot,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        (slot,) = self._slots(db, q).values()
         assert slot["lowered"] not in (None, False)
         lowered_obj = slot["lowered"]
         r2 = execute_query_volcano(q, db)
         assert r2 == r1 and len(r1) == 7
-        (slot2,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        (slot2,) = self._slots(db, q).values()
         assert slot2["lowered"] is lowered_obj
         # mutation invalidates the slot but the answer stays correct
         db.parse_ntriples(
@@ -435,13 +442,13 @@ class TestPlanCache:
             "ORDER BY DESC(?s) LIMIT 5"
         )
         r1 = execute_query_volcano(q, db)
-        (slot,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        (slot,) = self._slots(db, q).values()
         assert slot["lowered"] not in (None, False)
         lowered_obj = slot["lowered"]
         r2 = execute_query_volcano(q, db)
         assert r2 == r1 and len(r1) == 5
         assert r1[0][1] == "1199"  # top salary of the 200-employee db
-        (slot2,) = db.__dict__["_plan_cache"][q]["by_state"].values()
+        (slot2,) = self._slots(db, q).values()
         assert slot2["lowered"] is lowered_obj
 
     def test_ordered_replay_keeps_host_clause_postpasses(self):
@@ -506,8 +513,7 @@ class TestPlanCache:
         db.execution_mode = "host"
         execute_query_volcano(self.Q, db)
         db.execution_mode = "device"
-        ent = db.__dict__["_plan_cache"][self.Q]
-        states = ent["by_state"]
+        states = self._slots(db, self.Q)
         assert len(states) == 2  # device + host slots coexist
         dev_slot = next(
             s for (v, u, m), s in states.items() if m == "device"
@@ -517,9 +523,7 @@ class TestPlanCache:
         assert execute_query_volcano(self.Q, db) == dev1
         dev_slot2 = next(
             s
-            for (v, u, m), s in db.__dict__["_plan_cache"][self.Q][
-                "by_state"
-            ].items()
+            for (v, u, m), s in self._slots(db, self.Q).items()
             if m == "device"
         )
         assert dev_slot2["lowered"] is lowered_obj  # flip did not evict

@@ -1,0 +1,331 @@
+"""Parameterized plan templates: fingerprint stability, the re-keyed plan
+cache (LRU bounds, store-version invalidation, sticky failure sentinels),
+the no-recompile guarantee across constant-variants, and the batched
+same-template dispatch.
+
+The load-bearing property under test: query constants live in a traced
+parameter vector, NOT in the static PlanSpec — so the jit cache for
+``_run_plan`` must not grow when only constants change.
+"""
+
+import numpy as np
+import pytest
+
+import kolibrie_tpu.optimizer.device_engine as de
+import kolibrie_tpu.query.executor as ex
+from kolibrie_tpu.query.executor import (
+    execute_queries_batched,
+    execute_query_volcano,
+    plan_cache_info,
+)
+from kolibrie_tpu.query.parser import parse_combined_query
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+from kolibrie_tpu.query.template import fingerprint_query
+
+PREFIXES = """PREFIX ex: <http://example.org/>
+PREFIX foaf: <http://xmlns.com/foaf/0.1/>
+"""
+
+
+def employee_db(n=300) -> SparqlDatabase:
+    db = SparqlDatabase()
+    lines = []
+    for i in range(n):
+        e = f"<http://example.org/e{i}>"
+        lines.append(f'{e} <http://example.org/dept> "dept{i % 5}" .')
+        lines.append(f'{e} <http://example.org/salary> "{20 + (i % 50)}" .')
+        lines.append(
+            f"{e} <http://xmlns.com/foaf/0.1/workplaceHomepage> "
+            f"<http://company{i % 7}.example/> ."
+        )
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+    return db
+
+
+def variant_query(dept: str, sal) -> str:
+    return (
+        PREFIXES
+        + f'SELECT ?e ?s WHERE {{ ?e ex:dept "{dept}" . ?e ex:salary ?s . '
+        + f"FILTER(?s > {sal}) }}"
+    )
+
+
+def host_rows(db, q):
+    mode = db.execution_mode
+    db.execution_mode = "host"
+    try:
+        return execute_query_volcano(q, db)
+    finally:
+        db.execution_mode = mode
+
+
+# -------------------------------------------------------------- fingerprint
+
+
+def test_fingerprint_stable_across_constants():
+    prefixes = {"ex": "http://example.org/", "foaf": "http://xmlns.com/foaf/0.1/"}
+    fp0, p0 = fingerprint_query(
+        parse_combined_query(variant_query("dept0", 25), prefixes)
+    )
+    fp1, p1 = fingerprint_query(
+        parse_combined_query(variant_query("dept3", 40), prefixes)
+    )
+    assert fp0 == fp1
+    assert p0 != p1
+    assert len(p0) == len(p1)
+
+
+def test_fingerprint_distinguishes_structure():
+    prefixes = {"ex": "http://example.org/"}
+    base = parse_combined_query(variant_query("dept0", 25), prefixes)
+    # different variable name → different template
+    other = parse_combined_query(
+        variant_query("dept0", 25).replace("?s", "?salary"), prefixes
+    )
+    assert fingerprint_query(base)[0] != fingerprint_query(other)[0]
+    # extra pattern → different template
+    wider = parse_combined_query(
+        PREFIXES
+        + 'SELECT ?e ?s WHERE { ?e ex:dept "dept0" . ?e ex:salary ?s . '
+        + "?e foaf:workplaceHomepage ?w . FILTER(?s > 25) }",
+        {"ex": "http://example.org/", "foaf": "http://xmlns.com/foaf/0.1/"},
+    )
+    assert fingerprint_query(base)[0] != fingerprint_query(wider)[0]
+
+
+def test_fingerprint_numeric_string_is_structural():
+    # a string literal that parses as a number lowers as a numeric
+    # comparand; one that doesn't takes the ID-equality path — the two
+    # must NOT share a template
+    prefixes = {"ex": "http://example.org/"}
+    q = PREFIXES + 'SELECT ?e WHERE { ?e ex:dept ?d . FILTER(?d = "%s") }'
+    fp_num, _ = fingerprint_query(parse_combined_query(q % "42", prefixes))
+    fp_str, _ = fingerprint_query(parse_combined_query(q % "dept1", prefixes))
+    assert fp_num != fp_str
+
+
+# ---------------------------------------------------------------- the cache
+
+
+def test_template_cache_one_entry_many_variants():
+    db = employee_db()
+    for d in range(5):
+        for s in (25, 30, 40):
+            execute_query_volcano(variant_query(f"dept{d}", s), db)
+    info = plan_cache_info(db)
+    assert info["templates"] == 1
+    assert info["parse_entries"] == 15
+    assert info["misses"] == 1
+    assert info["param_rebinds"] == 14
+
+
+def test_template_cache_lru_eviction(monkeypatch):
+    monkeypatch.setattr(ex, "_TEMPLATE_CACHE_MAX", 2)
+    db = employee_db()
+    queries = [
+        PREFIXES + 'SELECT ?e WHERE { ?e ex:dept "dept0" }',
+        PREFIXES + "SELECT ?e ?s WHERE { ?e ex:salary ?s }",
+        PREFIXES + "SELECT ?e ?w WHERE { ?e foaf:workplaceHomepage ?w }",
+    ]
+    for q in queries:
+        execute_query_volcano(q, db)
+    info = plan_cache_info(db)
+    assert info["templates"] == 2
+    assert info["evictions"] >= 1
+    # evicted template still answers correctly (re-planned transparently)
+    rows = execute_query_volcano(queries[0], db)
+    assert sorted(rows) == sorted(host_rows(db, queries[0]))
+
+
+def test_store_version_invalidates_slot():
+    db = employee_db(50)
+    q = PREFIXES + 'SELECT ?e WHERE { ?e ex:dept "deptX" }'
+    assert execute_query_volcano(q, db) == []
+    db.parse_ntriples(
+        '<http://example.org/new> <http://example.org/dept> "deptX" .'
+    )
+    rows = execute_query_volcano(q, db)
+    assert rows == [["http://example.org/new"]]
+    # only the live store version's state slots are retained
+    tent = next(iter(db._template_cache.values()))
+    assert all(k[0] == db.store.version for k in tent["by_state"])
+
+
+# ------------------------------------------------------- sticky fail sentinel
+
+
+def test_failed_lowering_sticky_across_constants(monkeypatch):
+    db = employee_db(100)
+    calls = {"n": 0}
+
+    def failing_lower_plan(*args, **kwargs):
+        calls["n"] += 1
+        raise de.Unsupported("forced for test")
+
+    monkeypatch.setattr(de, "lower_plan", failing_lower_plan)
+
+    def agg(d):
+        return (
+            PREFIXES
+            + f'SELECT (COUNT(?e) AS ?c) WHERE {{ ?e ex:dept "dept{d}" }}'
+        )
+
+    r0 = execute_query_volcano(agg(0), db)
+    first = calls["n"]
+    assert first >= 1  # the device aggregate path attempted the lowering
+    # same text again: the False sentinel short-circuits the retry
+    assert execute_query_volcano(agg(0), db) == r0
+    assert calls["n"] == first
+    # same TEMPLATE, different constant: sentinel must survive the
+    # parameter rebind (lowerability is structural)
+    execute_query_volcano(agg(1), db)
+    execute_query_volcano(agg(2), db)
+    assert calls["n"] == first
+    # host fallback still answers correctly throughout
+    assert r0 == host_rows(db, agg(0))
+
+
+def test_failed_ordered_lowering_sticky(monkeypatch):
+    db = employee_db(100)
+    calls = {"n": 0}
+
+    def failing_lower_plan(*args, **kwargs):
+        calls["n"] += 1
+        raise de.Unsupported("forced for test")
+
+    monkeypatch.setattr(de, "lower_plan", failing_lower_plan)
+
+    def ordered(d):
+        return (
+            PREFIXES
+            + f'SELECT ?e ?s WHERE {{ ?e ex:dept "dept{d}" . '
+            + "?e ex:salary ?s } ORDER BY DESC(?s) LIMIT 3"
+        )
+
+    r0 = execute_query_volcano(ordered(0), db)
+    first = calls["n"]
+    assert first >= 1
+    assert execute_query_volcano(ordered(0), db) == r0
+    assert calls["n"] == first  # ordered_failed skipped the retry
+    execute_query_volcano(ordered(1), db)  # param rebind keeps the sentinel
+    assert calls["n"] == first
+    assert r0 == host_rows(db, ordered(0))
+
+
+# ------------------------------------------------ tier-1: no recompile rule
+
+
+def test_no_recompile_across_32_constant_variants():
+    db = employee_db()
+    variants = [
+        (f"dept{i % 5}", 20 + (i * 7) % 45) for i in range(32)
+    ]
+    # warm the template: first variant pays the single compile
+    first = execute_query_volcano(variant_query(*variants[0]), db)
+    assert len(first) > 0
+    base = de.device_compile_stats()
+    rows = [execute_query_volcano(variant_query(d, s), db) for d, s in variants]
+    after = de.device_compile_stats()
+    assert after["run_plan"] == base["run_plan"], "constant change recompiled!"
+    # results agree with the host numpy engine for every variant
+    for (d, s), dev in zip(variants, rows):
+        assert sorted(dev) == sorted(host_rows(db, variant_query(d, s))), (d, s)
+
+
+# ------------------------------------------------------------ batched serve
+
+
+def test_batched_execution_agreement():
+    db = employee_db()
+    batch = [variant_query(f"dept{d}", s) for d in range(5) for s in (25, 40)]
+    # mix in a non-batchable member (aggregate) and a duplicate
+    batch.append(
+        PREFIXES + 'SELECT (COUNT(?e) AS ?c) WHERE { ?e ex:dept "dept0" }'
+    )
+    batch.append(batch[0])
+    base = de.device_compile_stats()
+    results = execute_queries_batched(db, batch)
+    after = de.device_compile_stats()
+    assert len(results) == len(batch)
+    for q, rows in zip(batch, results):
+        assert sorted(map(tuple, rows)) == sorted(
+            map(tuple, host_rows(db, q))
+        ), q
+    info = plan_cache_info(db)
+    assert info["batch_groups"] >= 1
+    assert info["batched"] >= 10
+    # the whole stacked group compiled at most one batch program
+    assert after["run_plan_batch"] - base["run_plan_batch"] <= 1
+
+
+def test_batched_single_and_empty():
+    db = employee_db(50)
+    assert execute_queries_batched(db, []) == []
+    q = variant_query("dept1", 30)
+    (rows,) = execute_queries_batched(db, [q])
+    assert sorted(rows) == sorted(host_rows(db, q))
+
+
+# ----------------------------------------------------------- http /stats
+
+
+def test_http_store_roundtrip_and_stats():
+    import json
+    import threading
+    from http.client import HTTPConnection
+
+    from kolibrie_tpu.frontends.http_server import make_server
+
+    srv = make_server(port=0, quiet=True)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(path, payload):
+        c = HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request(
+            "POST", path, json.dumps(payload), {"Content-Type": "application/json"}
+        )
+        r = c.getresponse()
+        out = json.loads(r.read())
+        c.close()
+        return r.status, out
+
+    try:
+        lines = [
+            f'<http://example.org/e{i}> <http://example.org/dept> "dept{i % 3}" .'
+            for i in range(60)
+        ]
+        st, out = post(
+            "/store/load",
+            {"rdf": "\n".join(lines), "format": "ntriples", "mode": "device"},
+        )
+        assert st == 200 and out["triples"] == 60
+        sid = out["store_id"]
+        q = (
+            "PREFIX ex: <http://example.org/> "
+            'SELECT ?e WHERE { ?e ex:dept "dept1" }'
+        )
+        st, out = post("/store/query", {"store_id": sid, "sparql": q})
+        assert st == 200 and len(out["data"]) == 20
+        st, out = post("/store/query", {"store_id": sid, "sparql": q})
+        assert st == 200
+
+        c = HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request("GET", "/stats")
+        r = c.getresponse()
+        stats = json.loads(r.read())
+        c.close()
+        assert r.status == 200
+        b = stats["stores"][sid]
+        assert b["requests"] == 2
+        assert b["plan_cache"]["templates"] == 1
+        assert b["plan_cache"]["hits"] >= 1  # identical repeat was a cache hit
+        assert b["per_template"]
+        rec = next(iter(b["per_template"].values()))
+        assert rec["dispatch_ms_p50"] >= 0.0
+
+        st, out = post("/store/query", {"store_id": "missing", "sparql": q})
+        assert st == 404
+    finally:
+        srv.shutdown()

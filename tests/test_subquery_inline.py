@@ -434,13 +434,21 @@ def test_subquery_fuzz_differential():
             f"{{ {' '.join(opats)} {ofilt} {sub} }}"
         )
 
+        # the mocked inliner changes parse→plan semantics OUTSIDE the
+        # database's visibility, so the oracle run must execute on a blank
+        # plan/template cache and its plans must never serve the real runs
+        # (production never swaps the inliner); the real runs keep THEIR
+        # caches across trials, so same-template trials exercise parameter
+        # rebinding on shared plans
+        _CACHES = ("_plan_cache", "_template_cache", "_plan_cache_stats")
+        _saved = {k: db.__dict__.pop(k, None) for k in _CACHES}
         with mock.patch.object(sqmod, "inline_subqueries", lambda w: w):
             db.execution_mode = "host"
             legacy = execute_query_volcano(q, db)
-        # the mocked inliner changed parse→plan semantics OUTSIDE the
-        # database's visibility, so the oracle run's cached plan must not
-        # serve the real runs (production never swaps the inliner)
-        db.__dict__.pop("_plan_cache", None)
+        for _k in _CACHES:
+            db.__dict__.pop(_k, None)
+            if _saved[_k] is not None:
+                db.__dict__[_k] = _saved[_k]
         db.execution_mode = "host"
         host = execute_query_volcano(q, db)
         db.execution_mode = "device"
