@@ -262,6 +262,65 @@ def main():
     }
     note(f"plan-template sweep done ({plan_template})")
 
+    # ---- resilience under 10% injected fault load ------------------------
+    # Serving-path TemplateBatcher over the same store with a seeded fault
+    # plan firing on 10% of device dispatches: failed dispatches degrade to
+    # the host interpreter behind the per-template circuit breaker, so the
+    # client sees rows either way.  Reports p99 request latency and the
+    # shed rate (deadline/admission rejections).  Never kills the capture:
+    # any failure lands as {"error": ...} in the secondary block.
+    note("resilience fault-load sweep")
+    resilience = None
+    try:
+        from kolibrie_tpu.frontends.http_server import TemplateBatcher
+        from kolibrie_tpu.resilience.breaker import breaker_board
+        from kolibrie_tpu.resilience.deadline import (
+            Deadline,
+            deadline_scope,
+        )
+        from kolibrie_tpu.resilience.errors import KolibrieError
+        from kolibrie_tpu.resilience.faultinject import (
+            FaultPlan,
+            InjectedCompileError,
+        )
+
+        batcher = TemplateBatcher(db)
+        fplan = FaultPlan(seed=11)
+        fplan.add("device.execute", error=InjectedCompileError, rate=0.10)
+        n_req, lat, served, shed = 120, [], 0, 0
+        with fplan.installed():
+            for k in range(n_req):
+                q = TPL_QUERY % (30000 + (k % 16) * 2500)
+                t0 = time.perf_counter()
+                try:
+                    with deadline_scope(Deadline.from_ms(5000)):
+                        batcher.submit(q)
+                    served += 1
+                except KolibrieError:
+                    shed += 1
+                lat.append((time.perf_counter() - t0) * 1000.0)
+        lat.sort()
+        breakers = breaker_board(db).snapshot().values()
+        resilience = {
+            "requests": n_req,
+            "injected_fault_rate": 0.10,
+            "injected_fires": sum(
+                r["fires"] for r in fplan.snapshot().values()
+            ),
+            "served": served,
+            "shed": shed,
+            "shed_rate": round(shed / n_req, 4),
+            "latency_ms_p50": round(lat[len(lat) // 2], 3),
+            "latency_ms_p99": round(
+                lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))], 3
+            ),
+            "degraded_served": sum(b["degraded_served"] for b in breakers),
+            "breaker_trips": sum(b["trips"] for b in breakers),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        resilience = {"error": repr(e)}
+    note(f"resilience sweep done ({resilience})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -322,6 +381,7 @@ def main():
                     "rows": len(rows),
                     "bulk_load_s": round(t_load, 3),
                     "plan_template": plan_template,
+                    "resilience": resilience,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

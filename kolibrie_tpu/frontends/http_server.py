@@ -27,9 +27,35 @@ from kolibrie_tpu.frontends.rules import (
     apply_sparql_rules,
     strip_hash_comments,
 )
+from kolibrie_tpu.resilience.admission import AdmissionController
+from kolibrie_tpu.resilience.deadline import (
+    Deadline,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+)
+from kolibrie_tpu.resilience.errors import (
+    BadRequest,
+    DeadlineExceeded,
+    KolibrieError,
+    NotFound,
+    Overloaded,
+    QueryError,
+    RequestTooLarge,
+    WindowCrash,
+    error_response,
+)
 
 MAX_REQUEST_BYTES = 64 * 1024 * 1024  # main.rs:42-44
 SSE_KEEPALIVE_SECONDS = 15.0
+
+# Resilience knobs (docs/RESILIENCE.md).  deadline <= 0 disables deadlines.
+DEFAULT_DEADLINE_MS = float(os.environ.get("KOLIBRIE_DEADLINE_MS", "30000"))
+MAX_INFLIGHT = int(os.environ.get("KOLIBRIE_MAX_INFLIGHT", "64"))
+MAX_QUEUE_DEPTH = int(os.environ.get("KOLIBRIE_MAX_QUEUE_DEPTH", "256"))
+SSE_SUBSCRIBER_QUEUE_MAX = int(
+    os.environ.get("KOLIBRIE_SSE_QUEUE_MAX", "1024")
+)
 
 _PLAYGROUND_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -93,19 +119,33 @@ class EngineSession:
         # serializes engine mutation: the RSP engine's single-thread drain
         # path is not safe under concurrent /rsp/push handler threads
         self.push_lock = threading.Lock()
+        self.dropped_subscribers = 0  # pruned dead/stalled SSE queues
+        self.crash_recoveries = 0  # WindowCrash → checkpoint restores
+        self.last_checkpoint: Optional[bytes] = None
 
     def emit(self, row: Tuple[Tuple[str, str], ...]) -> None:
         table = results_to_table([row])
         payload = json.dumps({"results": table})
         with self.lock:
             self.results.append(table)
+            dead = []
             for q in self.subscribers:
-                q.put(payload)
+                try:
+                    q.put_nowait(payload)
+                except queue.Full:
+                    # subscriber stopped draining — a broken pipe whose
+                    # handler thread already died, or a stalled client.
+                    # Prune it here; un-pruned it would pin its queue (and
+                    # every future payload) forever.
+                    dead.append(q)
+            for q in dead:
+                self.subscribers.remove(q)
+                self.dropped_subscribers += 1
 
     def subscribe_with_backlog(self) -> Tuple["queue.Queue[str]", List[str]]:
         """Atomically add a subscriber and snapshot prior results — a row
         emitted between the two would otherwise be delivered twice."""
-        q: "queue.Queue[str]" = queue.Queue()
+        q: "queue.Queue[str]" = queue.Queue(maxsize=SSE_SUBSCRIBER_QUEUE_MAX)
         with self.lock:
             self.subscribers.append(q)
             backlog = [json.dumps({"results": t}) for t in self.results]
@@ -116,6 +156,30 @@ class EngineSession:
             if q in self.subscribers:
                 self.subscribers.remove(q)
 
+    # --------------------------------------------------- crash recovery
+
+    def maybe_checkpoint(self) -> None:
+        """Snapshot engine state after a successful push (caller holds
+        ``push_lock``).  Failures are non-fatal: a stale checkpoint only
+        widens the at-least-once replay window on the next recovery."""
+        try:
+            self.last_checkpoint = self.engine.checkpoint_state()
+        except Exception:
+            pass
+
+    def recover(self) -> bool:
+        """Restore the engine from the last good checkpoint after a
+        WindowCrash (caller holds ``push_lock``).  Returns whether the
+        session is serving again."""
+        if self.last_checkpoint is None:
+            return False
+        try:
+            self.engine.restore_state(self.last_checkpoint)
+        except Exception:
+            return False
+        self.crash_recoveries += 1
+        return True
+
 
 def _pct(samples: List[float], q: float) -> float:
     if not samples:
@@ -125,13 +189,16 @@ def _pct(samples: List[float], q: float) -> float:
 
 
 class _BatchRequest:
-    __slots__ = ("text", "done", "result", "error")
+    __slots__ = ("text", "done", "result", "error", "deadline")
 
-    def __init__(self, text: str):
+    def __init__(self, text: str, deadline: Optional[Deadline] = None):
         self.text = text
         self.done = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        # captured at submit time: the leader dispatches on ANOTHER
+        # thread, where the submitter's thread-local scope is invisible
+        self.deadline = deadline
 
 
 class TemplateBatcher:
@@ -150,9 +217,12 @@ class TemplateBatcher:
     stats — serializes on ``dispatch_lock``, so the engine itself never
     sees concurrency."""
 
-    def __init__(self, db, window_ms: float = 5.0):
+    def __init__(
+        self, db, window_ms: float = 5.0, max_queue_depth: int = MAX_QUEUE_DEPTH
+    ):
         self.db = db
         self.window = window_ms / 1000.0
+        self.max_queue_depth = max_queue_depth
         self.lock = threading.Lock()  # guards pending + counters
         self.dispatch_lock = threading.Lock()  # serializes db access
         self.pending: List[_BatchRequest] = []
@@ -160,19 +230,40 @@ class TemplateBatcher:
         self.dispatches = 0
         self.dedup_hits = 0
         self.max_batch = 0
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
         # fp -> {"requests", "dedup_hits", "lat": [dispatch ms, ...]}
         self.templates: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- dispatch
 
     def submit(self, text: str):
-        req = _BatchRequest(text)
+        check_deadline("batcher.submit")
+        req = _BatchRequest(text, deadline=current_deadline())
         with self.lock:
+            if len(self.pending) >= self.max_queue_depth:
+                # queue depth is the best single predictor of blowing the
+                # deadline anyway: shed at the door, structured 429
+                self.shed_queue_full += 1
+                raise Overloaded(
+                    f"store queue full ({len(self.pending)} pending)",
+                    retry_after_s=max(self.window * 4, 0.05),
+                )
             self.pending.append(req)
             self.requests += 1
         # collect followers for one window, then elect a dispatcher; loop
         # covers the race where a drain happened between append and wait
         while not req.done.wait(timeout=self.window):
+            if req.deadline is not None and req.deadline.expired():
+                # a waiter never blocks past its deadline: drop out even
+                # if a leader is mid-dispatch (its result goes unread)
+                with self.lock:
+                    if req in self.pending:
+                        self.pending.remove(req)
+                    self.shed_deadline += 1
+                raise DeadlineExceeded(
+                    "deadline exceeded at batcher.queue", site="batcher.queue"
+                )
             if self.dispatch_lock.acquire(blocking=False):
                 try:
                     with self.lock:
@@ -187,6 +278,19 @@ class TemplateBatcher:
             raise req.error
         return req.result
 
+    @staticmethod
+    def _batch_deadline(batch: List[_BatchRequest]) -> Optional[Deadline]:
+        """The LOOSEST member deadline (None if any member has none): one
+        tight straggler must not kill the shared dispatch its batch-mates
+        are riding.  The straggler itself sheds in its own wait loop."""
+        loosest: Optional[Deadline] = None
+        for r in batch:
+            if r.deadline is None:
+                return None
+            if loosest is None or r.deadline.expires_at > loosest.expires_at:
+                loosest = r.deadline
+        return loosest
+
     def _run_batch(self, batch: List[_BatchRequest]) -> None:
         from kolibrie_tpu.query.executor import (
             execute_queries_batched,
@@ -197,12 +301,17 @@ class TemplateBatcher:
         uniq = list(dict.fromkeys(texts))
         start = time.perf_counter()
         try:
-            by_text = dict(zip(uniq, execute_queries_batched(self.db, uniq)))
+            with deadline_scope(self._batch_deadline(batch)):
+                by_text = dict(
+                    zip(uniq, execute_queries_batched(self.db, uniq))
+                )
         except Exception:
-            # one bad member must not fail its batch-mates: solo retries
+            # one bad member must not fail its batch-mates: solo retries,
+            # each under its OWN deadline (None masks the leader's scope)
             for r in batch:
                 try:
-                    r.result = execute_query_volcano(r.text, self.db)
+                    with deadline_scope(r.deadline):
+                        r.result = execute_query_volcano(r.text, self.db)
                 except Exception as e:
                     r.error = e
                 r.done.set()
@@ -239,6 +348,7 @@ class TemplateBatcher:
     def stats(self) -> dict:
         from kolibrie_tpu.optimizer.device_engine import device_compile_stats
         from kolibrie_tpu.query.executor import plan_cache_info
+        from kolibrie_tpu.resilience.breaker import breaker_board
 
         with self.lock:
             per = {
@@ -256,11 +366,14 @@ class TemplateBatcher:
                 "dispatches": self.dispatches,
                 "dedup_hits": self.dedup_hits,
                 "max_batch": self.max_batch,
+                "shed_queue_full": self.shed_queue_full,
+                "shed_deadline": self.shed_deadline,
                 "per_template": per,
             }
         with self.dispatch_lock:
             out["triples"] = len(self.db.store)
             out["plan_cache"] = plan_cache_info(self.db)
+            out["breakers"] = breaker_board(self.db).snapshot()
         out["device_compiles"] = device_compile_stats()
         return out
 
@@ -271,6 +384,7 @@ class _ServerState:
         self.stores: Dict[str, TemplateBatcher] = {}
         self.lock = threading.Lock()
         self.counter = itertools.count(1)
+        self.admission = AdmissionController(max_inflight=MAX_INFLIGHT)
 
 
 def _build_rsp_engine(
@@ -356,26 +470,44 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def _send_error_json(self, message: str, code: int = 400) -> None:
         self._send_json({"error": message}, code)
 
-    def _read_body(self) -> Optional[bytes]:
+    def _send_failure(self, exc: Exception) -> None:
+        """Map an exception through the shared taxonomy to a structured
+        JSON response.  BaseExceptions outside Exception (KeyboardInterrupt,
+        SystemExit) never reach here — the dispatch wrappers catch only
+        ``Exception`` and :func:`error_response` re-raises them anyway."""
+        status, payload = error_response(exc, context=self.path)
+        self._send_json(payload, status)
+
+    def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
         if length > MAX_REQUEST_BYTES:
-            self._send_error_json("request too large", 413)
-            return None
+            raise RequestTooLarge("request too large")
         return self.rfile.read(length)
 
-    def _read_json(self) -> Optional[dict]:
+    def _read_json(self) -> dict:
         body = self._read_body()
-        if body is None:
-            return None
         try:
             payload = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
-            self._send_error_json(f"Invalid JSON: {e}")
-            return None
+            raise BadRequest(f"Invalid JSON: {e}") from e
         if not isinstance(payload, dict):
-            self._send_error_json("Invalid JSON: expected an object")
-            return None
+            raise BadRequest("Invalid JSON: expected an object")
         return payload
+
+    def _request_deadline(self, req: Optional[dict] = None) -> Optional[Deadline]:
+        """The request's deadline budget: ``deadline_ms`` body field, then
+        ``X-Kolibrie-Deadline-Ms`` header, then the server default.
+        ``<= 0`` disables the deadline for this request."""
+        raw = req.get("deadline_ms") if req else None
+        if raw is None:
+            raw = self.headers.get("X-Kolibrie-Deadline-Ms")
+        if raw is None:
+            raw = DEFAULT_DEADLINE_MS
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            raise BadRequest(f"invalid deadline_ms: {raw!r}")
+        return Deadline.from_ms(ms) if ms > 0 else None
 
     # --------------------------------------------------------------- routes
 
@@ -394,31 +526,37 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self._handle_sse(self.path[len("/rsp/events/"):])
             return
         if self.path == "/stats":
-            self._handle_stats()
+            try:
+                self._handle_stats()
+            except Exception as e:
+                self._send_failure(e)
             return
         self._send_error_json("not found", 404)
 
+    _POST_ROUTES = {
+        "/query": "_handle_query",
+        "/store/load": "_handle_store_load",
+        "/store/query": "_handle_store_query",
+        "/explain": "_handle_explain",
+        "/rsp-query": "_handle_rsp_query",
+        "/rsp/register": "_handle_rsp_register",
+        "/rsp/push": "_handle_rsp_push",
+        "/rsp/checkpoint": "_handle_rsp_checkpoint",
+        "/rsp/restore": "_handle_rsp_restore",
+    }
+
     def do_POST(self):
-        if self.path == "/query":
-            self._handle_query()
-        elif self.path == "/store/load":
-            self._handle_store_load()
-        elif self.path == "/store/query":
-            self._handle_store_query()
-        elif self.path == "/explain":
-            self._handle_explain()
-        elif self.path == "/rsp-query":
-            self._handle_rsp_query()
-        elif self.path == "/rsp/register":
-            self._handle_rsp_register()
-        elif self.path == "/rsp/push":
-            self._handle_rsp_push()
-        elif self.path == "/rsp/checkpoint":
-            self._handle_rsp_checkpoint()
-        elif self.path == "/rsp/restore":
-            self._handle_rsp_restore()
-        else:
+        name = self._POST_ROUTES.get(self.path)
+        if name is None:
             self._send_error_json("not found", 404)
+            return
+        try:
+            getattr(self, name)()
+        except Exception as e:
+            # single choke point: handlers raise taxonomy errors (or plain
+            # exceptions, conservatively mapped); KeyboardInterrupt and
+            # SystemExit are BaseException and sail straight through
+            self._send_failure(e)
 
     # -------------------------------------------------------------- /explain
 
@@ -430,24 +568,22 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
         req = self._read_json()
-        if req is None:
-            return
         if not req.get("sparql"):
-            self._send_error_json("No query provided")
-            return
+            raise BadRequest("No query provided")
         db = SparqlDatabase()
         try:
             _load_rdf_into(db, req.get("rdf") or "", req.get("format", "rdfxml"))
         except Exception as e:
-            self._send_error_json(f"RDF parse error: {e}")
-            return
-        try:
-            plan = QueryEngine(db).explain_device(
-                strip_hash_comments(req["sparql"])
-            )
-        except Exception as e:
-            self._send_error_json(f"Explain failed: {e}")
-            return
+            raise BadRequest(f"RDF parse error: {e}") from e
+        with deadline_scope(self._request_deadline(req)):
+            try:
+                plan = QueryEngine(db).explain_device(
+                    strip_hash_comments(req["sparql"])
+                )
+            except KolibrieError:
+                raise
+            except Exception as e:
+                raise QueryError(f"Explain failed: {e}") from e
         self._send_json({"plan": plan})
 
     # ---------------------------------------------------------------- /query
@@ -460,62 +596,61 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
         req = self._read_json()
-        if req is None:
-            return
         queries: List[str] = []
         if req.get("sparql"):
             queries.append(req["sparql"])
         queries.extend(req.get("queries") or [])
         if not queries:
-            self._send_error_json("No queries provided")
-            return
+            raise BadRequest("No queries provided")
         rules: List[str] = []
         if req.get("rule"):
             rules.append(req["rule"])
         rules.extend(req.get("rules") or [])
         fmt = req.get("format", "rdfxml")
 
-        db = SparqlDatabase()
-        try:
-            _load_rdf_into(db, req.get("rdf") or "", fmt)
-        except Exception as e:
-            self._send_error_json(f"RDF parse error: {e}")
-            return
+        deadline = self._request_deadline(req)
+        with self.state.admission.admitted_scope(), deadline_scope(deadline):
+            db = SparqlDatabase()
+            try:
+                _load_rdf_into(db, req.get("rdf") or "", fmt)
+            except Exception as e:
+                raise BadRequest(f"RDF parse error: {e}") from e
 
-        n3logic = req.get("n3logic")
-        if n3logic:
-            try:
-                apply_n3_logic(db, n3logic)
-            except Exception as e:
-                self._send_error_json(f"N3 rule error: {e}")
-                return
-        if rules:
-            try:
-                apply_sparql_rules(db, rules)
-            except Exception as e:
-                self._send_error_json(f"Rule error: {e}")
-                return
+            n3logic = req.get("n3logic")
+            if n3logic:
+                try:
+                    apply_n3_logic(db, n3logic)
+                except Exception as e:
+                    raise BadRequest(f"N3 rule error: {e}") from e
+            if rules:
+                try:
+                    apply_sparql_rules(db, rules)
+                except Exception as e:
+                    raise BadRequest(f"Rule error: {e}") from e
 
-        results = []
-        # The reference routes only pre-indexed ntriples loads through the
-        # Volcano optimizer (main.rs:941); here Volcano IS the default path
-        # and {"legacy": true} opts into the sequential agreement path.
-        run = execute_query if req.get("legacy") else execute_query_volcano
-        for idx, q in enumerate(queries):
-            start = time.perf_counter()
-            try:
-                rows = run(strip_hash_comments(q), db)
-            except Exception as e:
-                self._send_error_json(f"Query {idx} failed: {e}")
-                return
-            results.append(
-                {
-                    "query_index": idx,
-                    "query": q,
-                    "data": rows,
-                    "execution_time_ms": (time.perf_counter() - start) * 1000.0,
-                }
-            )
+            results = []
+            # The reference routes only pre-indexed ntriples loads through
+            # the Volcano optimizer (main.rs:941); here Volcano IS the
+            # default path and {"legacy": true} opts into the sequential
+            # agreement path.
+            run = execute_query if req.get("legacy") else execute_query_volcano
+            for idx, q in enumerate(queries):
+                start = time.perf_counter()
+                try:
+                    rows = run(strip_hash_comments(q), db)
+                except KolibrieError:
+                    raise
+                except Exception as e:
+                    raise QueryError(f"Query {idx} failed: {e}") from e
+                results.append(
+                    {
+                        "query_index": idx,
+                        "query": q,
+                        "data": rows,
+                        "execution_time_ms": (time.perf_counter() - start)
+                        * 1000.0,
+                    }
+                )
         self._send_json({"results": results})
 
     # ----------------------------------------------------- persistent stores
@@ -529,8 +664,6 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
         req = self._read_json()
-        if req is None:
-            return
         state = self.state
         sid = str(req.get("store_id") or "")
         with state.lock:
@@ -550,8 +683,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                     batcher.db, req.get("rdf") or "", req.get("format", "ntriples")
                 )
         except Exception as e:
-            self._send_error_json(f"RDF parse error: {e}")
-            return
+            raise BadRequest(f"RDF parse error: {e}") from e
         self._send_json(
             {"store_id": sid, "loaded": n, "triples": len(batcher.db.store)}
         )
@@ -562,23 +694,23 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         identical queries are answered by one execution; same-template
         variants within the batching window share one device dispatch."""
         req = self._read_json()
-        if req is None:
-            return
         if not req.get("sparql"):
-            self._send_error_json("No query provided")
-            return
+            raise BadRequest("No query provided")
         state = self.state
         with state.lock:
             batcher = state.stores.get(str(req.get("store_id") or ""))
         if batcher is None:
-            self._send_error_json("store not found", 404)
-            return
+            raise NotFound("store not found")
         start = time.perf_counter()
-        try:
-            rows = batcher.submit(strip_hash_comments(req["sparql"]))
-        except Exception as e:
-            self._send_error_json(f"Query failed: {e}")
-            return
+        with state.admission.admitted_scope(), deadline_scope(
+            self._request_deadline(req)
+        ):
+            try:
+                rows = batcher.submit(strip_hash_comments(req["sparql"]))
+            except KolibrieError:
+                raise
+            except Exception as e:
+                raise QueryError(f"Query failed: {e}") from e
         self._send_json(
             {
                 "data": rows,
@@ -593,11 +725,27 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         state = self.state
         with state.lock:
             stores = dict(state.stores)
-            n_sessions = len(state.sessions)
+            sessions = dict(state.sessions)
+        per_session = {}
+        for sid, s in sessions.items():
+            with s.lock:
+                info = {
+                    "subscribers": len(s.subscribers),
+                    "dropped_subscribers": s.dropped_subscribers,
+                    "crash_recoveries": s.crash_recoveries,
+                }
+            rstats = getattr(s.engine, "resilience_stats", None)
+            if rstats is not None:
+                info["windows"] = rstats()
+            per_session[sid] = info
         self._send_json(
             {
                 "stores": {sid: b.stats() for sid, b in stores.items()},
-                "rsp_sessions": n_sessions,
+                "rsp_sessions": len(sessions),
+                "resilience": {
+                    "admission": state.admission.snapshot(),
+                    "sessions": per_session,
+                },
             }
         )
 
@@ -605,11 +753,8 @@ class KolibrieHandler(BaseHTTPRequestHandler):
 
     def _handle_rsp_query(self):
         req = self._read_json()
-        if req is None:
-            return
         if not req.get("query"):
-            self._send_error_json("No query provided")
-            return
+            raise BadRequest("No query provided")
         collected: List = []
         start = time.perf_counter()
         try:
@@ -622,8 +767,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 collected.append,
             )
         except Exception as e:
-            self._send_error_json(f"Failed to build RSP engine: {e}")
-            return
+            raise BadRequest(f"Failed to build RSP engine: {e}") from e
         events = [e for e in (req.get("events") or []) if isinstance(e, dict)]
         events.sort(key=lambda e: e.get("timestamp", 0))
         try:
@@ -634,9 +778,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                     int(ev.get("timestamp", 0)),
                     ev.get("ntriples", ""),
                 )
+        except KolibrieError:
+            raise
         except Exception as e:
-            self._send_error_json(f"Event error: {e}")
-            return
+            raise QueryError(f"Event error: {e}") from e
         engine.stop()
         table = results_to_table(collected)
         self._send_json(
@@ -673,8 +818,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 engine.restore_state(restore_blob)
         except Exception as e:
             verb = "restore" if restore_blob is not None else "build"
-            self._send_error_json(f"Failed to {verb} RSP engine: {e}")
-            return
+            raise BadRequest(f"Failed to {verb} RSP engine: {e}") from e
         streams = [cfg.stream_iri for cfg in engine.window_configs]
         session = EngineSession(engine, streams)
         # keep the CONFIGURATION so /rsp/checkpoint blobs are restorable
@@ -697,11 +841,8 @@ class KolibrieHandler(BaseHTTPRequestHandler):
 
     def _handle_rsp_register(self):
         req = self._read_json()
-        if req is None:
-            return
         if not req.get("query"):
-            self._send_error_json("No query provided")
-            return
+            raise BadRequest("No query provided")
         self._create_session(req)
 
     def _handle_rsp_checkpoint(self):
@@ -712,14 +853,11 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         import base64
 
         req = self._read_json()
-        if req is None:
-            return
         state = self.state
         with state.lock:
             session = state.sessions.get(str(req.get("session_id")))
         if session is None:
-            self._send_error_json("session not found", 404)
-            return
+            raise NotFound("session not found")
         with session.push_lock:
             blob = session.engine.checkpoint_state()
         self._send_json(
@@ -738,40 +876,45 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         import base64
 
         req = self._read_json()
-        if req is None:
-            return
         reg = req.get("register") or {}
         if not reg.get("query"):
-            self._send_error_json("No query in register payload")
-            return
+            raise BadRequest("No query in register payload")
         try:
             blob = base64.b64decode(req.get("state", ""), validate=True)
-        except Exception:
-            self._send_error_json("Invalid base64 state")
-            return
+        except Exception as e:
+            raise BadRequest("Invalid base64 state") from e
         self._create_session(reg, restore_blob=blob)
 
     def _handle_rsp_push(self):
         req = self._read_json()
-        if req is None:
-            return
         state = self.state
         with state.lock:
             session = state.sessions.get(str(req.get("session_id")))
         if session is None:
-            self._send_error_json("session not found", 404)
-            return
-        try:
-            with session.push_lock:
+            raise NotFound("session not found")
+        with session.push_lock, deadline_scope(self._request_deadline(req)):
+            try:
                 n = _push_event(
                     session.engine,
                     req.get("stream", ""),
                     int(req.get("timestamp", 0)),
                     req.get("ntriples", ""),
                 )
-        except Exception as e:
-            self._send_error_json(f"Push error: {e}")
-            return
+                # checkpoint AFTER the event is fully processed: a crash
+                # on a later push rolls back to this consistent state and
+                # the client replays from here (at-least-once)
+                session.maybe_checkpoint()
+            except WindowCrash as e:
+                recovered = session.recover()
+                payload = e.payload(context=self.path)
+                payload["recovered"] = recovered
+                payload["crash_recoveries"] = session.crash_recoveries
+                self._send_json(payload, e.http_status)
+                return
+            except KolibrieError:
+                raise
+            except Exception as e:
+                raise QueryError(f"Push error: {e}") from e
         self._send_json({"ok": True, "triples": n})
 
     def _handle_sse(self, session_id: str):
@@ -801,7 +944,11 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 except queue.Empty:
                     self.wfile.write(b": keepalive\n\n")
                 self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError, OSError):
+        except (BrokenPipeError, ConnectionResetError, OSError, ValueError):
+            # ValueError covers "I/O operation on closed file", which is
+            # not an OSError subclass.  A subscriber that dies WITHOUT
+            # reaching this finally (killed daemon thread) is pruned by
+            # EngineSession.emit when its bounded queue fills.
             pass
         finally:
             session.unsubscribe(q)

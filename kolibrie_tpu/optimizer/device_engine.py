@@ -76,6 +76,8 @@ __all__ = [
 ]
 
 from kolibrie_tpu.ops import round_cap as _round_cap
+from kolibrie_tpu.resilience.deadline import check_deadline
+from kolibrie_tpu.resilience.faultinject import fault_point
 
 
 class Unsupported(Exception):
@@ -1861,9 +1863,16 @@ class LoweredPlan:
 
     def execute(self) -> BindingTable:
         """Run to completion with capacity validation; returns a host table."""
+        # deadline check BEFORE the dispatch (don't start device work the
+        # client stopped waiting for) and a fault point that can inject
+        # kernel latency / simulated device OOM for the chaos tests
+        check_deadline("device.execute")
+        fault_point("device.execute")
         if not self.const_ok():
             return self.empty_table()
-        return self.to_table(*self.converge(self.run()))
+        table = self.to_table(*self.converge(self.run()))
+        check_deadline("device.execute.done")
+        return table
 
 
 def string_filter_mask(db, name: str, pattern: str, which: str) -> np.ndarray:
@@ -1955,6 +1964,12 @@ def template_scan_cap(db, order_name: str, n_bound: int) -> int:
 
 
 def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> LoweredPlan:
+    # resilience hooks: an injected compile fault raises DeviceFault (NOT
+    # Unsupported — transient, counted by the circuit breaker, never
+    # recorded as a sticky lowering sentinel); an expired deadline sheds
+    # the request before lowering work starts
+    check_deadline("device.lower")
+    fault_point("device.lower")
     return LoweredPlan(db, plan, anti_plans, union_groups, optional_plans)
 
 
@@ -1977,6 +1992,8 @@ def execute_plan_batch(
 
     if not lowereds:
         return []
+    check_deadline("device.batch")
+    fault_point("device.batch")
     base = lowereds[0]
     for lp in lowereds[1:]:
         if lp.mask_exprs != base.mask_exprs:

@@ -44,6 +44,9 @@ from kolibrie_tpu.query.ast import (
     WhereClause,
 )
 from kolibrie_tpu.query.parser import parse_combined_query
+from kolibrie_tpu.resilience.breaker import breaker_board
+from kolibrie_tpu.resilience.deadline import check_deadline
+from kolibrie_tpu.resilience.errors import DeadlineExceeded, is_device_fault
 
 Rows = List[List[str]]
 
@@ -1026,11 +1029,59 @@ def plan_cache_info(db) -> dict:
     }
 
 
+def _execute_degraded(db, sparql: str) -> Rows:
+    """Degraded mode: run on the CPU interpreter path by forcing host
+    execution for this call.  The plan-cache state key includes
+    ``execution_mode``, so the host plan gets (and keeps) its own warm
+    slot — repeat degraded queries don't re-plan.
+
+    The mode flip is a plain attribute swap: callers that share a
+    database across threads (the serving layer's TemplateBatcher) already
+    serialize all database access on ``dispatch_lock``."""
+    check_deadline("executor.degraded")
+    prev = db.execution_mode
+    db.execution_mode = "host"
+    try:
+        ent, slot = _plan_cache_entry(db, sparql)
+        return execute_combined(db, ent["cq"], cache_entry=slot)
+    finally:
+        db.execution_mode = prev
+
+
 def execute_query_volcano(sparql: str, db) -> Rows:
-    """The main query path (execute_query.rs:356 parity)."""
+    """The main query path (execute_query.rs:356 parity).
+
+    Device-routed queries run behind the template's circuit breaker
+    (:mod:`kolibrie_tpu.resilience.breaker`): transient device faults
+    (injected or real compile failures, device OOM) and deadline blowups
+    count against the breaker; a device fault degrades THIS call to the
+    CPU interpreter path and, once the breaker trips, the whole template
+    is served degraded until a half-open probe succeeds.  ``Unsupported``
+    is not a fault — the sticky lowering sentinel already handles it."""
+    check_deadline("executor.enter")
     db.register_prefixes_from_query(sparql)
     ent, slot = _plan_cache_entry(db, sparql)
-    return execute_combined(db, ent["cq"], cache_entry=slot)
+    if not _device_routed(db):
+        return execute_combined(db, ent["cq"], cache_entry=slot)
+    fp = ent["fp"]
+    board = breaker_board(db)
+    if not board.allow(fp):
+        return _execute_degraded(db, sparql)
+    try:
+        rows = execute_combined(db, ent["cq"], cache_entry=slot)
+    except DeadlineExceeded:
+        # still shed (the client's budget is gone either way), but a
+        # template that repeatedly blows deadlines on the device trips
+        # its breaker and future calls go straight to the host path
+        board.record_failure(fp)
+        raise
+    except Exception as e:
+        if not is_device_fault(e):
+            raise
+        board.record_failure(fp)
+        return _execute_degraded(db, sparql)
+    board.record_success(fp)
+    return rows
 
 
 def _batchable_select(db, cq):
@@ -1103,11 +1154,13 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
         lower_plan,
     )
 
+    check_deadline("executor.batch")
     results: List[Optional[Rows]] = [None] * len(queries)
     for text in queries:
         db.register_prefixes_from_query(text)
     groups: Dict[str, List[int]] = {}
     members: List[Optional[tuple]] = [None] * len(queries)
+    board = breaker_board(db)
     if _device_routed(db):
         for i, text in enumerate(queries):
             ent, slot = _plan_cache_entry(db, text)
@@ -1123,6 +1176,8 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
     for fp, idxs in groups.items():
         if len(idxs) < 2:
             continue  # solo dispatch is already optimal for singletons
+        if not board.allow(fp):
+            continue  # breaker open: members fall to the solo degraded path
         lowereds, ok = [], True
         for i in idxs:
             ent, slot, q, w = members[i]
@@ -1135,6 +1190,17 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             except Unsupported:
                 ok = False
                 break
+            except DeadlineExceeded:
+                board.record_failure(fp)
+                raise
+            except Exception as e:
+                if not is_device_fault(e):
+                    raise
+                # transient compile fault: count it, hand the whole group
+                # to the solo path (which degrades per the breaker)
+                board.record_failure(fp)
+                ok = False
+                break
             lowereds.append((i, q, plan, lowered))
         if not ok:
             continue
@@ -1142,6 +1208,15 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             tables = execute_plan_batch([low for _, _, _, low in lowereds])
         except Unsupported:
             continue  # shape/plan divergence inside the group: solo path
+        except DeadlineExceeded:
+            board.record_failure(fp)
+            raise
+        except Exception as e:
+            if not is_device_fault(e):
+                raise
+            board.record_failure(fp)
+            continue
+        board.record_success(fp)
         stats["batched"] += len(idxs)
         stats["batch_groups"] += 1
         for (i, q, plan, lowered), table in zip(lowereds, tables):

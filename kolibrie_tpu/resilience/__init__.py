@@ -1,0 +1,76 @@
+"""Resilience subsystem: deadlines, admission control, circuit breakers,
+window supervision, and deterministic fault injection.
+
+See docs/RESILIENCE.md for the failure-mode map and configuration."""
+
+from kolibrie_tpu.resilience.admission import AdmissionController
+from kolibrie_tpu.resilience.breaker import (
+    BreakerBoard,
+    CircuitBreaker,
+    breaker_board,
+)
+from kolibrie_tpu.resilience.deadline import (
+    Deadline,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+    remaining_s,
+)
+from kolibrie_tpu.resilience.errors import (
+    BadRequest,
+    DeadlineExceeded,
+    DeviceFault,
+    KolibrieError,
+    NotFound,
+    Overloaded,
+    QueryError,
+    RequestTooLarge,
+    WindowCrash,
+    error_response,
+    is_device_fault,
+)
+from kolibrie_tpu.resilience.faultinject import (
+    FaultPlan,
+    InjectedCompileError,
+    InjectedDeviceOOM,
+    InjectedFault,
+    InjectedWindowCrash,
+    fault_point,
+)
+from kolibrie_tpu.resilience.supervisor import (
+    DeadLetter,
+    SupervisionConfig,
+    WindowSupervisor,
+)
+
+__all__ = [
+    "AdmissionController",
+    "BadRequest",
+    "BreakerBoard",
+    "CircuitBreaker",
+    "DeadLetter",
+    "Deadline",
+    "DeadlineExceeded",
+    "DeviceFault",
+    "FaultPlan",
+    "InjectedCompileError",
+    "InjectedDeviceOOM",
+    "InjectedFault",
+    "InjectedWindowCrash",
+    "KolibrieError",
+    "NotFound",
+    "Overloaded",
+    "QueryError",
+    "RequestTooLarge",
+    "SupervisionConfig",
+    "WindowCrash",
+    "WindowSupervisor",
+    "breaker_board",
+    "check_deadline",
+    "current_deadline",
+    "deadline_scope",
+    "error_response",
+    "fault_point",
+    "is_device_fault",
+    "remaining_s",
+]

@@ -1,0 +1,176 @@
+"""Per-template circuit breakers with exponential-backoff re-probe.
+
+PR 1 left the executor with *sticky* per-template failure sentinels:
+once device lowering failed, the template never tried the device again.
+That is the right policy for :class:`Unsupported` (a permanent property
+of the template's shape) but wrong for TRANSIENT device faults — a
+compile that hit an injected/real OOM, a dispatch that blew its
+deadline.  Those need the classic breaker state machine:
+
+- **closed**: requests run on the device; failures are counted.
+- **open** (tripped after ``failure_threshold`` consecutive failures):
+  requests skip the device entirely and run on the CPU interpreter path
+  (graceful degradation — the client still gets rows).
+- **half-open** (after an exponentially growing backoff): exactly ONE
+  probe request is allowed back onto the device.  Success closes the
+  breaker; failure re-opens it with a doubled backoff (capped).
+
+Keyed by template fingerprint — the same key the plan cache uses — so
+one poisoned query shape cannot take healthy templates down with it.
+The clock is injectable: every transition is testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# Defaults; env-overridable so operators can tune without a deploy.
+DEFAULT_FAILURE_THRESHOLD = int(os.environ.get("KOLIBRIE_BREAKER_THRESHOLD", "3"))
+DEFAULT_BACKOFF_BASE_S = float(os.environ.get("KOLIBRIE_BREAKER_BACKOFF_S", "0.5"))
+DEFAULT_BACKOFF_FACTOR = 2.0
+DEFAULT_BACKOFF_MAX_S = float(os.environ.get("KOLIBRIE_BREAKER_BACKOFF_MAX_S", "60"))
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_factor: float = DEFAULT_BACKOFF_FACTOR,
+        backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.backoff_base_s = backoff_base_s
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.failures = 0  # consecutive, resets on success
+        self.total_failures = 0  # lifetime, never resets
+        self.trips = 0  # lifetime trip count
+        self.consecutive_trips = 0  # drives the backoff exponent
+        self.retry_at = 0.0
+        self._probe_inflight = False
+        self.degraded_served = 0  # requests routed to the host path
+
+    # ------------------------------------------------------------- decisions
+
+    def allow(self) -> bool:
+        """May this request take the device path?  False ⇒ degraded host
+        path.  An open breaker past its backoff admits ONE half-open
+        probe; concurrent requests during the probe stay degraded."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN and self.clock() >= self.retry_at:
+                self.state = HALF_OPEN
+                self._probe_inflight = False
+            if self.state == HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            self.degraded_served += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self.consecutive_trips = 0
+            self.state = CLOSED
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            self.total_failures += 1
+            if self.state == HALF_OPEN or self.failures >= self.failure_threshold:
+                self._trip_locked()
+
+    def _trip_locked(self) -> None:
+        self.trips += 1
+        self.consecutive_trips += 1
+        backoff = min(
+            self.backoff_base_s
+            * (self.backoff_factor ** (self.consecutive_trips - 1)),
+            self.backoff_max_s,
+        )
+        self.state = OPEN
+        self.retry_at = self.clock() + backoff
+        self._probe_inflight = False
+        self.failures = 0
+
+    # ----------------------------------------------------------------- stats
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self.state,
+                "failures": self.failures,
+                "total_failures": self.total_failures,
+                "trips": self.trips,
+                "degraded_served": self.degraded_served,
+            }
+            if self.state == OPEN:
+                out["retry_in_s"] = round(max(0.0, self.retry_at - self.clock()), 3)
+            return out
+
+
+class BreakerBoard:
+    """One breaker per template fingerprint, created on first sight.
+
+    Bounded: past ``max_entries`` the oldest CLOSED breakers are evicted
+    (an evicted healthy breaker loses nothing; open/half-open breakers —
+    the ones carrying state that matters — are never dropped)."""
+
+    def __init__(self, max_entries: int = 256, **breaker_kwargs):
+        self._kwargs = breaker_kwargs
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def get(self, fp: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(fp)
+            if br is None:
+                if len(self._breakers) >= self.max_entries:
+                    for k in [
+                        k
+                        for k, b in self._breakers.items()
+                        if b.state == CLOSED
+                    ][: len(self._breakers) - self.max_entries + 1]:
+                        self._breakers.pop(k)
+                br = self._breakers[fp] = CircuitBreaker(**self._kwargs)
+            return br
+
+    def allow(self, fp: str) -> bool:
+        return self.get(fp).allow()
+
+    def record_success(self, fp: str) -> None:
+        self.get(fp).record_success()
+
+    def record_failure(self, fp: str) -> None:
+        self.get(fp).record_failure()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {fp: br.snapshot() for fp, br in items}
+
+
+def breaker_board(db, **breaker_kwargs) -> BreakerBoard:
+    """The database's breaker board, lazily attached (same pattern as the
+    plan caches): every executor entry point sharing a db shares its
+    breakers."""
+    board = db.__dict__.get("_breaker_board")
+    if board is None:
+        board = BreakerBoard(**breaker_kwargs)
+        db.__dict__["_breaker_board"] = board
+    return board

@@ -1,0 +1,158 @@
+"""Shared error taxonomy for the serving stack.
+
+One hierarchy maps every failure the serving layer can surface to a
+structured HTTP response: a status code, a stable machine-readable
+``code`` string, and the human message.  The HTTP frontend used to hold
+a dozen bare ``except Exception`` blocks each inventing its own message
+shape; they now all route through :func:`error_response`.
+
+Design rules:
+
+- ``KeyboardInterrupt`` / ``SystemExit`` (and every other
+  ``BaseException`` outside ``Exception``) are NEVER classified — they
+  propagate.  :func:`error_response` refuses them loudly rather than
+  swallowing an interpreter shutdown into a 500.
+- Exceptions that are not :class:`KolibrieError` get a conservative
+  default mapping (parse/value errors → 400, everything else → 500) so
+  a new failure mode degrades to a structured response, not a stack
+  trace over a half-written HTTP body.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+class KolibrieError(Exception):
+    """Base of the serving-layer taxonomy: carries the HTTP mapping."""
+
+    http_status = 500
+    code = "internal"
+
+    def payload(self, context: str = "") -> Dict[str, object]:
+        msg = str(self) or self.code
+        out: Dict[str, object] = {"error": msg, "code": self.code}
+        if context:
+            out["context"] = context
+        return out
+
+
+class BadRequest(KolibrieError):
+    """Malformed client input (bad JSON, missing fields, parse errors)."""
+
+    http_status = 400
+    code = "bad_request"
+
+
+class QueryError(BadRequest):
+    """The query itself failed to parse or execute."""
+
+    code = "query_failed"
+
+
+class NotFound(KolibrieError):
+    http_status = 404
+    code = "not_found"
+
+
+class RequestTooLarge(KolibrieError):
+    http_status = 413
+    code = "request_too_large"
+
+
+class Overloaded(KolibrieError):
+    """Admission control shed the request (queue depth / in-flight cap).
+
+    ``retry_after_s`` is advisory; it lands in the payload so clients can
+    back off without parsing prose."""
+
+    http_status = 429
+    code = "overloaded"
+
+    def __init__(self, message: str = "server overloaded", retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def payload(self, context: str = "") -> Dict[str, object]:
+        out = super().payload(context)
+        out["retry_after_s"] = self.retry_after_s
+        return out
+
+
+class DeadlineExceeded(KolibrieError):
+    """The request's deadline budget ran out (shed, not served late)."""
+
+    http_status = 504
+    code = "deadline_exceeded"
+
+    def __init__(self, message: str = "deadline exceeded", site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+    def payload(self, context: str = "") -> Dict[str, object]:
+        out = super().payload(context)
+        if self.site:
+            out["site"] = self.site
+        return out
+
+
+class DeviceFault(KolibrieError):
+    """Device-side failure (compile error, OOM, kernel fault) — the class
+    the circuit breaker counts.  Serving layers should degrade to the
+    host interpreter path instead of returning this to a client."""
+
+    http_status = 500
+    code = "device_fault"
+
+
+class WindowCrash(KolibrieError):
+    """A window processor thread died mid-event.  The supervisor restarts
+    it (multi-thread mode) or the session restores from its last
+    checkpoint (single-thread serving)."""
+
+    http_status = 503
+    code = "window_crashed"
+
+
+def is_device_fault(exc: BaseException) -> bool:
+    """Does this exception count against a template's circuit breaker?
+
+    Device faults: our taxonomy's :class:`DeviceFault` (fault injection
+    lands here), plus the raw forms a real backend produces —
+    ``XlaRuntimeError`` (by name: jax moves it between modules across
+    versions), ``MemoryError``/RESOURCE_EXHAUSTED, and jax's
+    ``JaxRuntimeError``.  Deliberately NOT ``Unsupported`` (a permanent
+    template property, handled by the sticky lowering sentinel) and NOT
+    parse/semantic errors (the query is wrong on every engine)."""
+    if isinstance(exc, DeviceFault):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError", "InternalError"):
+        return True
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "Resource exhausted" in msg
+
+
+def error_response(
+    exc: BaseException, context: str = ""
+) -> Tuple[int, Dict[str, object]]:
+    """Map an exception to ``(http_status, json_payload)``.
+
+    Raises (never maps) anything outside ``Exception`` — swallowing a
+    ``KeyboardInterrupt`` or ``SystemExit`` into a 500 would turn an
+    operator's Ctrl-C into a hung worker."""
+    if not isinstance(exc, Exception):
+        raise exc
+    if isinstance(exc, KolibrieError):
+        return exc.http_status, exc.payload(context)
+    if isinstance(exc, (ValueError, TypeError, KeyError, SyntaxError)):
+        status, code = 400, "bad_request"
+    else:
+        status, code = 500, "internal"
+    msg = str(exc) or type(exc).__name__
+    out: Dict[str, object] = {"error": msg, "code": code}
+    if context:
+        out["context"] = context
+    return status, out

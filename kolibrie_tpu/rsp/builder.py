@@ -43,6 +43,7 @@ class RSPBuilder:
         self._cross_window_rules_text: Optional[str] = None
         self._cross_window_mode = CrossWindowReasoningMode.INCREMENTAL
         self._r2r_mode: Optional[str] = None
+        self._supervision = None
 
     # fluent configuration ---------------------------------------------------
 
@@ -92,6 +93,13 @@ class RSPBuilder:
         :class:`kolibrie_tpu.rsp.r2r.IncrementalR2R`), or ``"auto"``
         (device when running on TPU)."""
         self._r2r_mode = mode
+        return self
+
+    def with_supervision(self, config) -> "RSPBuilder":
+        """Window supervision policy
+        (:class:`kolibrie_tpu.resilience.SupervisionConfig`): event-retry
+        and dead-letter bounds, restart backoff, checkpoint cadence."""
+        self._supervision = config
         return self
 
     # build ------------------------------------------------------------------
@@ -160,4 +168,5 @@ class RSPBuilder:
             cross_window_mode=self._cross_window_mode,
             cross_window_rules_text=self._cross_window_rules_text,
             r2r_mode=self._r2r_mode,
+            supervision=self._supervision,
         )

@@ -206,9 +206,14 @@ class RSPEngine:
         cross_window_mode: str = CrossWindowReasoningMode.INCREMENTAL,
         cross_window_rules_text: Optional[str] = None,
         r2r_mode: Optional[str] = None,
+        supervision=None,
     ):
         self.window_configs = window_configs
         self.operation_mode = operation_mode
+        # window supervision policy (resilience.supervisor): None uses the
+        # defaults (retry-once + dead-letter, bounded restarts, no
+        # supervisor-driven checkpoints)
+        self.supervision = supervision
         self.sync_policy = sync_policy or SyncPolicy(SyncPolicyKind.STEAL)
         self.consumer = consumer or (lambda row: None)
 
@@ -370,23 +375,34 @@ class RSPEngine:
         raise TypeError(f"unsupported stream item {item!r}")
 
     def _register_windows(self) -> None:
+        """Register per-window processors UNDER SUPERVISION
+        (resilience.supervisor): a processor exception is retried then
+        dead-lettered instead of killing the window; a WindowCrash in
+        multi-thread mode restarts the worker loop with bounded
+        exponential backoff, restoring the engine from the supervisor's
+        last checkpoint when one exists.  In single-thread mode a crash
+        propagates to the pusher (the HTTP session layer restores from
+        ITS checkpoint — docs/RESILIENCE.md)."""
+        from kolibrie_tpu.resilience.supervisor import WindowSupervisor
+
         self._window_receivers: List[queue.Queue] = []
+        self.supervisors: List[WindowSupervisor] = []
+        self._window_threads: List[threading.Thread] = []
         for cfg, runner in zip(self.window_configs, self.windows):
             processor = self._make_processor(cfg)
+            sup = WindowSupervisor(
+                cfg.window_iri,
+                config=self.supervision,
+                checkpoint_fn=self.checkpoint_state,
+                restore_fn=self.restore_state,
+            )
+            self.supervisors.append(sup)
             if self.operation_mode == OperationMode.SINGLE_THREAD:
-                runner.register_callback(processor)
+                runner.register_callback(sup.wrap(processor))
             else:
                 receiver = runner.register()
                 self._window_receivers.append(receiver)
-
-                def run(recv=receiver, proc=processor, iri=cfg.window_iri):
-                    while True:
-                        content = recv.get()
-                        if content is None:  # shutdown sentinel
-                            break
-                        proc(content)
-
-                threading.Thread(target=run, daemon=True).start()
+                self._window_threads.append(sup.spawn(receiver, processor))
 
     # ------------------------------------------------------------ streaming
 
@@ -794,6 +810,21 @@ class RSPEngine:
             self._auto_prev_alive = None
 
     # ----------------------------------------------------------------- misc
+
+    @property
+    def dead_letters(self):
+        """All dead-lettered window firings, across windows."""
+        out = []
+        for sup in getattr(self, "supervisors", []):
+            out.extend(sup.dead_letters)
+        return out
+
+    def resilience_stats(self) -> dict:
+        """Per-window supervisor snapshot (processed / retried / restarts
+        / dead-letter counts) for /stats and operators."""
+        return {
+            "windows": [s.snapshot() for s in getattr(self, "supervisors", [])]
+        }
 
     def stop(self) -> None:
         for runner in self.windows:

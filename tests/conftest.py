@@ -85,3 +85,9 @@ def pytest_configure(config):
         "slow: multi-minute mesh tests, excluded from the tier-1 "
         "`-m 'not slow'` gate (run explicitly with `-m slow`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection scenarios (seeded "
+        "resilience.faultinject plans); CPU-only and fast, so they run "
+        "INSIDE the tier-1 `-m 'not slow'` gate",
+    )

@@ -1,0 +1,419 @@
+"""Deterministic chaos scenarios (seeded fault plans) against the full
+serving stack: the HTTP server keeps answering under injected compile
+faults (degraded through the CPU interpreter path), sheds instead of
+blocking past deadlines, 429s at the admission/queue bounds, and recovers
+a crashed window session from its checkpoint without duplicating or
+dropping rows.
+
+Everything here is CPU-only and seeded — the tier-1 `-m 'not slow'` gate
+runs it on every change.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from contextlib import contextmanager
+
+import pytest
+
+from kolibrie_tpu.frontends.http_server import make_server
+from kolibrie_tpu.resilience.faultinject import (
+    FaultPlan,
+    InjectedCompileError,
+    InjectedWindowCrash,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@contextmanager
+def chaos_server():
+    """Fresh in-process server per scenario; yields (base_url, httpd) so
+    scenarios can reach the bound ``_ServerState`` (admission knobs,
+    session objects) directly."""
+    httpd = make_server("127.0.0.1", 0, quiet=True)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{port}", httpd
+    finally:
+        httpd.shutdown()
+
+
+def post(base, path, payload, timeout=60, headers=None):
+    """→ (status, body) — error responses are data here, not exceptions."""
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get_stats(base):
+    with urllib.request.urlopen(base + "/stats", timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _load_store(base, n=60):
+    lines = [
+        f'<http://e/x{i}> <http://e/dept> "dept{i % 3}" .' for i in range(n)
+    ]
+    st, out = post(
+        base,
+        "/store/load",
+        {"rdf": "\n".join(lines), "format": "ntriples", "mode": "device"},
+    )
+    assert st == 200 and out["triples"] == n
+    return out["store_id"]
+
+
+def _dept_query(d):
+    return f'PREFIX ex: <http://e/> SELECT ?e WHERE {{ ?e ex:dept "dept{d}" }}'
+
+
+# ----------------------------------------------------- injected compile load
+
+
+def test_serving_survives_10pct_compile_faults():
+    """ISSUE acceptance: with 10% of device compiles failing, every request
+    still gets correct rows (degraded through the interpreter path when the
+    device path faults or its breaker is open)."""
+    with chaos_server() as (base, httpd):
+        sid = _load_store(base)
+        plan = FaultPlan(seed=7)
+        # compile faults on lowering AND dispatch faults on the (cached)
+        # lowered plan: the plan cache means lowering runs only a few
+        # times, but execute runs on every device-path request
+        plan.add("device.lower", error=InjectedCompileError, rate=0.10)
+        plan.add("device.execute", error=InjectedCompileError, rate=0.10)
+        with plan.installed():
+            for i in range(40):
+                st, out = post(
+                    base,
+                    "/store/query",
+                    {"store_id": sid, "sparql": _dept_query(i % 3)},
+                )
+                assert st == 200, out
+                assert len(out["data"]) == 20  # 60 triples / 3 depts
+        fires = sum(r["fires"] for r in plan.snapshot().values())
+        assert fires >= 1  # the chaos was real
+        stats = get_stats(base)["stores"][sid]
+        assert stats["requests"] == 40
+        assert stats["shed_queue_full"] == 0 and stats["shed_deadline"] == 0
+        faults_counted = sum(
+            b["total_failures"] for b in stats["breakers"].values()
+        )
+        assert faults_counted >= 1  # faults hit the breakers, not the client
+
+
+def test_breaker_reprobe_restores_device_path():
+    """After the fault plan is lifted, the open breaker's half-open probe
+    succeeds and the template serves from the device path again."""
+    import kolibrie_tpu.resilience.breaker as breaker_mod
+
+    with chaos_server() as (base, httpd):
+        sid = _load_store(base)
+        batcher = httpd.RequestHandlerClass.state.stores[sid]
+        plan = FaultPlan(seed=1)
+        plan.add("device.lower", error=InjectedCompileError, rate=1.0)
+        with plan.installed():
+            for _ in range(4):
+                st, out = post(
+                    base,
+                    "/store/query",
+                    {"store_id": sid, "sparql": _dept_query(0)},
+                )
+                assert st == 200 and len(out["data"]) == 20
+        board = breaker_mod.breaker_board(batcher.db)
+        (fp,) = board.snapshot().keys()
+        assert board.get(fp).state == "open"
+        board.get(fp).retry_at = 0.0  # fast-forward past the backoff
+        st, out = post(
+            base, "/store/query", {"store_id": sid, "sparql": _dept_query(0)}
+        )
+        assert st == 200 and len(out["data"]) == 20
+        assert board.get(fp).state == "closed"  # probe succeeded, healed
+
+
+# ------------------------------------------------------------- deadline shed
+
+
+def test_slow_device_request_sheds_with_504():
+    """A request whose budget dies inside a slow device call is SHED with a
+    structured 504, not served late."""
+    with chaos_server() as (base, httpd):
+        sid = _load_store(base)
+        st, _ = post(
+            base, "/store/query", {"store_id": sid, "sparql": _dept_query(0)}
+        )
+        assert st == 200  # warm path works
+        plan = FaultPlan(seed=0)
+        plan.add("device.lower", latency_s=0.25, rate=1.0)
+        with plan.installed():
+            st, out = post(
+                base,
+                "/store/query",
+                {
+                    "store_id": sid,
+                    "sparql": _dept_query(1),
+                    "deadline_ms": 60,
+                },
+            )
+        assert st == 504, out
+        assert out["code"] == "deadline_exceeded"
+        assert "site" in out
+        # an over-generous budget still succeeds through the same slowdown
+        plan2 = FaultPlan(seed=0)
+        plan2.add("device.lower", latency_s=0.05, rate=1.0)
+        with plan2.installed():
+            st, out = post(
+                base,
+                "/store/query",
+                {
+                    "store_id": sid,
+                    "sparql": _dept_query(2),
+                    "deadline_ms": 30000,
+                },
+            )
+        assert st == 200 and len(out["data"]) == 20
+        assert get_stats(base)["stores"][sid]["shed_deadline"] >= 0
+
+
+def test_deadline_header_and_invalid_value():
+    with chaos_server() as (base, httpd):
+        sid = _load_store(base, n=6)
+        st, _ = post(
+            base,
+            "/store/query",
+            {"store_id": sid, "sparql": _dept_query(0)},
+            headers={"X-Kolibrie-Deadline-Ms": "30000"},
+        )
+        assert st == 200
+        st, out = post(
+            base,
+            "/store/query",
+            {"store_id": sid, "sparql": _dept_query(0), "deadline_ms": "soon"},
+        )
+        assert st == 400 and "deadline_ms" in out["error"]
+
+
+# --------------------------------------------------------- admission control
+
+
+def test_inflight_cap_returns_structured_429():
+    with chaos_server() as (base, httpd):
+        adm = httpd.RequestHandlerClass.state.admission
+        adm.max_inflight = 0
+        st, out = post(
+            base, "/query", {"sparql": "SELECT ?s WHERE { ?s ?p ?o }"}
+        )
+        assert st == 429, out
+        assert out["code"] == "overloaded"
+        assert out["retry_after_s"] > 0
+        adm.max_inflight = 64
+        st, _ = post(
+            base, "/query", {"sparql": "SELECT ?s WHERE { ?s ?p ?o }"}
+        )
+        assert st == 200
+        snap = get_stats(base)["resilience"]["admission"]
+        assert snap["shed"] == 1 and snap["admitted"] >= 1
+
+
+def test_queue_depth_cap_returns_structured_429():
+    with chaos_server() as (base, httpd):
+        sid = _load_store(base, n=6)
+        batcher = httpd.RequestHandlerClass.state.stores[sid]
+        batcher.max_queue_depth = 0
+        st, out = post(
+            base, "/store/query", {"store_id": sid, "sparql": _dept_query(0)}
+        )
+        assert st == 429, out
+        assert out["code"] == "overloaded" and out["retry_after_s"] > 0
+        batcher.max_queue_depth = 256
+        st, _ = post(
+            base, "/store/query", {"store_id": sid, "sparql": _dept_query(0)}
+        )
+        assert st == 200
+        assert get_stats(base)["stores"][sid]["shed_queue_full"] == 1
+
+
+# ------------------------------------------------- window crash + checkpoint
+
+
+RSP_QUERY = (
+    "REGISTER RSTREAM <out> AS SELECT * "
+    "FROM NAMED WINDOW <w> ON <stream1> [RANGE 10 STEP 2] "
+    "WHERE { WINDOW <w> { ?s ?p ?o } }"
+)
+
+
+def _push(base, sid, ts):
+    return post(
+        base,
+        "/rsp/push",
+        {
+            "session_id": sid,
+            "stream": "stream1",
+            "timestamp": ts,
+            "ntriples": f"<http://e/s{ts}> <http://e/p> <http://e/o{ts}> .",
+        },
+    )
+
+
+def _run_session(base, httpd, timestamps, crash_at_ts=None):
+    """Register a session, push events (optionally crashing one mid-window
+    and replaying it like a client would), and return the session object."""
+    st, reg = post(base, "/rsp/register", {"query": RSP_QUERY})
+    assert st == 200
+    sid = reg["session_id"]
+    for ts in timestamps:
+        if ts == crash_at_ts:
+            plan = FaultPlan(seed=0)
+            plan.add(
+                "rsp.window", error=InjectedWindowCrash, rate=1.0, max_fires=1
+            )
+            with plan.installed():
+                st, out = _push(base, sid, ts)
+            assert st == 503, out
+            assert out["code"] == "window_crashed"
+            assert out["recovered"] is True  # restored from checkpoint
+            st, out = _push(base, sid, ts)  # client replays the event
+        else:
+            st, out = _push(base, sid, ts)
+        assert st == 200, out
+    return httpd.RequestHandlerClass.state.sessions[sid]
+
+
+def test_window_crash_recovers_from_checkpoint_no_dup_no_drop():
+    """ISSUE acceptance: an injected window-thread crash mid-stream gets a
+    structured 503, the session restores from its last checkpoint, and a
+    client replay continues the stream with exactly the rows an
+    uninterrupted run produces (no duplicates, no drops)."""
+    timestamps = [1, 2, 3, 4, 5, 6]
+    with chaos_server() as (base, httpd):
+        ref_session = _run_session(base, httpd, timestamps)
+        ref_rows = list(ref_session.results)
+
+    with chaos_server() as (base, httpd):
+        session = _run_session(base, httpd, timestamps, crash_at_ts=4)
+        assert session.crash_recoveries == 1
+        assert session.results == ref_rows
+        per = get_stats(base)["resilience"]["sessions"]
+        assert any(s["crash_recoveries"] == 1 for s in per.values())
+
+
+def test_crash_without_checkpoint_reports_unrecovered():
+    """A crash with no usable checkpoint must say so in the 503 instead of
+    pretending the session healed."""
+    with chaos_server() as (base, httpd):
+        st, reg = post(base, "/rsp/register", {"query": RSP_QUERY})
+        assert st == 200
+        sid = reg["session_id"]
+        for ts in [1, 2, 3]:
+            st, _ = _push(base, sid, ts)
+            assert st == 200
+        session = httpd.RequestHandlerClass.state.sessions[sid]
+        session.last_checkpoint = None  # as if checkpointing never succeeded
+        plan = FaultPlan(seed=0)
+        plan.add(
+            "rsp.window", error=InjectedWindowCrash, rate=1.0, max_fires=1
+        )
+        with plan.installed():
+            st, out = _push(base, sid, 4)
+        assert st == 503
+        assert out["recovered"] is False
+
+
+ENGINE_QUERY = """
+PREFIX ex: <http://e/>
+REGISTER ISTREAM <http://out/stream> AS
+SELECT ?s ?o
+FROM NAMED WINDOW <http://e/w> ON ?stream [RANGE 3 STEP 1]
+WHERE { WINDOW <http://e/w> { ?s ex:val ?o } }
+"""
+
+
+def _build_engine(sink, supervision=None):
+    from kolibrie_tpu.rsp.builder import RSPBuilder
+
+    b = RSPBuilder(ENGINE_QUERY).with_consumer(sink.append)
+    if supervision is not None:
+        b.with_supervision(supervision)
+    return b.build()
+
+
+def _event(i):
+    from kolibrie_tpu.rsp.s2r import WindowTriple
+
+    return WindowTriple(f"<http://e/s{i}>", "<http://e/val>", f'"{i}"')
+
+
+def test_engine_checkpoint_roundtrip_under_midwindow_crash():
+    """Satellite: RSPEngine.checkpoint_state/restore_state round-trip with
+    a crash injected MID-WINDOW — the restored engine replays the crashed
+    event and the combined emission equals an uninterrupted run's."""
+    from kolibrie_tpu.resilience.errors import WindowCrash
+
+    ref = []
+    e_ref = _build_engine(ref)
+    for i in [1, 2, 3, 4, 5]:
+        e_ref.add_to_stream(":stream", _event(i), i)
+    e_ref.stop()
+
+    # interrupted run: checkpoint after ts=2, crash injected on ts=3
+    part1 = []
+    e1 = _build_engine(part1)
+    for i in [1, 2]:
+        e1.add_to_stream(":stream", _event(i), i)
+    blob = e1.checkpoint_state()
+    plan = FaultPlan(seed=0)
+    plan.add("rsp.window", error=InjectedWindowCrash, rate=1.0, max_fires=1)
+    with plan.installed():
+        with pytest.raises(WindowCrash):
+            e1.add_to_stream(":stream", _event(3), 3)
+    e1.stop()
+
+    # recovery: fresh engine + restore + replay from the checkpoint
+    part2 = []
+    e2 = _build_engine(part2)
+    e2.restore_state(blob)
+    for i in [3, 4, 5]:
+        e2.add_to_stream(":stream", _event(i), i)
+    e2.stop()
+
+    vals_ref = [dict(r).get("o") for r in ref]
+    vals_split = [dict(r).get("o") for r in part1 + part2]
+    assert vals_split == vals_ref  # no duplicated, no dropped rows
+
+
+def test_dead_letter_keeps_stream_flowing():
+    """A poisoned firing (plain processor exception, not a crash) is
+    retried then dead-lettered; later events still produce results."""
+    from kolibrie_tpu.resilience.supervisor import SupervisionConfig
+
+    rows = []
+    engine = _build_engine(
+        rows, supervision=SupervisionConfig(max_event_retries=1)
+    )
+    plan = FaultPlan(seed=0)
+    # firing 2 fails on first try AND on its retry (calls 2 and 3)
+    plan.add("rsp.window", error=ValueError, at_calls=[2, 3])
+    with plan.installed():
+        for i in [1, 2, 3, 4]:
+            engine.add_to_stream(":stream", _event(i), i)
+    engine.stop()
+    assert len(engine.dead_letters) == 1
+    assert engine.supervisors[0].retried == 1
+    stats = engine.resilience_stats()["windows"][0]
+    assert stats["dead_letters"] == 1 and not stats["dead"]
+    # the stream kept flowing: rows from firings after the poisoned one
+    # (literal quotes are stripped in emitted bindings)
+    assert any(dict(r).get("o") == "3" for r in rows)

@@ -1,0 +1,424 @@
+"""Resilience primitives: deadlines, circuit breakers, admission control,
+deterministic fault injection, the error taxonomy, window supervision, and
+the SSE subscriber bookkeeping.  Every clock and sleep is injected —
+nothing in this file waits on wall time except the tiny spawn-loop joins.
+"""
+
+import queue
+import threading
+
+import pytest
+
+from kolibrie_tpu.resilience.admission import AdmissionController
+from kolibrie_tpu.resilience.breaker import BreakerBoard, CircuitBreaker
+from kolibrie_tpu.resilience.deadline import (
+    Deadline,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+)
+from kolibrie_tpu.resilience.errors import (
+    DeadlineExceeded,
+    DeviceFault,
+    Overloaded,
+    error_response,
+    is_device_fault,
+)
+from kolibrie_tpu.resilience.faultinject import (
+    FaultPlan,
+    InjectedCompileError,
+    InjectedWindowCrash,
+    fault_point,
+)
+from kolibrie_tpu.resilience.supervisor import (
+    SupervisionConfig,
+    WindowSupervisor,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ----------------------------------------------------------------- deadlines
+
+
+def test_deadline_expiry_and_check():
+    clk = FakeClock()
+    dl = Deadline(1.0, clock=clk)
+    assert not dl.expired()
+    assert dl.remaining() == pytest.approx(1.0)
+    clk.advance(0.6)
+    assert dl.remaining() == pytest.approx(0.4)
+    clk.advance(0.5)
+    assert dl.expired()
+    assert dl.remaining() == 0.0
+    with pytest.raises(DeadlineExceeded) as ei:
+        dl.check("unit.site")
+    assert ei.value.site == "unit.site"
+    assert ei.value.http_status == 504
+
+
+def test_deadline_merge_picks_tighter():
+    clk = FakeClock()
+    tight, loose = Deadline(1.0, clock=clk), Deadline(5.0, clock=clk)
+    assert tight.merge(loose) is tight
+    assert loose.merge(tight) is tight
+    assert tight.merge(None) is tight
+
+
+def test_deadline_scope_nesting_and_none_mask():
+    clk = FakeClock()
+    outer = Deadline(0.5, clock=clk)
+    assert current_deadline() is None
+    check_deadline("anywhere")  # no scope → no-op
+    with deadline_scope(outer):
+        assert current_deadline() is outer
+        clk.advance(1.0)  # outer is now expired
+        with pytest.raises(DeadlineExceeded):
+            check_deadline("inner")
+        # None MASKS the outer scope: a batch leader re-running a
+        # no-deadline follower must not see the leader's budget
+        with deadline_scope(None):
+            assert current_deadline() is None
+            check_deadline("masked")  # must not raise
+        assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+# ------------------------------------------------------------------ breakers
+
+
+def test_breaker_trips_and_reprobes():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failure_threshold=3, backoff_base_s=1.0, backoff_max_s=60.0, clock=clk
+    )
+    for _ in range(2):
+        br.record_failure()
+        assert br.allow()
+    br.record_failure()  # third consecutive failure trips
+    assert br.state == "open"
+    assert not br.allow()
+    assert br.degraded_served == 1
+    clk.advance(0.5)
+    assert not br.allow()  # still inside backoff
+    clk.advance(0.6)  # past backoff: exactly ONE half-open probe
+    assert br.allow()
+    assert not br.allow()  # concurrent request during the probe: degraded
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_halfopen_failure_doubles_backoff():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        failure_threshold=1, backoff_base_s=1.0, backoff_max_s=60.0, clock=clk
+    )
+    br.record_failure()  # trip 1: backoff 1s
+    clk.advance(1.1)
+    assert br.allow()  # half-open probe
+    br.record_failure()  # probe fails → trip 2: backoff 2s
+    assert br.state == "open"
+    clk.advance(1.5)
+    assert not br.allow()  # 1.5 < 2.0: doubled backoff holds
+    clk.advance(0.6)
+    assert br.allow()
+    br.record_success()
+    assert br.consecutive_trips == 0  # success resets the exponent
+
+
+def test_breaker_board_keys_isolated_and_bounded():
+    clk = FakeClock()
+    board = BreakerBoard(max_entries=4, failure_threshold=1, clock=clk)
+    board.record_failure("bad")
+    assert not board.allow("bad")
+    assert board.allow("good")  # unrelated template unaffected
+    for i in range(6):
+        board.allow(f"fill{i}")
+    snap = board.snapshot()
+    assert len(snap) <= 4
+    assert "bad" in snap  # open breakers are never evicted
+
+
+# ----------------------------------------------------------- fault injection
+
+
+def test_fault_plan_rate_is_seed_deterministic():
+    def fire_pattern(seed):
+        plan = FaultPlan(seed=seed)
+        plan.add("site.a", error=InjectedCompileError, rate=0.3)
+        hits = []
+        for _ in range(50):
+            try:
+                plan.hit("site.a")
+                hits.append(False)
+            except InjectedCompileError:
+                hits.append(True)
+        return hits
+
+    a1, a2, b = fire_pattern(7), fire_pattern(7), fire_pattern(8)
+    assert a1 == a2  # same seed → identical pattern
+    assert a1 != b  # different seed → different pattern
+    assert 1 <= sum(a1) <= 30  # rate is roughly honored
+
+
+def test_fault_plan_at_calls_and_max_fires():
+    plan = FaultPlan(seed=0)
+    plan.add("s", error=InjectedWindowCrash, at_calls=[2, 4], max_fires=1)
+    fired = []
+    for i in range(1, 6):
+        try:
+            plan.hit("s")
+        except InjectedWindowCrash:
+            fired.append(i)
+    assert fired == [2]  # exact ordinal, bounded by max_fires
+    assert plan.snapshot()["s"] == {"calls": 5, "fires": 1}
+
+
+def test_fault_plan_latency_uses_injected_sleep():
+    slept = []
+    plan = FaultPlan(seed=0, sleep=slept.append)
+    plan.add("slow", latency_s=0.25, rate=1.0)
+    plan.hit("slow")
+    assert slept == [0.25]
+
+
+def test_fault_point_global_install():
+    plan = FaultPlan(seed=0)
+    plan.add("x", error=InjectedCompileError, rate=1.0)
+    fault_point("x")  # nothing installed → no-op
+    with plan.installed():
+        with pytest.raises(InjectedCompileError):
+            fault_point("x")
+        fault_point("unarmed.site")  # armed plan, different site → no-op
+    fault_point("x")  # uninstalled again
+
+
+# ------------------------------------------------------------ error taxonomy
+
+
+def test_error_response_mappings():
+    status, payload = error_response(DeadlineExceeded(site="d.e"), "ctx")
+    assert status == 504
+    assert payload["code"] == "deadline_exceeded"
+    assert payload["site"] == "d.e"
+    assert payload["context"] == "ctx"
+
+    status, payload = error_response(Overloaded(retry_after_s=2.5))
+    assert status == 429 and payload["retry_after_s"] == 2.5
+
+    status, payload = error_response(ValueError("bad input"))
+    assert status == 400 and payload["error"] == "bad input"
+
+    status, payload = error_response(RuntimeError("boom"))
+    assert status == 500 and payload["code"] == "internal"
+
+
+def test_error_response_never_swallows_base_exceptions():
+    with pytest.raises(KeyboardInterrupt):
+        error_response(KeyboardInterrupt())
+    with pytest.raises(SystemExit):
+        error_response(SystemExit(0))
+
+
+def test_is_device_fault_classification():
+    from kolibrie_tpu.optimizer.device_engine import Unsupported
+
+    assert is_device_fault(DeviceFault("x"))
+    assert is_device_fault(InjectedCompileError("x"))
+    assert is_device_fault(MemoryError())
+    XlaRuntimeError = type("XlaRuntimeError", (Exception,), {})
+    assert is_device_fault(XlaRuntimeError("k"))
+    assert is_device_fault(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+    # NOT faults: permanent template properties and plain bad queries
+    assert not is_device_fault(Unsupported("shape"))
+    assert not is_device_fault(ValueError("parse"))
+
+
+# --------------------------------------------------------- admission control
+
+
+def test_admission_cap_sheds_with_429():
+    adm = AdmissionController(max_inflight=2, retry_after_s=0.5)
+    adm.try_acquire()
+    adm.try_acquire()
+    with pytest.raises(Overloaded) as ei:
+        adm.try_acquire()
+    assert ei.value.retry_after_s == 0.5
+    adm.release()
+    with adm.admitted_scope():
+        assert adm.inflight == 2
+    snap = adm.snapshot()
+    assert snap["shed"] == 1 and snap["admitted"] == 3
+    assert snap["peak_inflight"] == 2 and snap["inflight"] == 1
+
+
+# --------------------------------------------------------- window supervision
+
+
+def test_supervisor_retries_then_dead_letters_poison():
+    cfg = SupervisionConfig(max_event_retries=1, sleep=lambda s: None)
+    sup = WindowSupervisor("w1", config=cfg)
+    calls = []
+
+    def processor(content):
+        calls.append(content)
+        if content == "poison":
+            raise ValueError("bad event")
+
+    sup.process(processor, "ok1")
+    sup.process(processor, "poison")
+    sup.process(processor, "ok2")  # the stream continues past the poison
+    assert calls == ["ok1", "poison", "poison", "ok2"]  # one retry
+    assert sup.retried == 1
+    assert len(sup.dead_letters) == 1
+    assert sup.dead_letters[0].window_iri == "w1"
+    assert "bad event" in sup.dead_letters[0].error
+    assert not sup.dead
+
+
+def test_supervisor_checkpoint_cadence():
+    blobs = []
+    cfg = SupervisionConfig(checkpoint_every=2, sleep=lambda s: None)
+    sup = WindowSupervisor(
+        "w", config=cfg, checkpoint_fn=lambda: blobs.append(1) or b"snap"
+    )
+    for i in range(5):
+        sup.process(lambda c: None, i)
+    assert len(blobs) == 2  # after firings 2 and 4
+    assert sup.last_checkpoint == b"snap"
+
+
+def test_supervised_thread_restarts_after_injected_crash():
+    sleeps = []
+    cfg = SupervisionConfig(
+        max_restarts=2, backoff_base_s=0.05, sleep=sleeps.append
+    )
+    restored = []
+    sup = WindowSupervisor("w", config=cfg, restore_fn=restored.append)
+    sup.last_checkpoint = b"ckpt"
+    seen = []
+    recv = queue.Queue()
+    plan = FaultPlan(seed=0)
+    plan.add("rsp.window", error=InjectedWindowCrash, at_calls=[2])
+    with plan.installed():
+        t = sup.spawn(recv, seen.append)
+        for ev in ("a", "b", "c"):
+            recv.put(ev)
+        recv.put(None)
+        t.join(timeout=5)
+    assert not t.is_alive()
+    assert seen == ["a", "c"]  # b crashed; loop restarted and continued
+    assert sup.restarts == 1 and not sup.dead
+    assert sleeps == [0.05]  # exponential backoff, first step
+    assert restored == [b"ckpt"]  # restart restored from the checkpoint
+
+
+def test_supervised_thread_dies_after_restart_budget():
+    cfg = SupervisionConfig(max_restarts=0, sleep=lambda s: None)
+    sup = WindowSupervisor("w", config=cfg)
+    recv = queue.Queue()
+    plan = FaultPlan(seed=0)
+    plan.add("rsp.window", error=InjectedWindowCrash, rate=1.0)
+    with plan.installed():
+        t = sup.spawn(recv, lambda c: None)
+        recv.put("a")
+        t.join(timeout=5)
+    assert not t.is_alive()
+    assert sup.dead
+    assert len(sup.dead_letters) == 1
+
+
+# ------------------------------------------------------------ SSE bookkeeping
+
+
+def test_engine_session_prunes_stalled_subscribers(monkeypatch):
+    import kolibrie_tpu.frontends.http_server as hs
+
+    monkeypatch.setattr(hs, "SSE_SUBSCRIBER_QUEUE_MAX", 2)
+    session = hs.EngineSession(engine=None, streams=[])
+    stalled, _ = session.subscribe_with_backlog()
+    live, _ = session.subscribe_with_backlog()
+    row = (("s", "http://e/a"), ("o", "1"))
+    for _ in range(3):
+        session.emit(row)
+        live.get_nowait()  # the live client drains; the stalled one never
+    assert stalled not in session.subscribers  # pruned when its queue filled
+    assert live in session.subscribers
+    assert session.dropped_subscribers == 1
+    session.unsubscribe(live)
+    assert session.subscribers == []
+
+
+# ----------------------------------------------------- executor integration
+
+
+def _tiny_device_db(n=30):
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    db = SparqlDatabase()
+    db.parse_ntriples(
+        "\n".join(
+            f'<http://e/x{i}> <http://e/dept> "dept{i % 3}" .' for i in range(n)
+        )
+    )
+    db.execution_mode = "device"
+    return db
+
+
+QUERY_DEPT = 'PREFIX ex: <http://e/> SELECT ?e WHERE { ?e ex:dept "dept1" }'
+
+
+def test_executor_degrades_on_injected_compile_fault():
+    from kolibrie_tpu.query.executor import execute_query_volcano
+    from kolibrie_tpu.resilience.breaker import breaker_board
+
+    db = _tiny_device_db()
+    plan = FaultPlan(seed=0)
+    plan.add("device.lower", error=InjectedCompileError, rate=1.0)
+    with plan.installed():
+        rows = execute_query_volcano(QUERY_DEPT, db)
+    assert len(rows) == 10  # served degraded, not erred
+    snap = breaker_board(db).snapshot()
+    assert sum(b["failures"] + b["trips"] for b in snap.values()) >= 1
+
+
+def test_executor_breaker_trips_then_skips_device():
+    from kolibrie_tpu.query.executor import execute_query_volcano
+    from kolibrie_tpu.resilience.breaker import breaker_board
+
+    db = _tiny_device_db()
+    board = breaker_board(db)
+    plan = FaultPlan(seed=0)
+    plan.add("device.lower", error=InjectedCompileError, rate=1.0)
+    with plan.installed():
+        for _ in range(4):
+            assert len(execute_query_volcano(QUERY_DEPT, db)) == 10
+        lower_calls = plan.snapshot()["device.lower"]["calls"]
+        # breaker is open: further queries skip the device entirely
+        assert len(execute_query_volcano(QUERY_DEPT, db)) == 10
+        assert plan.snapshot()["device.lower"]["calls"] == lower_calls
+    (fp,) = board.snapshot().keys()
+    assert board.get(fp).state == "open"
+    assert board.get(fp).degraded_served >= 1
+
+
+def test_executor_sheds_on_expired_deadline():
+    from kolibrie_tpu.query.executor import execute_query_volcano
+
+    db = _tiny_device_db()
+    clk = FakeClock()
+    dl = Deadline(0.1, clock=clk)
+    clk.advance(0.2)
+    with deadline_scope(dl):
+        with pytest.raises(DeadlineExceeded):
+            execute_query_volcano(QUERY_DEPT, db)
