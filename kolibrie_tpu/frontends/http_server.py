@@ -27,6 +27,14 @@ from kolibrie_tpu.frontends.rules import (
     apply_sparql_rules,
     strip_hash_comments,
 )
+from kolibrie_tpu.obs import export as obs_export
+from kolibrie_tpu.obs import metrics as obs_metrics
+from kolibrie_tpu.obs.spans import (
+    current_trace_id,
+    export_jsonl,
+    span,
+    trace_scope,
+)
 from kolibrie_tpu.resilience.admission import AdmissionController
 from kolibrie_tpu.resilience.deadline import (
     Deadline,
@@ -55,6 +63,45 @@ MAX_INFLIGHT = int(os.environ.get("KOLIBRIE_MAX_INFLIGHT", "64"))
 MAX_QUEUE_DEPTH = int(os.environ.get("KOLIBRIE_MAX_QUEUE_DEPTH", "256"))
 SSE_SUBSCRIBER_QUEUE_MAX = int(
     os.environ.get("KOLIBRIE_SSE_QUEUE_MAX", "1024")
+)
+
+# ------------------------------------------------------- serving metrics
+# (docs/OBSERVABILITY.md has the full catalog)
+
+_HTTP_REQS = obs_metrics.counter(
+    "kolibrie_http_requests_total",
+    "HTTP responses by route and status code",
+    labels=("route", "code"),
+)
+_HTTP_LAT = obs_metrics.histogram(
+    "kolibrie_http_request_seconds",
+    "request wall time by route",
+    labels=("route",),
+)
+_BATCH_REQS = obs_metrics.counter(
+    "kolibrie_batcher_requests_total", "queries submitted to a batcher"
+)
+_BATCH_DISPATCHES = obs_metrics.counter(
+    "kolibrie_batcher_dispatches_total", "batch dispatches drained"
+)
+_BATCH_DEDUP = obs_metrics.counter(
+    "kolibrie_batcher_dedup_hits_total",
+    "in-flight identical-text queries answered by one execution",
+)
+_BATCH_SHED = obs_metrics.counter(
+    "kolibrie_batcher_shed_total",
+    "requests shed by the batcher",
+    labels=("reason",),
+)
+_BATCH_SIZE = obs_metrics.histogram(
+    "kolibrie_batcher_batch_size",
+    "requests riding one dispatch",
+    buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_BATCH_DISPATCH_LAT = obs_metrics.histogram(
+    "kolibrie_batcher_dispatch_seconds",
+    "batch dispatch wall time by template fingerprint",
+    labels=("template",),
 )
 
 _PLAYGROUND_PATH = os.path.join(
@@ -181,17 +228,15 @@ class EngineSession:
         return True
 
 
-def _pct(samples: List[float], q: float) -> float:
-    if not samples:
-        return 0.0
-    s = sorted(samples)
-    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
-
-
 class _BatchRequest:
-    __slots__ = ("text", "done", "result", "error", "deadline")
+    __slots__ = ("text", "done", "result", "error", "deadline", "trace_id")
 
-    def __init__(self, text: str, deadline: Optional[Deadline] = None):
+    def __init__(
+        self,
+        text: str,
+        deadline: Optional[Deadline] = None,
+        trace_id: Optional[str] = None,
+    ):
         self.text = text
         self.done = threading.Event()
         self.result = None
@@ -199,6 +244,7 @@ class _BatchRequest:
         # captured at submit time: the leader dispatches on ANOTHER
         # thread, where the submitter's thread-local scope is invisible
         self.deadline = deadline
+        self.trace_id = trace_id
 
 
 class TemplateBatcher:
@@ -239,18 +285,26 @@ class TemplateBatcher:
 
     def submit(self, text: str):
         check_deadline("batcher.submit")
-        req = _BatchRequest(text, deadline=current_deadline())
+        req = _BatchRequest(
+            text, deadline=current_deadline(), trace_id=current_trace_id()
+        )
+        with span("batcher.submit"):
+            return self._submit(req)
+
+    def _submit(self, req: _BatchRequest):
         with self.lock:
             if len(self.pending) >= self.max_queue_depth:
                 # queue depth is the best single predictor of blowing the
                 # deadline anyway: shed at the door, structured 429
                 self.shed_queue_full += 1
+                _BATCH_SHED.labels("queue_full").inc()
                 raise Overloaded(
                     f"store queue full ({len(self.pending)} pending)",
                     retry_after_s=max(self.window * 4, 0.05),
                 )
             self.pending.append(req)
             self.requests += 1
+        _BATCH_REQS.inc()
         # collect followers for one window, then elect a dispatcher; loop
         # covers the race where a drain happened between append and wait
         while not req.done.wait(timeout=self.window):
@@ -261,6 +315,7 @@ class TemplateBatcher:
                     if req in self.pending:
                         self.pending.remove(req)
                     self.shed_deadline += 1
+                _BATCH_SHED.labels("deadline").inc()
                 raise DeadlineExceeded(
                     "deadline exceeded at batcher.queue", site="batcher.queue"
                 )
@@ -300,23 +355,30 @@ class TemplateBatcher:
         texts = [r.text for r in batch]
         uniq = list(dict.fromkeys(texts))
         start = time.perf_counter()
-        try:
-            with deadline_scope(self._batch_deadline(batch)):
-                by_text = dict(
-                    zip(uniq, execute_queries_batched(self.db, uniq))
-                )
-        except Exception:
-            # one bad member must not fail its batch-mates: solo retries,
-            # each under its OWN deadline (None masks the leader's scope)
-            for r in batch:
-                try:
-                    with deadline_scope(r.deadline):
-                        r.result = execute_query_volcano(r.text, self.db)
-                except Exception as e:
-                    r.error = e
-                r.done.set()
-            self._count(batch, texts, uniq, time.perf_counter() - start)
-            return
+        # the dispatch span lands in the LEADER's trace (followers' spans
+        # would need span links, which this tracer doesn't model); solo
+        # retries below re-enter each member's own captured trace
+        with span("batcher.dispatch", batch=len(batch), uniq=len(uniq)):
+            try:
+                with deadline_scope(self._batch_deadline(batch)):
+                    by_text = dict(
+                        zip(uniq, execute_queries_batched(self.db, uniq))
+                    )
+            except Exception:
+                # one bad member must not fail its batch-mates: solo
+                # retries, each under its OWN deadline and trace (None
+                # masks the leader's scope)
+                for r in batch:
+                    try:
+                        with trace_scope(r.trace_id), deadline_scope(
+                            r.deadline
+                        ), span("batcher.solo_retry"):
+                            r.result = execute_query_volcano(r.text, self.db)
+                    except Exception as e:
+                        r.error = e
+                    r.done.set()
+                self._count(batch, texts, uniq, time.perf_counter() - start)
+                return
         for r in batch:
             r.result = by_text[r.text]
             r.done.set()
@@ -342,40 +404,18 @@ class TemplateBatcher:
                     rec["dedup_hits"] += texts.count(text) - 1
                 rec["lat"].append(ms)
                 del rec["lat"][:-256]  # bounded latency window
+        _BATCH_DISPATCHES.inc()
+        _BATCH_DEDUP.inc(len(texts) - len(uniq))
+        _BATCH_SIZE.observe(len(batch))
+        for fp in by_fp:
+            _BATCH_DISPATCH_LAT.labels(fp).observe(elapsed)
 
     # ---------------------------------------------------------------- stats
 
     def stats(self) -> dict:
-        from kolibrie_tpu.optimizer.device_engine import device_compile_stats
-        from kolibrie_tpu.query.executor import plan_cache_info
-        from kolibrie_tpu.resilience.breaker import breaker_board
-
-        with self.lock:
-            per = {
-                fp: {
-                    "requests": rec["requests"],
-                    "dedup_hits": rec["dedup_hits"],
-                    "dispatches": len(rec["lat"]),
-                    "dispatch_ms_p50": _pct(rec["lat"], 0.50),
-                    "dispatch_ms_p95": _pct(rec["lat"], 0.95),
-                }
-                for fp, rec in self.templates.items()
-            }
-            out = {
-                "requests": self.requests,
-                "dispatches": self.dispatches,
-                "dedup_hits": self.dedup_hits,
-                "max_batch": self.max_batch,
-                "shed_queue_full": self.shed_queue_full,
-                "shed_deadline": self.shed_deadline,
-                "per_template": per,
-            }
-        with self.dispatch_lock:
-            out["triples"] = len(self.db.store)
-            out["plan_cache"] = plan_cache_info(self.db)
-            out["breakers"] = breaker_board(self.db).snapshot()
-        out["device_compiles"] = device_compile_stats()
-        return out
+        """Single source of truth lives in obs.export (the /stats handler
+        renders through the same function)."""
+        return obs_export.store_stats(self)
 
 
 class _ServerState:
@@ -447,6 +487,8 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     state: _ServerState = None  # set by serve()
     quiet = False
+    _trace_id: Optional[str] = None
+    _route_label: Optional[str] = None
 
     # ------------------------------------------------------------- plumbing
 
@@ -460,9 +502,15 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.send_header("Access-Control-Allow-Origin", "*")
         self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
-        self.send_header("Access-Control-Allow-Headers", "Content-Type")
+        self.send_header(
+            "Access-Control-Allow-Headers", "Content-Type, X-Kolibrie-Trace-Id"
+        )
+        if self._trace_id:
+            self.send_header("X-Kolibrie-Trace-Id", self._trace_id)
         self.end_headers()
         self.wfile.write(body)
+        if self._route_label is not None:
+            _HTTP_REQS.labels(self._route_label, str(code)).inc()
 
     def _send_json(self, payload, code: int = 200) -> None:
         self._send(code, json.dumps(payload).encode(), "application/json")
@@ -512,26 +560,44 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- routes
 
     def do_OPTIONS(self):
+        self._route_label = "OPTIONS"
         self._send(204, b"", "text/plain")
 
     def do_GET(self):
-        if self.path == "/" or self.path == "/playground":
+        path, _, qs = self.path.partition("?")
+        known = ("/", "/playground", "/stats", "/metrics", "/debug/traces")
+        self._route_label = (
+            "/rsp/events"
+            if path.startswith("/rsp/events/")
+            else (path if path in known else "unknown")
+        )
+        if path == "/" or path == "/playground":
             try:
                 with open(_PLAYGROUND_PATH, "rb") as f:
                     self._send(200, f.read(), "text/html; charset=utf-8")
             except OSError:
                 self._send_error_json("playground not available", 404)
             return
-        if self.path.startswith("/rsp/events/"):
-            self._handle_sse(self.path[len("/rsp/events/"):])
+        if path.startswith("/rsp/events/"):
+            # SSE is long-lived: no trace scope, no request span
+            self._handle_sse(path[len("/rsp/events/"):])
             return
-        if self.path == "/stats":
+        routes = {
+            "/stats": lambda: self._handle_stats(),
+            "/metrics": lambda: self._handle_metrics(),
+            "/debug/traces": lambda: self._handle_debug_traces(qs),
+        }
+        with trace_scope(
+            self.headers.get("X-Kolibrie-Trace-Id") or None
+        ) as tid:
+            self._trace_id = tid
             try:
-                self._handle_stats()
+                handler = routes.get(path)
+                if handler is None:
+                    raise NotFound("not found")
+                handler()
             except Exception as e:
                 self._send_failure(e)
-            return
-        self._send_error_json("not found", 404)
 
     _POST_ROUTES = {
         "/query": "_handle_query",
@@ -543,20 +609,37 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         "/rsp/push": "_handle_rsp_push",
         "/rsp/checkpoint": "_handle_rsp_checkpoint",
         "/rsp/restore": "_handle_rsp_restore",
+        "/debug/profile": "_handle_debug_profile",
     }
 
     def do_POST(self):
-        name = self._POST_ROUTES.get(self.path)
-        if name is None:
-            self._send_error_json("not found", 404)
-            return
-        try:
-            getattr(self, name)()
-        except Exception as e:
-            # single choke point: handlers raise taxonomy errors (or plain
-            # exceptions, conservatively mapped); KeyboardInterrupt and
-            # SystemExit are BaseException and sail straight through
-            self._send_failure(e)
+        path = self.path.partition("?")[0]
+        name = self._POST_ROUTES.get(path)
+        # unknown paths share one label: client typos must not mint
+        # unbounded label values
+        self._route_label = path if name else "unknown"
+        start = time.perf_counter()
+        # the client's trace id (or a fresh one) scopes the whole request;
+        # _send echoes it back via X-Kolibrie-Trace-Id and error payloads
+        # pick it up in errors.py
+        with trace_scope(
+            self.headers.get("X-Kolibrie-Trace-Id") or None
+        ) as tid:
+            self._trace_id = tid
+            with span("http.request", route=path, method="POST"):
+                try:
+                    if name is None:
+                        raise NotFound("not found")
+                    getattr(self, name)()
+                except Exception as e:
+                    # single choke point: handlers raise taxonomy errors
+                    # (or plain exceptions, conservatively mapped);
+                    # KeyboardInterrupt and SystemExit are BaseException
+                    # and sail straight through
+                    self._send_failure(e)
+        _HTTP_LAT.labels(path if name else "unknown").observe(
+            time.perf_counter() - start
+        )
 
     # -------------------------------------------------------------- /explain
 
@@ -721,32 +804,66 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def _handle_stats(self):
         """Serving metrics per store: request/dedup/batch counters, per-
         template dispatch latency percentiles, the two-level plan-cache
-        snapshot, and jit compile counts."""
-        state = self.state
-        with state.lock:
-            stores = dict(state.stores)
-            sessions = dict(state.sessions)
-        per_session = {}
-        for sid, s in sessions.items():
-            with s.lock:
-                info = {
-                    "subscribers": len(s.subscribers),
-                    "dropped_subscribers": s.dropped_subscribers,
-                    "crash_recoveries": s.crash_recoveries,
+        snapshot, and jit compile counts.  Rendered by obs.export — the
+        same source of truth as TemplateBatcher.stats()."""
+        self._send_json(obs_export.build_stats(self.state))
+
+    def _handle_metrics(self):
+        """Prometheus text exposition of the process-wide registry."""
+        obs_export.refresh_server_gauges(self.state)
+        self._send(
+            200,
+            obs_export.render_prometheus().encode("utf-8"),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def _handle_debug_traces(self, qs: str):
+        """The span ring as JSONL; ``?trace_id=`` filters to one trace."""
+        from urllib.parse import parse_qs
+
+        trace_id = (parse_qs(qs).get("trace_id") or [None])[0]
+        body = export_jsonl(trace_id)
+        self._send(200, body.encode("utf-8"), "application/x-ndjson")
+
+    def _handle_debug_profile(self):
+        """``POST /debug/profile?seconds=N``: capture a jax.profiler trace
+        for N wall seconds.  No-ops (``profiled: false``) on CPU backends
+        so CI never pays for — or breaks on — the profiler."""
+        from urllib.parse import parse_qs
+
+        import jax
+
+        qs = parse_qs(self.path.partition("?")[2])
+        try:
+            seconds = float((qs.get("seconds") or ["1"])[0])
+        except ValueError:
+            raise BadRequest("invalid seconds")
+        if not 0 < seconds <= 30:
+            raise BadRequest("seconds must be in (0, 30]")
+        backend = jax.default_backend()
+        if backend not in ("tpu", "gpu"):
+            self._send_json(
+                {
+                    "profiled": False,
+                    "backend": backend,
+                    "reason": "profiler capture is gated to accelerator "
+                    "backends (CPU CI no-op)",
                 }
-            rstats = getattr(s.engine, "resilience_stats", None)
-            if rstats is not None:
-                info["windows"] = rstats()
-            per_session[sid] = info
+            )
+            return
+        import tempfile
+
+        out_dir = os.environ.get("KOLIBRIE_PROFILE_DIR") or tempfile.mkdtemp(
+            prefix="kolibrie-profile-"
+        )
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
         self._send_json(
-            {
-                "stores": {sid: b.stats() for sid, b in stores.items()},
-                "rsp_sessions": len(sessions),
-                "resilience": {
-                    "admission": state.admission.snapshot(),
-                    "sessions": per_session,
-                },
-            }
+            {"profiled": True, "backend": backend, "trace_dir": out_dir,
+             "seconds": seconds}
         )
 
     # ------------------------------------------------------------ /rsp-query

@@ -1,0 +1,11 @@
+"""kolibrie_tpu.obs — spans, metrics, and exposition.
+
+Import discipline: :mod:`runtime`, :mod:`spans` and :mod:`metrics` are
+stdlib-only and import nothing from the engine, so any layer (resilience
+included) may instrument itself without cycles.  :mod:`export` imports
+the engine (compile stats, plan cache, breakers) and is therefore NOT
+imported here — only the HTTP frontend and tests pull it in.
+"""
+
+from kolibrie_tpu.obs.runtime import enabled, set_enabled  # noqa: F401
+from kolibrie_tpu.obs import metrics, spans  # noqa: F401

@@ -1,0 +1,208 @@
+"""Exposition: Prometheus text format for ``GET /metrics`` and the
+single source of truth behind ``GET /stats``.
+
+Before this module existed the server had two stats code paths —
+``TemplateBatcher.stats()`` poked the compile cache, plan cache and
+breaker board with function-level imports on every poll, and
+``_handle_stats`` assembled a second dict around it.  Both now render
+here: :func:`store_stats` builds one store's block, :func:`build_stats`
+the whole ``/stats`` payload, and the heavyweight imports run once at
+module import instead of per scrape.
+
+The JSON shapes are load-bearing (tests/test_plan_template.py and
+tests/test_chaos.py assert on keys), so :func:`store_stats` preserves
+them exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from kolibrie_tpu.obs import metrics
+from kolibrie_tpu.obs.metrics import Registry
+
+# Satellite: module-scope imports — previously re-imported inside
+# TemplateBatcher.stats() on every /stats poll.
+from kolibrie_tpu.optimizer.device_engine import device_compile_stats
+from kolibrie_tpu.query.executor import plan_cache_info
+from kolibrie_tpu.resilience.breaker import breaker_board
+
+# ------------------------------------------------------------ prometheus
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _labels_str(names, values, extra=()) -> str:
+    pairs = [
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    ] + [f'{n}="{_escape_label(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render_prometheus(registry: Registry = metrics.REGISTRY) -> str:
+    """The registry in Prometheus text exposition format v0.0.4.
+    Runs registered collectors first so pull-style gauges are fresh."""
+    registry.run_collectors()
+    lines: List[str] = []
+    for fam in registry.families():
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for values, child in fam.children():
+            if fam.kind in ("counter", "gauge"):
+                lines.append(
+                    f"{fam.name}{_labels_str(fam.label_names, values)} "
+                    f"{_fmt_value(child.value)}"
+                )
+            else:  # histogram
+                for le, acc in child.cumulative():
+                    ls = _labels_str(
+                        fam.label_names, values, extra=[("le", _fmt_value(le))]
+                    )
+                    lines.append(f"{fam.name}_bucket{ls} {acc}")
+                base = _labels_str(fam.label_names, values)
+                with child._lock:
+                    s, c = child.sum, child.count
+                lines.append(f"{fam.name}_sum{base} {_fmt_value(s)}")
+                lines.append(f"{fam.name}_count{base} {c}")
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------- /stats
+
+
+def _pct(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def store_stats(batcher) -> dict:
+    """One store's ``/stats`` block (formerly ``TemplateBatcher.stats``).
+    Key set is asserted by tests — extend, don't rename."""
+    with batcher.lock:
+        per = {
+            fp: {
+                "requests": rec["requests"],
+                "dedup_hits": rec["dedup_hits"],
+                "dispatches": len(rec["lat"]),
+                "dispatch_ms_p50": _pct(rec["lat"], 0.50),
+                "dispatch_ms_p95": _pct(rec["lat"], 0.95),
+            }
+            for fp, rec in batcher.templates.items()
+        }
+        out = {
+            "requests": batcher.requests,
+            "dispatches": batcher.dispatches,
+            "dedup_hits": batcher.dedup_hits,
+            "max_batch": batcher.max_batch,
+            "shed_queue_full": batcher.shed_queue_full,
+            "shed_deadline": batcher.shed_deadline,
+            "queue_depth": len(batcher.pending),
+            "per_template": per,
+        }
+    with batcher.dispatch_lock:
+        out["triples"] = len(batcher.db.store)
+        out["plan_cache"] = plan_cache_info(batcher.db)
+        out["breakers"] = breaker_board(batcher.db).snapshot()
+    out["device_compiles"] = device_compile_stats()
+    return out
+
+
+def build_stats(state) -> dict:
+    """The whole ``GET /stats`` payload (formerly inline in
+    ``_handle_stats``): per-store blocks plus RSP session and resilience
+    counters.  ``state`` is the server's ``_ServerState``."""
+    with state.lock:
+        stores = dict(state.stores)
+        sessions = dict(state.sessions)
+    per_session = {}
+    for sid, s in sessions.items():
+        with s.lock:
+            info = {
+                "subscribers": len(s.subscribers),
+                "dropped_subscribers": s.dropped_subscribers,
+                "crash_recoveries": s.crash_recoveries,
+            }
+        rstats = getattr(s.engine, "resilience_stats", None)
+        if rstats is not None:
+            info["windows"] = rstats()
+        per_session[sid] = info
+    return {
+        "stores": {sid: store_stats(b) for sid, b in stores.items()},
+        "rsp_sessions": len(sessions),
+        "resilience": {
+            "admission": state.admission.snapshot(),
+            "sessions": per_session,
+        },
+    }
+
+
+# ------------------------------------------------- scrape-time collectors
+
+_compile_cache_gauge = metrics.gauge(
+    "kolibrie_device_compile_cache_entries",
+    "jit cache sizes per device entry point (a recompile adds an entry)",
+    labels=("entry",),
+)
+
+
+def _collect_compile_cache() -> None:
+    for name, size in device_compile_stats().items():
+        _compile_cache_gauge.labels(name).set(size)
+
+
+metrics.register_collector(_collect_compile_cache)
+
+_queue_depth_gauge = metrics.gauge(
+    "kolibrie_batcher_queue_depth",
+    "requests pending in a store's batching window",
+    labels=("store",),
+)
+_rsp_sessions_gauge = metrics.gauge(
+    "kolibrie_rsp_sessions", "live RSP sessions"
+)
+_plan_cache_gauges = {
+    "parse_entries": metrics.gauge(
+        "kolibrie_plan_cache_parse_entries",
+        "parse-level plan cache occupancy", labels=("store",),
+    ),
+    "templates": metrics.gauge(
+        "kolibrie_plan_cache_templates",
+        "template-level plan cache occupancy", labels=("store",),
+    ),
+}
+
+
+def refresh_server_gauges(state) -> None:
+    """Pull server-held state into gauges — called by the /metrics
+    handler before rendering (the registry's own collectors cannot see
+    the server state object)."""
+    with state.lock:
+        stores = dict(state.stores)
+        n_sessions = len(state.sessions)
+    _rsp_sessions_gauge.set(n_sessions)
+    for sid, b in stores.items():
+        with b.lock:
+            _queue_depth_gauge.labels(sid).set(len(b.pending))
+        info = plan_cache_info(b.db)
+        for key, g in _plan_cache_gauges.items():
+            g.labels(sid).set(info[key])

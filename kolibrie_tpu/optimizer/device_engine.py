@@ -75,9 +75,38 @@ __all__ = [
     "template_scan_cap",
 ]
 
+import time as _time
+
+from kolibrie_tpu.obs import metrics as _obs_metrics
+from kolibrie_tpu.obs.spans import get_baggage as _get_baggage
+from kolibrie_tpu.obs.spans import span as _obs_span
 from kolibrie_tpu.ops import round_cap as _round_cap
 from kolibrie_tpu.resilience.deadline import check_deadline
 from kolibrie_tpu.resilience.faultinject import fault_point
+
+# Per-template device phase timings.  The template label is the plan
+# template fingerprint carried in trace baggage by the executor —
+# bounded upstream by the template cache, so cardinality is safe.
+_LOWER_LAT = _obs_metrics.histogram(
+    "kolibrie_device_lower_seconds",
+    "plan lowering (trace + spec assembly) time by template",
+    labels=("template",),
+)
+_DISPATCH_LAT = _obs_metrics.histogram(
+    "kolibrie_device_dispatch_seconds",
+    "device dispatch + convergence time by template (first observation "
+    "per shape includes the XLA compile)",
+    labels=("template",),
+)
+_COLLECT_LAT = _obs_metrics.histogram(
+    "kolibrie_device_collect_seconds",
+    "device→host result materialization time",
+)
+_DEVICE_BATCH_SIZE = _obs_metrics.histogram(
+    "kolibrie_device_batch_size",
+    "members per stacked-parameter batch dispatch",
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
 
 
 class Unsupported(Exception):
@@ -1870,7 +1899,15 @@ class LoweredPlan:
         fault_point("device.execute")
         if not self.const_ok():
             return self.empty_table()
-        table = self.to_table(*self.converge(self.run()))
+        tpl = _get_baggage("template", "unknown")
+        t0 = _time.perf_counter()
+        with _obs_span("device.dispatch", template=tpl):
+            parts = self.converge(self.run())
+        _DISPATCH_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+        t1 = _time.perf_counter()
+        with _obs_span("device.collect"):
+            table = self.to_table(*parts)
+        _COLLECT_LAT.observe(_time.perf_counter() - t1)
         check_deadline("device.execute.done")
         return table
 
@@ -1970,10 +2007,32 @@ def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> L
     # the request before lowering work starts
     check_deadline("device.lower")
     fault_point("device.lower")
-    return LoweredPlan(db, plan, anti_plans, union_groups, optional_plans)
+    tpl = _get_baggage("template", "unknown")
+    t0 = _time.perf_counter()
+    with _obs_span("device.lower", template=tpl):
+        lowered = LoweredPlan(db, plan, anti_plans, union_groups, optional_plans)
+    _LOWER_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+    return lowered
 
 
 def execute_plan_batch(
+    lowereds: List[LoweredPlan], max_attempts: int = 12
+) -> List[BindingTable]:
+    """Instrumented wrapper over :func:`_execute_plan_batch`: one
+    ``device.dispatch`` span + per-template timing for the whole stacked
+    dispatch."""
+    if not lowereds:
+        return []
+    tpl = _get_baggage("template", "unknown")
+    _DEVICE_BATCH_SIZE.observe(len(lowereds))
+    t0 = _time.perf_counter()
+    with _obs_span("device.dispatch", template=tpl, batch=len(lowereds)):
+        out = _execute_plan_batch(lowereds, max_attempts)
+    _DISPATCH_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+    return out
+
+
+def _execute_plan_batch(
     lowereds: List[LoweredPlan], max_attempts: int = 12
 ) -> List[BindingTable]:
     """Run MANY constant-variants of ONE plan template as a single

@@ -12,6 +12,7 @@ pattern, SURVEY §4).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,12 +44,44 @@ from kolibrie_tpu.query.ast import (
     Var,
     WhereClause,
 )
+from kolibrie_tpu.obs import metrics as obs_metrics
+from kolibrie_tpu.obs.spans import set_baggage, span
 from kolibrie_tpu.query.parser import parse_combined_query
 from kolibrie_tpu.resilience.breaker import breaker_board
 from kolibrie_tpu.resilience.deadline import check_deadline
 from kolibrie_tpu.resilience.errors import DeadlineExceeded, is_device_fault
 
 Rows = List[List[str]]
+
+_PARSE_LAT = obs_metrics.histogram(
+    "kolibrie_query_parse_seconds", "SPARQL parse + template fingerprint time"
+)
+_PLAN_LAT = obs_metrics.histogram(
+    "kolibrie_query_plan_seconds",
+    "Streamertail planning time (plan-cache misses only)",
+)
+_QUERY_LAT = obs_metrics.histogram(
+    "kolibrie_query_seconds",
+    "end-to-end executor time by path (device/host/degraded)",
+    labels=("path",),
+)
+_PLAN_CACHE_EVENTS = obs_metrics.counter(
+    "kolibrie_plan_cache_events_total",
+    "plan cache events (hit/miss/param_rebind/eviction)",
+    labels=("event",),
+)
+_BATCHED_QUERIES = obs_metrics.counter(
+    "kolibrie_query_batched_total",
+    "queries served by a stacked-parameter batch dispatch",
+)
+# fixed-label children hoisted out of the per-query hot path
+_QUERY_LAT_DEVICE = _QUERY_LAT.labels("device")
+_QUERY_LAT_HOST = _QUERY_LAT.labels("host")
+_QUERY_LAT_DEGRADED = _QUERY_LAT.labels("degraded")
+_PLAN_CACHE_HIT = _PLAN_CACHE_EVENTS.labels("hit")
+_PLAN_CACHE_MISS = _PLAN_CACHE_EVENTS.labels("miss")
+_PLAN_CACHE_REBIND = _PLAN_CACHE_EVENTS.labels("param_rebind")
+_PLAN_CACHE_EVICTION = _PLAN_CACHE_EVENTS.labels("eviction")
 
 # "auto" execution mode switches to the device engine at this store size;
 # db.execution_mode = "device" / "host" forces either path.
@@ -111,7 +144,10 @@ def eval_where(
             plan = prebuilt_plan
         else:
             logical = build_logical_plan(resolved, plan_filters, [], where.values)
-            plan = planner.find_best_plan(logical)
+            with span("query.plan"):
+                t0 = time.perf_counter()
+                plan = planner.find_best_plan(logical)
+                _PLAN_LAT.observe(time.perf_counter() - t0)
         if capture is not None:
             capture["plan"] = plan
         table = None
@@ -935,8 +971,11 @@ def _plan_cache_entry(db, sparql: str):
     while len(parse) > _PLAN_CACHE_MAX:
         parse.popitem(last=False)
     if ent["cq"] is None:
-        ent["cq"] = parse_combined_query(sparql, db.prefixes)
-        ent["fp"], ent["params"] = fingerprint_query(ent["cq"])
+        with span("query.parse"):
+            t0 = time.perf_counter()
+            ent["cq"] = parse_combined_query(sparql, db.prefixes)
+            ent["fp"], ent["params"] = fingerprint_query(ent["cq"])
+            _PARSE_LAT.observe(time.perf_counter() - t0)
     fp, params = ent["fp"], ent["params"]
     tent = templates.get(fp)
     if tent is None:
@@ -946,6 +985,7 @@ def _plan_cache_entry(db, sparql: str):
     while len(templates) > _TEMPLATE_CACHE_MAX:
         templates.popitem(last=False)
         stats["evictions"] += 1
+        _PLAN_CACHE_EVICTION.inc()
     version = db.store.version
     state = (
         version,
@@ -972,6 +1012,7 @@ def _plan_cache_entry(db, sparql: str):
             tent["by_state"].pop(next(iter(tent["by_state"])))
         stats["misses"] += 1
         tent["misses"] += 1
+        _PLAN_CACHE_MISS.inc()
     elif slot["params"] != params:
         # same template, new constants: the cached plan/lowered program
         # embed the OLD parameter binding, so they cannot replay — drop
@@ -986,9 +1027,11 @@ def _plan_cache_entry(db, sparql: str):
         slot["params"] = params
         stats["param_rebinds"] += 1
         tent["misses"] += 1
+        _PLAN_CACHE_REBIND.inc()
     else:
         stats["hits"] += 1
         tent["hits"] += 1
+        _PLAN_CACHE_HIT.inc()
     return ent, slot
 
 
@@ -1041,9 +1084,13 @@ def _execute_degraded(db, sparql: str) -> Rows:
     check_deadline("executor.degraded")
     prev = db.execution_mode
     db.execution_mode = "host"
+    t0 = time.perf_counter()
     try:
-        ent, slot = _plan_cache_entry(db, sparql)
-        return execute_combined(db, ent["cq"], cache_entry=slot)
+        with span("query.degraded"):
+            ent, slot = _plan_cache_entry(db, sparql)
+            rows = execute_combined(db, ent["cq"], cache_entry=slot)
+        _QUERY_LAT_DEGRADED.observe(time.perf_counter() - t0)
+        return rows
     finally:
         db.execution_mode = prev
 
@@ -1061,14 +1108,23 @@ def execute_query_volcano(sparql: str, db) -> Rows:
     check_deadline("executor.enter")
     db.register_prefixes_from_query(sparql)
     ent, slot = _plan_cache_entry(db, sparql)
-    if not _device_routed(db):
-        return execute_combined(db, ent["cq"], cache_entry=slot)
     fp = ent["fp"]
+    # baggage lets device_engine label its lower/dispatch timings with
+    # the template fingerprint without threading it through eval_where
+    set_baggage("template", fp)
+    if not _device_routed(db):
+        t0 = time.perf_counter()
+        with span("query.execute", template=fp, path="host"):
+            rows = execute_combined(db, ent["cq"], cache_entry=slot)
+        _QUERY_LAT_HOST.observe(time.perf_counter() - t0)
+        return rows
     board = breaker_board(db)
     if not board.allow(fp):
         return _execute_degraded(db, sparql)
+    t0 = time.perf_counter()
     try:
-        rows = execute_combined(db, ent["cq"], cache_entry=slot)
+        with span("query.execute", template=fp, path="device"):
+            rows = execute_combined(db, ent["cq"], cache_entry=slot)
     except DeadlineExceeded:
         # still shed (the client's budget is gone either way), but a
         # template that repeatedly blows deadlines on the device trips
@@ -1081,6 +1137,7 @@ def execute_query_volcano(sparql: str, db) -> Rows:
         board.record_failure(fp)
         return _execute_degraded(db, sparql)
     board.record_success(fp)
+    _QUERY_LAT_DEVICE.observe(time.perf_counter() - t0)
     return rows
 
 
@@ -1178,6 +1235,7 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             continue  # solo dispatch is already optimal for singletons
         if not board.allow(fp):
             continue  # breaker open: members fall to the solo degraded path
+        set_baggage("template", fp)
         lowereds, ok = [], True
         for i in idxs:
             ent, slot, q, w = members[i]
@@ -1219,6 +1277,7 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
         board.record_success(fp)
         stats["batched"] += len(idxs)
         stats["batch_groups"] += 1
+        _BATCHED_QUERIES.inc(len(idxs))
         for (i, q, plan, lowered), table in zip(lowereds, tables):
             ent, slot, _, _ = members[i]
             if slot["params"] == ent["params"] and slot["lowered"] is None:

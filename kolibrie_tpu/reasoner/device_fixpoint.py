@@ -50,7 +50,26 @@ class Unsupported(Exception):
     """Rule set the device fixpoint cannot express (host fallback)."""
 
 
+from kolibrie_tpu.obs import metrics as _obs_metrics
+from kolibrie_tpu.obs import runtime as _obs_runtime
+from kolibrie_tpu.obs.spans import span as _obs_span
 from kolibrie_tpu.ops import round_cap as _round_cap
+
+_FIXPOINT_ROUNDS = _obs_metrics.histogram(
+    "kolibrie_fixpoint_rounds",
+    "semi-naive rounds per fixpoint run (chunked path: productive rounds)",
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_FIXPOINT_DERIVED = _obs_metrics.histogram(
+    "kolibrie_fixpoint_derived_facts",
+    "facts derived per fixpoint run",
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_FIXPOINT_DELTA = _obs_metrics.histogram(
+    "kolibrie_fixpoint_delta_facts",
+    "delta size fed to each chunked fixpoint round",
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -740,6 +759,9 @@ class DeviceFixpoint:
     def __init__(self, reasoner):
         self.reasoner = reasoner
         self.rules, self.bank = lower_rules(reasoner, reasoner.rules)
+        # rounds taken by the most recent successful infer/infer_padded —
+        # previously computed on device and discarded at readback
+        self.last_rounds = 0
 
     def _caps(self, n_facts: int):
         return _Caps(
@@ -842,6 +864,11 @@ class DeviceFixpoint:
                 )
             code = int(code)
             if code == 0:
+                if _obs_runtime.enabled():
+                    # one extra scalar readback, gated: the same sync the
+                    # int(code) above already paid for covers its latency
+                    self.last_rounds = int(rounds)
+                    _FIXPOINT_ROUNDS.observe(self.last_rounds)
                 return ofs, ofp, ofo, int(on), caps
             if code & 8:
                 raise RuntimeError(
@@ -874,20 +901,22 @@ class DeviceFixpoint:
             # every rule was statically dead (unsatisfiable ground guards)
             return 0
         caps = initial_caps if initial_caps is not None else self._caps(n0)
-        ofs, ofp, ofo, n_out, caps = self.infer_padded(
-            jnp.asarray(s),
-            jnp.asarray(p),
-            jnp.asarray(o),
-            jnp.int32(n0),
-            caps,
-            max_attempts,
-        )
+        with _obs_span("reasoner.fixpoint", facts=n0):
+            ofs, ofp, ofo, n_out, caps = self.infer_padded(
+                jnp.asarray(s),
+                jnp.asarray(p),
+                jnp.asarray(o),
+                jnp.int32(n0),
+                caps,
+                max_attempts,
+            )
         self.converged_caps = caps
         if n_out > n0:
             s_h = np.asarray(ofs[:n_out])
             p_h = np.asarray(ofp[:n_out])
             o_h = np.asarray(ofo[:n_out])
             r.facts.add_batch(s_h[n0:], p_h[n0:], o_h[n0:])
+        _FIXPOINT_DERIVED.observe(n_out - n0)
         return n_out - n0
 
 
@@ -973,6 +1002,7 @@ class DeviceFixpoint:
             n_delta = n0
 
             for _round in range(10_000):
+                _FIXPOINT_DELTA.observe(n_delta)
                 # Readback discipline: chunks chain through DEVICE scalars
                 # (n_acc, OR-ed overflow code) and the host syncs ONCE per
                 # round attempt — on the axon tunnel a readback degrades
@@ -1053,6 +1083,8 @@ class DeviceFixpoint:
                     "device fixpoint hit the round limit before convergence"
                 )
 
+            self.last_rounds = _round  # productive rounds (final is empty)
+            _FIXPOINT_ROUNDS.observe(_round)
             self.converged_caps = _Caps(F, D, J)
             # device-resident result; ``writeback=False`` lets callers (and
             # benches) defer the bulk device→host transfer — on the axon

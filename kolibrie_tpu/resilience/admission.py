@@ -21,7 +21,18 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 
+from kolibrie_tpu.obs import metrics as _obs_metrics
 from kolibrie_tpu.resilience.errors import Overloaded
+
+_INFLIGHT = _obs_metrics.gauge(
+    "kolibrie_admission_inflight", "query requests currently admitted"
+)
+_ADMITTED = _obs_metrics.counter(
+    "kolibrie_admission_admitted_total", "requests admitted"
+)
+_SHED = _obs_metrics.counter(
+    "kolibrie_admission_shed_total", "requests shed by the in-flight cap"
+)
 
 
 class AdmissionController:
@@ -39,6 +50,7 @@ class AdmissionController:
         with self._lock:
             if self.inflight >= self.max_inflight:
                 self.shed += 1
+                _SHED.inc()
                 raise Overloaded(
                     f"too many requests in flight ({self.inflight} >= "
                     f"{self.max_inflight})",
@@ -48,10 +60,13 @@ class AdmissionController:
             self.admitted += 1
             if self.inflight > self.peak_inflight:
                 self.peak_inflight = self.inflight
+        _ADMITTED.inc()
+        _INFLIGHT.inc()
 
     def release(self) -> None:
         with self._lock:
             self.inflight -= 1
+        _INFLIGHT.dec()
 
     @contextmanager
     def admitted_scope(self):

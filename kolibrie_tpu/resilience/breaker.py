@@ -27,6 +27,16 @@ import threading
 import time
 from typing import Callable, Dict
 
+from kolibrie_tpu.obs import metrics as _obs_metrics
+
+_TRIPS = _obs_metrics.counter(
+    "kolibrie_breaker_trips_total", "circuit breaker open transitions"
+)
+_DEGRADED = _obs_metrics.counter(
+    "kolibrie_breaker_degraded_total",
+    "requests routed to the host path by an open breaker",
+)
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -78,6 +88,7 @@ class CircuitBreaker:
                 self._probe_inflight = True
                 return True
             self.degraded_served += 1
+            _DEGRADED.inc()
             return False
 
     def record_success(self) -> None:
@@ -96,6 +107,7 @@ class CircuitBreaker:
 
     def _trip_locked(self) -> None:
         self.trips += 1
+        _TRIPS.inc()
         self.consecutive_trips += 1
         backoff = min(
             self.backoff_base_s

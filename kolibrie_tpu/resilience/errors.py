@@ -22,6 +22,11 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+# obs.spans is stdlib-only and imports nothing from resilience, so this
+# is cycle-safe; it lets every structured error carry the trace id of
+# the request it failed (a shed/504/429 correlates with its trace).
+from kolibrie_tpu.obs.spans import current_trace_id
+
 
 class KolibrieError(Exception):
     """Base of the serving-layer taxonomy: carries the HTTP mapping."""
@@ -34,6 +39,9 @@ class KolibrieError(Exception):
         out: Dict[str, object] = {"error": msg, "code": self.code}
         if context:
             out["context"] = context
+        trace_id = current_trace_id()
+        if trace_id:
+            out["trace_id"] = trace_id
         return out
 
 
@@ -155,4 +163,7 @@ def error_response(
     out: Dict[str, object] = {"error": msg, "code": code}
     if context:
         out["context"] = context
+    trace_id = current_trace_id()
+    if trace_id:
+        out["trace_id"] = trace_id
     return status, out

@@ -37,10 +37,27 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from kolibrie_tpu.obs import metrics as _obs_metrics
 from kolibrie_tpu.resilience.errors import WindowCrash
 from kolibrie_tpu.resilience.faultinject import fault_point
 
 FAULT_SITE = "rsp.window"
+
+_DEAD_LETTERS = _obs_metrics.counter(
+    "kolibrie_rsp_dead_letters_total",
+    "window firings dead-lettered after retry exhaustion",
+    labels=("window",),
+)
+_RESTARTS = _obs_metrics.counter(
+    "kolibrie_rsp_restarts_total",
+    "supervised window processor restarts",
+    labels=("window",),
+)
+_RETRIES = _obs_metrics.counter(
+    "kolibrie_rsp_retries_total",
+    "poisoned-event retries",
+    labels=("window",),
+)
 
 
 @dataclass
@@ -108,10 +125,12 @@ class WindowSupervisor:
                 if attempt + 1 < attempts:
                     with self._lock:
                         self.retried += 1
+                    _RETRIES.labels(self.window_iri).inc()
         with self._lock:
             self.dead_letters.append(
                 DeadLetter(self.window_iri, ordinal, repr(last_exc))
             )
+        _DEAD_LETTERS.labels(self.window_iri).inc()
 
     def _maybe_checkpoint(self) -> None:
         n = self.config.checkpoint_every
@@ -170,7 +189,9 @@ class WindowSupervisor:
                 self.dead_letters.append(
                     DeadLetter(self.window_iri, self.processed, repr(exc))
                 )
+                _DEAD_LETTERS.labels(self.window_iri).inc()
                 return False
+        _RESTARTS.labels(self.window_iri).inc()
         backoff = min(
             self.config.backoff_base_s * (self.config.backoff_factor ** (n - 1)),
             self.config.backoff_max_s,

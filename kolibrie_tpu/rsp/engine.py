@@ -23,6 +23,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kolibrie_tpu.core.rule import Rule
 from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.obs import metrics as _obs_metrics
+from kolibrie_tpu.obs.spans import span as _obs_span
 from kolibrie_tpu.query.ast import (
     SelectItem,
     SelectQuery,
@@ -48,6 +50,25 @@ from kolibrie_tpu.rsp.r2r import SimpleR2R
 from kolibrie_tpu.rsp.r2s import Relation2StreamOperator, StreamOperator
 from kolibrie_tpu.rsp.s2r import ContentContainer, WindowTriple
 from kolibrie_tpu.rsp.window_runner import WindowRunner, WindowSpec
+
+# Streaming health metrics (docs/OBSERVABILITY.md).  Window IRIs come
+# from registered queries, so the label set is bounded by configuration.
+_WINDOW_FIRE_LAT = _obs_metrics.histogram(
+    "kolibrie_rsp_window_fire_seconds",
+    "window firing (R2R materialize + query) wall time",
+    labels=("window",),
+)
+_EVENT_LAG = _obs_metrics.histogram(
+    "kolibrie_rsp_event_lag",
+    "event-time lag at firing: engine high-water timestamp minus the "
+    "firing's last-changed timestamp (logical time units)",
+    labels=("window",),
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_CLOSE_TO_EMIT = _obs_metrics.histogram(
+    "kolibrie_rsp_close_to_emit_seconds",
+    "wall time from the earliest pending window firing to result emission",
+)
 
 ResultRow = Tuple[Tuple[str, str], ...]  # sorted (var, value) pairs
 
@@ -269,6 +290,12 @@ class RSPEngine:
         self.r2s = Relation2StreamOperator(stream_type, 0)
         self._store_lock = threading.Lock()
         self._result_queue: "queue.Queue[WindowResult]" = queue.Queue()
+        # observability: engine-wide event-time high water (drives the
+        # per-window lag metric) and start times of window firings whose
+        # results are still queued (drives close-to-emit latency); races
+        # on these only skew a metric, never a result
+        self._max_event_ts = 0
+        self._fire_t0: Dict[str, float] = {}
 
         # cross-window state (rules may arrive pre-parsed or as N3 text,
         # which is parsed against THIS engine's dictionary so IDs align)
@@ -322,8 +349,7 @@ class RSPEngine:
         """Window processor closure (create_window_processor! parity)."""
         prev_window_triples: List = []
 
-        def processor(content: ContentContainer):
-            ts = content.get_last_timestamp_changed()
+        def fire(content: ContentContainer, ts: int):
             if self.cross_window_enabled:
                 raw: List[Tuple[Triple, int]] = []
                 for item, event_ts in content.iter_with_timestamps():
@@ -360,6 +386,22 @@ class RSPEngine:
                 filtered = self.r2s.eval(results, ts)
                 for row in filtered:
                     self.consumer(row)
+
+        def processor(content: ContentContainer):
+            ts = content.get_last_timestamp_changed()
+            _EVENT_LAG.labels(cfg.window_iri).observe(
+                max(0, self._max_event_ts - ts)
+            )
+            if self.cross_window_enabled or self._has_joins:
+                # result rides _result_queue: emission happens later, in
+                # _emit — remember the EARLIEST pending fire start
+                self._fire_t0.setdefault(cfg.window_iri, time.perf_counter())
+            t0 = time.perf_counter()
+            with _obs_span("rsp.window.fire", window=cfg.window_iri):
+                fire(content, ts)
+            _WINDOW_FIRE_LAT.labels(cfg.window_iri).observe(
+                time.perf_counter() - t0
+            )
 
         return processor
 
@@ -416,6 +458,8 @@ class RSPEngine:
         (rsp_engine.rs:693-731)."""
         if self.operation_mode == OperationMode.SINGLE_THREAD and self._has_joins:
             self.process_single_thread_window_results()
+        if ts > self._max_event_ts:
+            self._max_event_ts = ts
         input_norm = self._normalize_stream_iri(stream_iri)
         for cfg, runner in zip(self.window_configs, self.windows):
             if cfg.stream_iri.startswith("?"):
@@ -428,6 +472,8 @@ class RSPEngine:
         """Convenience: feed every window (single-stream engines)."""
         if self.operation_mode == OperationMode.SINGLE_THREAD and self._has_joins:
             self.process_single_thread_window_results()
+        if ts > self._max_event_ts:
+            self._max_event_ts = ts
         for runner in self.windows:
             runner.add_to_window(item, ts)
 
@@ -573,6 +619,10 @@ class RSPEngine:
         ]
         for row in self.r2s.eval(outputs, ts):
             self.consumer(row)
+        if self._fire_t0:
+            pending = list(self._fire_t0.values())
+            self._fire_t0.clear()
+            _CLOSE_TO_EMIT.observe(time.perf_counter() - min(pending))
 
     # ---------------------------------------------------------- cross-window
 

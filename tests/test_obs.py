@@ -1,0 +1,325 @@
+"""Observability tests: metrics registry semantics, span tracing and
+context propagation, Prometheus exposition, and the instrumented HTTP
+serving path (ISSUE 3)."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kolibrie_tpu.frontends.http_server import make_server
+from kolibrie_tpu.obs import export as obs_export
+from kolibrie_tpu.obs import metrics as obs_metrics
+from kolibrie_tpu.obs import runtime as obs_runtime
+from kolibrie_tpu.obs import spans as obs_spans
+
+# ------------------------------------------------------------------ helpers
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = make_server("127.0.0.1", 0, quiet=True)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def post(base, path, payload, headers=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return dict(resp.headers), json.loads(resp.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as resp:
+        return dict(resp.headers), resp.read().decode()
+
+
+NT = "\n".join(f'<http://e/{i}> <http://e/p> "{i}" .' for i in range(64))
+QUERY = "SELECT ?s ?o WHERE { ?s <http://e/p> ?o }"
+
+
+# ------------------------------------------------------------ metrics core
+
+
+def test_histogram_bucket_boundaries():
+    reg = obs_metrics.Registry()
+    h = reg.histogram("t_hist", "test", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 99.0):
+        h.observe(v)
+    cum = h._default.cumulative()
+    # boundary values land in their own bucket (le is inclusive)
+    assert cum == [(0.1, 2), (1.0, 4), (10.0, 6), (float("inf"), 7)]
+    assert h._default.count == 7
+    assert h._default.sum == pytest.approx(sum((0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 99.0)))
+
+
+def test_counter_concurrent_increments():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_conc", "test")
+    per_thread, n_threads = 1000, 8
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c._default.value == per_thread * n_threads
+
+
+def test_labeled_children_are_independent():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_lbl", "test", labels=("kind",))
+    c.labels("a").inc(3)
+    c.labels("b").inc()
+    assert c.labels("a").value == 3
+    assert c.labels("b").value == 1
+    with pytest.raises(ValueError):
+        c.labels("a", "extra")
+
+
+def test_registry_rejects_kind_conflicts():
+    reg = obs_metrics.Registry()
+    reg.counter("t_kind", "test")
+    with pytest.raises(ValueError):
+        reg.gauge("t_kind", "test")
+
+
+def test_disabled_runtime_skips_recording():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_off", "test")
+    h = reg.histogram("t_off_h", "test")
+    obs_runtime.set_enabled(False)
+    try:
+        c.inc()
+        h.observe(1.0)
+        with obs_spans.span("t.off"):
+            pass
+    finally:
+        obs_runtime.set_enabled(True)
+    assert c._default.value == 0
+    assert h._default.count == 0
+    assert not obs_spans.spans_snapshot()[-1:] or (
+        obs_spans.spans_snapshot()[-1]["name"] != "t.off"
+    )
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_span_nesting_and_ring():
+    obs_spans.clear()
+    with obs_spans.trace_scope("trace-nest") as tid:
+        assert tid == "trace-nest"
+        with obs_spans.span("outer"):
+            with obs_spans.span("inner"):
+                pass
+    recorded = obs_spans.spans_snapshot("trace-nest")
+    by_name = {s["name"]: s for s in recorded}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    # JSONL export round-trips
+    lines = obs_spans.export_jsonl("trace-nest").splitlines()
+    assert len(lines) == 2 and all(json.loads(l)["trace_id"] == "trace-nest" for l in lines)
+
+
+def test_span_ring_eviction():
+    obs_spans.set_ring_capacity(8)
+    try:
+        obs_spans.clear()
+        with obs_spans.trace_scope("trace-evict"):
+            for i in range(20):
+                with obs_spans.span(f"s{i}"):
+                    pass
+        kept = obs_spans.spans_snapshot("trace-evict")
+        assert len(kept) == 8
+        # oldest evicted, newest retained
+        assert [s["name"] for s in kept] == [f"s{i}" for i in range(12, 20)]
+    finally:
+        obs_spans.set_ring_capacity(obs_spans.DEFAULT_RING_CAPACITY)
+
+
+def test_span_records_errors():
+    obs_spans.clear()
+    with obs_spans.trace_scope("trace-err"):
+        with pytest.raises(RuntimeError):
+            with obs_spans.span("boom"):
+                raise RuntimeError("kaboom")
+    (sp,) = obs_spans.spans_snapshot("trace-err")
+    assert "kaboom" in sp["error"]
+
+
+def test_baggage_scoped_to_trace():
+    with obs_spans.trace_scope("trace-bag"):
+        obs_spans.set_baggage("template", "fp123")
+        assert obs_spans.get_baggage("template") == "fp123"
+        with obs_spans.trace_scope("trace-bag-2"):
+            assert obs_spans.get_baggage("template") is None
+        assert obs_spans.get_baggage("template") == "fp123"
+
+
+# ------------------------------------------------------------- exposition
+
+
+def test_prometheus_exposition_parses():
+    text = obs_export.render_prometheus()
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+Inf-]+$"
+    )
+    seen_types = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            parts = line.split()
+            assert parts[3] in ("counter", "gauge", "histogram")
+            seen_types.append(parts[2])
+            continue
+        assert sample_re.match(line), f"unparseable sample line: {line!r}"
+    # one TYPE per metric family, no duplicates
+    assert len(seen_types) == len(set(seen_types))
+    # the catalog's core families are present
+    for name in (
+        "kolibrie_http_request_seconds",
+        "kolibrie_plan_cache_events_total",
+        "kolibrie_device_dispatch_seconds",
+        "kolibrie_admission_inflight",
+        "kolibrie_breaker_trips_total",
+        "kolibrie_rsp_dead_letters_total",
+    ):
+        assert f"# TYPE {name} " in text, name
+
+
+def test_histogram_exposition_shape():
+    reg = obs_metrics.Registry()
+    h = reg.histogram("t_expo", "test", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(1.5)
+    text = obs_export.render_prometheus(reg)
+    assert 't_expo_bucket{le="1"} 1' in text
+    assert 't_expo_bucket{le="2"} 2' in text
+    assert 't_expo_bucket{le="+Inf"} 2' in text
+    assert "t_expo_sum 2" in text
+    assert "t_expo_count 2" in text
+
+
+def test_label_value_escaping():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_esc", "test", labels=("v",))
+    c.labels('quo"te\nnl').inc()
+    text = obs_export.render_prometheus(reg)
+    assert 't_esc{v="quo\\"te\\nnl"} 1' in text
+
+
+# ------------------------------------------------- HTTP serving path (e2e)
+
+
+def test_trace_propagation_http_to_executor(server):
+    obs_spans.clear()
+    post(server, "/store/load",
+         {"store_id": "obs1", "rdf": NT, "format": "ntriples", "mode": "device"})
+    headers, out = post(
+        server, "/store/query", {"store_id": "obs1", "sparql": QUERY},
+        headers={"X-Kolibrie-Trace-Id": "trace-e2e-1"},
+    )
+    assert headers.get("X-Kolibrie-Trace-Id") == "trace-e2e-1"
+    assert len(out["data"]) == 64
+    _, body = get(server, "/debug/traces?trace_id=trace-e2e-1")
+    spans = [json.loads(l) for l in body.splitlines() if l]
+    assert spans and all(s["trace_id"] == "trace-e2e-1" for s in spans)
+    names = {s["name"] for s in spans}
+    # the full serving chain under ONE trace id: HTTP → batcher → executor
+    # → device phases (parse/plan/lower/dispatch/collect)
+    assert {
+        "http.request", "batcher.submit", "batcher.dispatch",
+        "query.execute", "query.parse", "query.plan",
+        "device.lower", "device.dispatch", "device.collect",
+    } <= names
+    # parent links resolve within the trace
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids
+
+
+def test_generated_trace_id_echoed(server):
+    headers, _ = post(server, "/query", {"sparql": "SELECT ?s WHERE { ?s ?p ?o }",
+                                         "rdf": "", "format": "ntriples"})
+    assert re.fullmatch(r"[0-9a-f]{32}", headers.get("X-Kolibrie-Trace-Id", ""))
+
+
+def test_error_payload_carries_trace_id(server):
+    req = urllib.request.Request(
+        server + "/store/query",
+        data=json.dumps({"store_id": "missing", "sparql": QUERY}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Kolibrie-Trace-Id": "trace-err-404"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    body = json.loads(ei.value.read())
+    assert ei.value.code == 404
+    assert body["trace_id"] == "trace-err-404"
+
+
+def test_metrics_endpoint_scrapes(server):
+    post(server, "/store/load",
+         {"store_id": "obs2", "rdf": NT, "format": "ntriples"})
+    post(server, "/store/query", {"store_id": "obs2", "sparql": QUERY})
+    headers, text = get(server, "/metrics")
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "# TYPE kolibrie_http_requests_total counter" in text
+    assert 'kolibrie_batcher_queue_depth{store="obs2"}' in text
+    assert "kolibrie_device_compile_cache_entries" in text
+    # counters visibly moved
+    m = re.search(
+        r'kolibrie_http_requests_total\{route="/store/query",code="200"\} (\d+)',
+        text,
+    )
+    assert m and int(m.group(1)) >= 1
+
+
+def test_stats_single_source_of_truth(server):
+    post(server, "/store/load",
+         {"store_id": "obs3", "rdf": NT, "format": "ntriples"})
+    post(server, "/store/query", {"store_id": "obs3", "sparql": QUERY})
+    _, text = get(server, "/stats")
+    stats = json.loads(text)
+    block = stats["stores"]["obs3"]
+    # legacy shape preserved (asserted by test_plan_template/test_chaos too)
+    for key in ("requests", "dispatches", "dedup_hits", "max_batch",
+                "shed_queue_full", "shed_deadline", "per_template",
+                "triples", "plan_cache", "breakers", "device_compiles"):
+        assert key in block, key
+    assert block["requests"] >= 1
+    # both renderers ARE the same function: TemplateBatcher.stats()
+    # delegates to the obs.export builder the /stats handler uses
+    from kolibrie_tpu.frontends.http_server import TemplateBatcher
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    b = TemplateBatcher(SparqlDatabase())
+    assert b.stats() == obs_export.store_stats(b)
+
+
+def test_debug_profile_noops_on_cpu(server):
+    _, out = post(server, "/debug/profile?seconds=0.01", {})
+    assert out["profiled"] is False
+    assert out["backend"] == "cpu"
