@@ -3,8 +3,11 @@
 This is the path that puts the TPU *inside* the query engine: a physical
 plan from :mod:`kolibrie_tpu.optimizer.planner` is lowered to a hashable
 ``PlanSpec`` and interpreted as ONE jitted XLA program — scans are
-``dynamic_slice`` windows over the store's device-resident sorted orders
-(:meth:`ColumnarTripleStore.device_order`), joins are the static-capacity
+``dynamic_slice`` windows over the store's device-resident sorted orders,
+held as a two-tier base + delta segment pair
+(:meth:`ColumnarTripleStore.device_segment`) merged inside the compiled
+plan so mutation batches under the delta threshold re-upload only the
+small delta segment and never change shapes, joins are the static-capacity
 sort-join of :func:`kolibrie_tpu.ops.device_join.join_indices`, numeric
 filters are gathers over host-precomputed per-ID masks, and strings are
 decoded only after the final readback.
@@ -84,6 +87,20 @@ from kolibrie_tpu.ops import round_cap as _round_cap
 from kolibrie_tpu.resilience.deadline import check_deadline
 from kolibrie_tpu.resilience.faultinject import fault_point
 
+
+def _pad_pow2(arr: np.ndarray, fill, lo: int = 128) -> np.ndarray:
+    """Pad a 1-D per-ID table to a power-of-two length with a semantically
+    neutral fill value.  Per-ID operands (numeric table, filter masks,
+    string ranks, quoted table) grow with the dictionary; padding keeps
+    their device SHAPES stable across small mutation batches so cached
+    compiled plans are reused instead of retraced."""
+    cap = _round_cap(len(arr), lo)
+    if cap == len(arr):
+        return arr
+    out = np.full(cap, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
 # Per-template device phase timings.  The template label is the plan
 # template fingerprint carried in trace baggage by the executor —
 # bounded upstream by the template cache, so cardinality is safe.
@@ -121,10 +138,14 @@ class Unsupported(Exception):
 @dataclass(frozen=True)
 class ScanSpec:
     order_idx: int  # into PlanSpec.orders
-    scan_idx: int  # into the (n_scans, 2) [lo, n] scalar array
+    scan_idx: int  # into the (n_scans, 4) [lo_b, n_b, lo_d, n_d] scalars
     out_vars: tuple  # ((var, pos), ...) pos: 0=s 1=p 2=o canonical
     eq_pairs: tuple  # ((pos_a, pos_b), ...) repeated-variable constraints
     cap: int
+    # canonical positions of the two order columns packed as the base/delta
+    # merge key — the first unbound perm column (and its successor), so the
+    # merged stream stays sorted exactly where the rsorted joins require it
+    key_pos: tuple = (0, 1)
 
 
 @dataclass(frozen=True)
@@ -394,19 +415,71 @@ def _plan_body(
 
     def eval_node(node):
         if isinstance(node, ScanSpec):
-            s_col, p_col, o_col = order_arrays[node.order_idx]
-            lo = scalars[node.scan_idx, 0]
-            n = scalars[node.scan_idx, 1]
-            ar = jnp.arange(node.cap, dtype=jnp.int32)
-            src = jnp.clip(lo + ar, 0, s_col.shape[0] - 1)
-            valid = ar < n
+            # Two-segment scan: a window over the FROZEN base order (with
+            # tombstoned rows masked out) merged with a window over the
+            # small delta order, entirely inside the compiled plan.  Shapes
+            # depend only on (base cap, delta cap), so mutation batches
+            # under the delta threshold re-upload the delta operand without
+            # recompiling.  Each live row's output slot is its rank in the
+            # two-way merge (base before delta on key ties), which keeps
+            # the merge-key column sorted with prefix validity — the exact
+            # contract the rsorted merge joins rely on.
+            bcols, dcols, del_pos = order_arrays[node.order_idx]
+            lo_b = scalars[node.scan_idx, 0]
+            n_b = scalars[node.scan_idx, 1]
+            lo_d = scalars[node.scan_idx, 2]
+            n_d = scalars[node.scan_idx, 3]
+            cap = node.cap
+            dcap = del_pos.shape[0]
+            ar = jnp.arange(cap, dtype=jnp.int32)
+            ard = jnp.arange(dcap, dtype=jnp.int32)
+            src_b = jnp.clip(lo_b + ar, 0, bcols[0].shape[0] - 1)
+            src_d = jnp.clip(lo_d + ard, 0, dcap - 1)
+            inb = ar < n_b
+            ind = ard < n_d
+            # tombstone check: sorted membership of the base ROW POSITION
+            # (one u32 word) instead of matching a 96-bit triple
+            sbu = src_b.astype(jnp.uint32)
+            jd = jnp.clip(jnp.searchsorted(del_pos, sbu), 0, dcap - 1)
+            is_del = (del_pos[jd] == sbu) & inb
+            bvalid = inb & ~is_del
+            k0, k1 = node.key_pos
+            sent = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+            bkey = (bcols[k0][src_b].astype(jnp.uint64) << jnp.uint64(32)) | (
+                bcols[k1][src_b].astype(jnp.uint64)
+            )
+            # deleted rows KEEP their real key (preserves sortedness and
+            # the rank arithmetic); only rows beyond the window go sentinel
+            bkey = jnp.where(inb, bkey, sent)
+            dkey = (dcols[k0][src_d].astype(jnp.uint64) << jnp.uint64(32)) | (
+                dcols[k1][src_d].astype(jnp.uint64)
+            )
+            dkey = jnp.where(ind, dkey, sent)
+            pos_b = (jnp.cumsum(bvalid.astype(jnp.int32)) - 1) + (
+                jnp.searchsorted(dkey, bkey, side="left").astype(jnp.int32)
+            )
+            cdel = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(is_del.astype(jnp.int32))]
+            )
+            ib = jnp.searchsorted(bkey, dkey, side="right").astype(jnp.int32)
+            pos_d = ard + ib - cdel[ib]
+            n_live = (n_b - cdel[-1]) + n_d
+            valid = ar < n_live
+            dst_b = jnp.where(bvalid, pos_b, cap)
+            dst_d = jnp.where(ind, pos_d, cap)
             raw = {}
             need = {pos for _, pos in node.out_vars}
             for a, b in node.eq_pairs:
                 need.add(a)
                 need.add(b)
             for pos in need:
-                raw[pos] = (s_col, p_col, o_col)[pos][src]
+                raw[pos] = (
+                    jnp.zeros(cap, dtype=jnp.uint32)
+                    .at[dst_b]
+                    .set(bcols[pos][src_b], mode="drop")
+                    .at[dst_d]
+                    .set(dcols[pos][src_d], mode="drop")
+                )
             for a, b in node.eq_pairs:
                 valid = valid & (raw[a] == raw[b])
             cols = {var: raw[pos] for var, pos in node.out_vars}
@@ -897,6 +970,7 @@ class LoweredPlan:
                     node.out_vars,
                     node.eq_pairs,
                     node.cap,
+                    node.key_pos,
                 )
             if isinstance(node, JoinSpec):
                 return JoinSpec(
@@ -1020,6 +1094,21 @@ class LoweredPlan:
                 return name
         return None
 
+    @staticmethod
+    def _merge_key_pos(order_name: str, n_bound: int) -> tuple:
+        """Canonical positions of the two order columns the two-segment
+        scan packs as its base/delta merge key: the first UNBOUND perm
+        column and its successor.  Rows inside a scanned range are sorted
+        by exactly that pair, so merging on it preserves the order the
+        rsorted joins require (fully-constant patterns never reach a scan —
+        they hoist to const_checks — hence ``n_bound <= 2``)."""
+        from kolibrie_tpu.core.store import ColumnarTripleStore
+
+        pos_of = {"s": 0, "p": 1, "o": 2}
+        perm = ColumnarTripleStore._ORDER_PERMS[order_name]
+        k = min(n_bound, 2)
+        return (pos_of[perm[k]], pos_of[perm[min(k + 1, 2)]])
+
     def _lower_scan(self, pattern: PatternTriple):
         terms = [pattern.subject, pattern.predicate, pattern.object]
         consts: List[Optional[int]] = []
@@ -1066,7 +1155,12 @@ class LoweredPlan:
         if not out_vars:
             raise Unsupported("pattern binds no variables")
         node: object = ScanSpec(
-            order_idx, scan_idx, tuple(out_vars), tuple(eq_pairs), 0
+            order_idx,
+            scan_idx,
+            tuple(out_vars),
+            tuple(eq_pairs),
+            0,
+            self._merge_key_pos(order_name, len(bound)),
         )
         bound_vars = {v for v in seen if not v.startswith("__qt")}
         for _pos, qvar, inner in quoted_at:
@@ -1125,6 +1219,7 @@ class LoweredPlan:
             node.out_vars,
             node.eq_pairs,
             node.cap,
+            self._merge_key_pos(order_name, len(bound)),
         )
 
     def _lower_values(self, values):
@@ -1316,7 +1411,42 @@ class LoweredPlan:
     # ------------------------------------------------------------- assembly
 
     def _scan_ranges(self) -> np.ndarray:
-        """Host searchsorted over the (host) sorted orders → (lo, n) rows."""
+        """Host searchsorted over the (host) base + delta sorted orders →
+        ``(lo_base, n_base, lo_delta, n_delta)`` rows.  The compiled plan
+        merges the two windows and masks base tombstones on device; the
+        base window intentionally INCLUDES deleted rows (the tombstone
+        positions handle them), keeping the range math identical on both
+        segments."""
+        store = self.db.store
+        pos_of = {"s": 0, "p": 1, "o": 2}
+        out = np.zeros((max(len(self.scan_descs), 1), 4), dtype=np.int32)
+        for i, (order_name, consts) in enumerate(self.scan_descs):
+            segments = (
+                store.base_order(order_name),
+                store.delta_order(order_name),
+            )
+            for j, order in enumerate(segments):
+                keys = [
+                    consts[pos_of[c]]
+                    for c in order.perm
+                    if consts[pos_of[c]] is not None
+                ]
+                if any(k < 0 for k in keys):
+                    continue  # unknown constant: (0, 0) — matches nothing
+                if not keys:
+                    lo, hi = 0, len(order)
+                elif len(keys) == 1:
+                    lo, hi = order.range0(keys[0])
+                else:
+                    lo, hi = order.range01(keys[0], keys[1])
+                out[i, 2 * j] = lo
+                out[i, 2 * j + 1] = hi - lo
+        return out
+
+    def _host_scan_ranges(self) -> np.ndarray:
+        """``(lo, n)`` rows over the LIVE sorted orders — the
+        host-evaluation twin of :meth:`_scan_ranges` (host consumers never
+        see the base/delta split)."""
         store = self.db.store
         pos_of = {"s": 0, "p": 1, "o": 2}
         out = np.zeros((max(len(self.scan_descs), 1), 2), dtype=np.int32)
@@ -1346,6 +1476,7 @@ class LoweredPlan:
                 node.out_vars,
                 node.eq_pairs,
                 scan_caps[node.scan_idx],
+                node.key_pos,
             )
         if isinstance(node, JoinSpec):
             return JoinSpec(
@@ -1477,9 +1608,15 @@ class LoweredPlan:
         root = self._with_caps(self.root, self._scan_caps, self._join_caps)
         spec = PlanSpec(root, self.out_vars, tuple(self.order_names), tag)
         order_arrays = tuple(
-            store.device_order(name)[0] for name in self.order_names
+            store.device_segment(name) for name in self.order_names
         )
-        masks = tuple(jnp.asarray(m) for m in self.mask_arrays)
+        # per-ID masks grow with the dictionary; pad each to a power-of-two
+        # capacity (False = "no match", the clamp-gather's existing
+        # out-of-range verdict) so small mutation batches that mint new
+        # dictionary IDs re-upload without changing operand shapes
+        masks = tuple(
+            jnp.asarray(_pad_pow2(m, False)) for m in self.mask_arrays
+        )
         values = tuple(
             tuple(jnp.asarray(c) for c in cols) for cols in self.values_tables
         )
@@ -1526,7 +1663,7 @@ class LoweredPlan:
         if not self.const_ok():
             return self.empty_table(), [0] * self.join_count
         self._refresh_masks()
-        scan_ranges = self._scan_ranges()
+        scan_ranges = self._host_scan_ranges()
         numf = self.db.numeric_values() if self.need_numf else None
         counts: List[int] = [0] * self.join_count
 
@@ -1791,7 +1928,7 @@ class LoweredPlan:
         key variables, capacities and (when provided) exact match counts,
         filters, and quoted expansions.  ``counts`` is the per-join exact
         count list from :meth:`host_execute`/calibration."""
-        scan_ranges = self._scan_ranges()
+        scan_ranges = self._host_scan_ranges()
         lines: List[str] = []
 
         def term(c):
@@ -1970,34 +2107,41 @@ def numeric_filter_mask(vals: np.ndarray, op: str, const: float) -> np.ndarray:
 
 
 def template_scan_cap(db, order_name: str, n_bound: int) -> int:
-    """Upper bound on ANY constant-variant's live range for a scan whose
-    ``order_name`` prefix binds ``n_bound`` columns: the largest key-group
-    of that prefix.  This is what makes ``ScanSpec.cap`` a property of the
-    TEMPLATE rather than of one variant's constants (shape-stable
-    compilation).  O(store) to compute, cached per (order, prefix, store
-    size) on the database."""
+    """Upper bound on ANY constant-variant's merged (base + delta) range
+    for a scan whose ``order_name`` prefix binds ``n_bound`` columns: the
+    largest key-group of that prefix in the FROZEN base segment plus the
+    fixed delta device capacity.  This is what makes ``ScanSpec.cap`` a
+    property of the TEMPLATE rather than of one variant's constants
+    (shape-stable compilation) — and because the base is frozen at
+    ``base_version``, the calibration survives every incremental mutation
+    batch.  O(base) to compute, cached per (order, prefix, base_version)
+    on the database."""
     store = db.store
-    n = len(store)
-    if n == 0:
-        return 1
+    dcap = store.delta_device_cap
+    base = store.base_order(order_name)
+    nb = len(base)
+    if nb == 0:
+        return dcap
     if n_bound <= 0:
-        return n
+        return nb + dcap
     cache = db.__dict__.setdefault("_device_group_cap_cache", {})
-    key = (order_name, n_bound, n)
+    bv = store.base_version
+    key = (order_name, n_bound, bv)
     hit = cache.get(key)
     if hit is not None:
-        return hit
-    order = store.order(order_name)
-    rows = order.slice_rows(0, n)
-    change = np.zeros(n, dtype=bool)
+        return hit + dcap
+    for stale in [k for k in cache if k[2] != bv]:
+        del cache[stale]
+    rows = base.slice_rows(0, nb)
+    change = np.zeros(nb, dtype=bool)
     change[0] = True
-    for c in order.perm[:n_bound]:
+    for c in base.perm[:n_bound]:
         col = rows[c]
         change[1:] |= col[1:] != col[:-1]
-    bounds = np.append(np.flatnonzero(change), n)
+    bounds = np.append(np.flatnonzero(change), nb)
     cap = int(np.max(np.diff(bounds)))
     cache[key] = cap
-    return cap
+    return cap + dcap
 
 
 def lower_plan(db, plan, anti_plans=(), union_groups=(), optional_plans=()) -> LoweredPlan:
@@ -2366,14 +2510,23 @@ def host_quoted_table(db):
 
 
 def device_quoted(db):
-    """Device copy of :func:`host_quoted_table`, cached alongside it."""
+    """Device copy of :func:`host_quoted_table`, cached alongside it.
+    Padded to a power-of-two row count with extra sentinel rows (all-ones
+    qid stays sorted-last and never matches) for shape stability under
+    mutation."""
     import jax.numpy as jnp
 
     cache = db.__dict__.get("_device_qt_cache")
     n = len(db.quoted)
     if cache is not None and cache[0] == n:
         return cache[1]
-    arrs = tuple(jnp.asarray(a) for a in host_quoted_table(db))
+    qid, qs, qp, qo = host_quoted_table(db)
+    arrs = (
+        jnp.asarray(_pad_pow2(qid, 0xFFFFFFFF)),
+        jnp.asarray(_pad_pow2(qs, 0)),
+        jnp.asarray(_pad_pow2(qp, 0)),
+        jnp.asarray(_pad_pow2(qo, 0)),
+    )
     db.__dict__["_device_qt_cache"] = (n, arrs)
     return arrs
 
@@ -2401,10 +2554,15 @@ def device_string_ranks(db):
     _, inv = np.unique(np.array(strs), return_inverse=True)
     ranks = inv.astype(np.float64)
     with _enable_x64(True):
+        # power-of-two padding (real IDs never index the pad slots) keeps
+        # operand shapes stable while the dictionary grows
         arrs = (
-            jnp.asarray(ranks[:n_d]),
+            jnp.asarray(_pad_pow2(ranks[:n_d], 0.0)),
             jnp.asarray(
-                ranks[n_d:] if n_q else np.zeros(1, dtype=np.float64)
+                _pad_pow2(
+                    ranks[n_d:] if n_q else np.zeros(1, dtype=np.float64),
+                    0.0,
+                )
             ),
         )
     db.__dict__["_device_strrank_cache"] = ((n_d, n_q), arrs)
@@ -2414,16 +2572,25 @@ def device_string_ranks(db):
 def device_numf(db):
     """Per-database device copy of the numeric-literal table (f64), cached
     until the dictionary grows — the one cache both the single-chip plan
-    lowering and the distributed aggregate tail read/populate."""
+    lowering and the distributed aggregate tail read/populate.
+
+    Padded to a power-of-two capacity with NaN (NaN already means
+    "non-numeric": every comparison over it is False) so dictionary growth
+    re-uploads the table without changing the operand SHAPE — small
+    mutation batches keep riding the compiled plan instead of retracing.
+    """
     import jax.numpy as jnp
 
     cache = db.__dict__.get("_device_numf_cache")
     vals = db.numeric_values()
-    if cache is not None and cache[0] == len(vals):
+    n = len(vals)
+    if cache is not None and cache[0] == n:
         return cache[1]
+    padded = np.full(_round_cap(n, 1024), np.nan)
+    padded[:n] = vals
     with _enable_x64(True):
-        arr = jnp.asarray(vals, dtype=jnp.float64)
-    db.__dict__["_device_numf_cache"] = (len(vals), arr)
+        arr = jnp.asarray(padded, dtype=jnp.float64)
+    db.__dict__["_device_numf_cache"] = (n, arr)
     return arr
 
 

@@ -714,13 +714,22 @@ def _display_array(db):
     return cache
 
 
-def _display_ranks(db, disp):
+def _display_ranks(db, disp, result_rows: int = 1 << 62):
     """``ranks[id]`` = dense rank of ``display[id]`` in lexicographic
-    order, or None when the dictionary is too large for a dictionary-wide
-    sort to amortize (callers rank per column instead).  Built only when a
-    canonical row sort actually needs it, once per dictionary size."""
+    order, or None when a dictionary-wide sort would not amortize (callers
+    rank per column instead).  Built only when a canonical row sort
+    actually needs it, once per dictionary size.
+
+    Under mutation the dictionary grows every batch; rebuilding the global
+    ranks then costs O(dict log dict) per batch no matter how small the
+    result.  A stale cache is therefore only refreshed when the result is
+    large enough for the rebuild to amortize — small results on a grown
+    dictionary take the per-column path, which scales with the result."""
     n = len(disp)
     if n > _GLOBAL_RANK_MAX:
+        return None
+    cached = db.__dict__.get("_display_ranks")
+    if (cached is None or cached[0] != n) and result_rows * 8 < n:
         return None
     cache = db.__dict__.get("_display_ranks")
     if cache is not None and cache[0] == n:
@@ -784,7 +793,7 @@ def format_results(
         for ids in id_cols
     ]
     if sort_rows:
-        ranks = _display_ranks(db, disp)
+        ranks = _display_ranks(db, disp, result_rows=n)
         keys = []
         for ids in safe_cols:
             if ids is None:
@@ -929,6 +938,22 @@ def _plan_caches(db):
     return parse, templates, stats
 
 
+def _unresolved_params(db, params) -> tuple:
+    """The string constants among ``params`` with no dictionary id yet.
+    A plan built while any of these were unknown embeds a can-never-match
+    sentinel for them, so it must be rebuilt (host-side; the device
+    executable is keyed on the constant-free spec and is NOT recompiled)
+    once the term gets interned — mutation batches under the delta
+    threshold no longer move ``base_version``, so the slot key alone
+    can't notice."""
+    dic = db.dictionary
+    return tuple(
+        p
+        for p in params
+        if isinstance(p, str) and dic.lookup(db.expand_term(p)) is None
+    )
+
+
 def _plan_cache_entry(db, sparql: str):
     """Automatic plan cache on the database.  Three granularities:
 
@@ -943,9 +968,13 @@ def _plan_cache_entry(db, sparql: str):
       the lowered program carries its constants in a traced parameter
       vector);
     - within a template, the physical plan + device-lowered program live
-      in per-state slots keyed by (store version, UDF registry,
+      in per-state slots keyed by (store BASE version, UDF registry,
       execution mode), so e.g. host/device alternation keeps BOTH
-      compiled programs warm instead of evicting on every flip.
+      compiled programs warm instead of evicting on every flip — and
+      because mutation batches under the store's delta threshold advance
+      only ``delta_epoch`` (never ``base_version``), prepared plans
+      survive sustained insert/delete traffic; per-execution scan ranges
+      and the small device delta segment carry the fresh state.
 
     A slot replays its plan/lowered program only when the stored
     parameter binding matches the incoming one; on mismatch the plan is
@@ -986,7 +1015,7 @@ def _plan_cache_entry(db, sparql: str):
         templates.popitem(last=False)
         stats["evictions"] += 1
         _PLAN_CACHE_EVICTION.inc()
-    version = db.store.version
+    version = db.store.base_version
     state = (
         version,
         db.__dict__.get("_udf_version", 0),
@@ -994,9 +1023,9 @@ def _plan_cache_entry(db, sparql: str):
     )
     slot = tent["by_state"].get(state)
     if slot is None:
-        # stale-version slots pin device-resident copies of OLD store
+        # stale-base-version slots pin device-resident copies of OLD store
         # orders (a LoweredPlan holds full sorted-store copies): drop
-        # them, keeping only the live version's udf/mode variants (same
+        # them, keeping only the live base's udf/mode variants (same
         # policy as dist_query's _dist_cap_cache)
         for k in [k for k in tent["by_state"] if k[0] != version]:
             tent["by_state"].pop(k)
@@ -1005,6 +1034,8 @@ def _plan_cache_entry(db, sparql: str):
             "lowered": None,
             "params": params,
             "ordered_failed": False,
+            "unresolved": _unresolved_params(db, params),
+            "quoted_n": len(db.quoted),
         }
         tent["by_state"][state] = slot
         while len(tent["by_state"]) > _PLAN_STATES_MAX:
@@ -1025,13 +1056,47 @@ def _plan_cache_entry(db, sparql: str):
         slot["plan"] = None
         slot["lowered"] = False if failed else None
         slot["params"] = params
+        slot["unresolved"] = _unresolved_params(db, params)
+        slot["quoted_n"] = len(db.quoted)
         stats["param_rebinds"] += 1
         tent["misses"] += 1
         _PLAN_CACHE_REBIND.inc()
     else:
-        stats["hits"] += 1
-        tent["hits"] += 1
-        _PLAN_CACHE_HIT.inc()
+        # same binding — but a constant that was UNKNOWN when the slot's
+        # plan was built may have been interned by an insert since (only
+        # delta_epoch moved, so the state key didn't): the embedded
+        # can-never-match sentinel is now wrong.  Rebind exactly like a
+        # parameter change: host-side rebuild, no device recompile.
+        rebind = False
+        unres = slot.get("unresolved", ())
+        if unres:
+            still = _unresolved_params(db, unres)
+            if len(still) != len(unres):
+                slot["unresolved"] = still
+                rebind = True
+        if not rebind and slot.get("quoted_n") != len(db.quoted):
+            # unknown quoted-triple ids resolve through db.quoted, not the
+            # dictionary; only plans that actually embed one need a rebuild
+            low = slot["lowered"]
+            if low is not None and low is not False:
+                checks = getattr(low, "const_checks", ()) or ()
+                scans = getattr(low, "scan_descs", ()) or ()
+                if any(t is None for cc in checks for t in cc) or any(
+                    c is not None and c < 0 for _n, cs in scans for c in cs
+                ):
+                    rebind = True
+            slot["quoted_n"] = len(db.quoted)
+        if rebind:
+            failed = slot["lowered"] is False
+            slot["plan"] = None
+            slot["lowered"] = False if failed else None
+            stats["param_rebinds"] += 1
+            tent["misses"] += 1
+            _PLAN_CACHE_REBIND.inc()
+        else:
+            stats["hits"] += 1
+            tent["hits"] += 1
+            _PLAN_CACHE_HIT.inc()
     return ent, slot
 
 

@@ -533,11 +533,13 @@ class SparqlDatabase:
 
     def get_or_build_stats(self):
         """Sampled cardinality stats for the optimizer (built lazily, cached
-        per store version).  Parity: ``sparql_database.rs:202`` →
+        per store BASE version — stats guide plan choice, so small delta
+        drift is tolerable and re-sampling per mutation batch is not).
+        Parity: ``sparql_database.rs:202`` →
         ``stats/database_stats.rs:43``."""
         from kolibrie_tpu.optimizer.stats import DatabaseStats
 
-        v = self.store.version
+        v = self.store.base_version
         if self._stats is None or self._stats_version != v:
             self._stats = DatabaseStats.gather_stats_fast(self)
             self._stats_version = v

@@ -102,7 +102,13 @@ class SimpleR2R(R2ROperator):
 
     def materialize(self) -> List[Triple]:
         """Evict the previous firing's derived facts, run the semi-naive
-        closure, track the new derived facts (simple_r2r.rs:103-128)."""
+        closure, track the new derived facts (simple_r2r.rs:103-128).
+
+        The evictions are buffered store deletes: together with the
+        firing's arrivals they form one delete+insert delta that the store
+        applies incrementally on the next compaction (per-order merge
+        insert + tombstones — ``docs/STORE.md``), so a window slide costs
+        O(delta), not O(store)."""
         for t in self._derived_prev:
             self.db.delete_triple(t)
         self._derived_prev = []
@@ -423,7 +429,13 @@ class IncrementalR2R(SimpleR2R):
     def feed_window(self, window_iri: str, width: int, items) -> None:
         """Reconcile one window's full content (``(item, event_ts)`` pairs)
         against the previous firing: new/improved facts join the pending
-        delta, vanished facts advance the prune clock and leave the db."""
+        delta, vanished facts advance the prune clock and leave the db.
+
+        Both the adds and the eviction deletes are buffered store
+        mutations — disjoint delete+insert traffic (the window-slide
+        shape) stays buffered and lands as ONE incremental delta at the
+        next compaction, leaving cached device plans and sort orders
+        intact (see ``docs/STORE.md``)."""
         bucket = self._buckets.setdefault(window_iri, {})
         seen = set()
         for item, ets in items:

@@ -1,5 +1,5 @@
 """Parameterized plan templates: fingerprint stability, the re-keyed plan
-cache (LRU bounds, store-version invalidation, sticky failure sentinels),
+cache (LRU bounds, base-version slot keying, sticky failure sentinels),
 the no-recompile guarantee across constant-variants, and the batched
 same-template dispatch.
 
@@ -138,18 +138,29 @@ def test_template_cache_lru_eviction(monkeypatch):
     assert sorted(rows) == sorted(host_rows(db, queries[0]))
 
 
-def test_store_version_invalidates_slot():
+def test_store_mutation_rides_cached_slot():
     db = employee_db(50)
     q = PREFIXES + 'SELECT ?e WHERE { ?e ex:dept "deptX" }'
     assert execute_query_volcano(q, db) == []
     db.parse_ntriples(
         '<http://example.org/new> <http://example.org/dept> "deptX" .'
     )
+    # a small mutation batch advances only delta_epoch: the cached slot
+    # (keyed on base_version) is REUSED, yet the new row is visible
     rows = execute_query_volcano(q, db)
     assert rows == [["http://example.org/new"]]
-    # only the live store version's state slots are retained
     tent = next(iter(db._template_cache.values()))
-    assert all(k[0] == db.store.version for k in tent["by_state"])
+    assert all(k[0] == db.store.base_version for k in tent["by_state"])
+    # a full rebuild (bulk load >> store size) moves base_version and
+    # retires the stale slots
+    bulk = "\n".join(
+        f'<http://example.org/b{i}> <http://example.org/dept> "deptX" .'
+        for i in range(5000)
+    )
+    db.parse_ntriples(bulk)
+    assert len(execute_query_volcano(q, db)) == 5001
+    tent = next(iter(db._template_cache.values()))
+    assert all(k[0] == db.store.base_version for k in tent["by_state"])
 
 
 # ------------------------------------------------------- sticky fail sentinel

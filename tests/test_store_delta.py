@@ -1,0 +1,416 @@
+"""Incremental mutation pipeline: per-order merge-insert maintenance,
+the two-tier base+delta device segments, `(base_version, delta_epoch)`
+cache semantics, and the zero-recompile guarantee for small mutation
+batches riding a cached plan template.
+
+The load-bearing properties under test:
+
+- every mutation path (add / add_batch / remove, in any interleaving)
+  yields EXACTLY the canonical columns and six sorted orders a
+  from-scratch rebuild would — incremental maintenance is invisible;
+- the base segment + tombstones + delta segment reconstruct the live
+  store for every order, across delta→base merge boundaries and
+  snapshot/restore;
+- small mutation batches never change device operand shapes, so the
+  compiled plan cache stays flat while results track the mutations.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu.core.store import ColumnarTripleStore, _pack2
+
+_ORDER_PERMS = ColumnarTripleStore._ORDER_PERMS
+from kolibrie_tpu.core.triple import Triple
+from kolibrie_tpu.query.executor import execute_query_volcano
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+PREFIXES = """PREFIX ex: <http://example.org/>
+"""
+
+
+def _oracle_rows(oracle: set) -> np.ndarray:
+    """Canonical (SPO-lexsorted unique) row matrix of a set-of-tuples."""
+    if not oracle:
+        return np.empty((0, 3), np.uint32)
+    arr = np.array(sorted(oracle), np.uint32)
+    return arr
+
+
+def _check_canonical(store: ColumnarTripleStore, oracle: set):
+    s, p, o = store.columns()
+    exp = _oracle_rows(oracle)
+    got = np.stack([s, p, o], axis=1) if len(s) else np.empty((0, 3), np.uint32)
+    assert np.array_equal(got, exp), "canonical columns diverged from oracle"
+
+
+def _check_orders(store: ColumnarTripleStore, oracle: set):
+    """All six sorted orders must equal a fresh lexsort of the live rows."""
+    s, p, o = store.columns()
+    cols = {"s": s, "p": p, "o": o}
+    for name, perm in _ORDER_PERMS.items():
+        so = store.order(name)
+        c0, c1, c2 = (cols[perm[0]], cols[perm[1]], cols[perm[2]])
+        idx = np.lexsort((c2, c1, c0))
+        assert np.array_equal(so.c0, c0[idx]), f"{name}.c0"
+        assert np.array_equal(so.c1, c1[idx]), f"{name}.c1"
+        assert np.array_equal(so.c2, c2[idx]), f"{name}.c2"
+        assert np.array_equal(so.key01, _pack2(so.c0, so.c1)), f"{name}.key01"
+
+
+def _check_segments(store: ColumnarTripleStore, oracle: set):
+    """base − tombstones + delta must reconstruct the live order rows."""
+    for name, perm in _ORDER_PERMS.items():
+        bo = store.base_order(name)
+        dp = store.delta_del_positions(name)
+        do = store.delta_order(name)
+        keep = np.ones(len(bo), bool)
+        keep[dp] = False
+        rows = np.stack(
+            [
+                np.concatenate([bo.c0[keep], do.c0]),
+                np.concatenate([bo.c1[keep], do.c1]),
+                np.concatenate([bo.c2[keep], do.c2]),
+            ],
+            axis=1,
+        )
+        idx = np.lexsort((rows[:, 2], rows[:, 1], rows[:, 0]))
+        live = store.order(name)
+        exp = np.stack([live.c0, live.c1, live.c2], axis=1)
+        assert np.array_equal(rows[idx], exp), f"segment reconstruction {name}"
+
+
+def _check_device_segments(store: ColumnarTripleStore):
+    """The uploaded base/delta mirrors must match their host twins, with
+    sentinel padding beyond the live ranges."""
+    for name in ("spo", "pos"):
+        bcols, dcols, del_pos = store.device_segment(name)
+        bo = store.base_order(name)
+        do = store.delta_order(name)
+        dp = store.delta_del_positions(name)
+        perm = _ORDER_PERMS[name]
+        pos_of = {"s": 0, "p": 1, "o": 2}
+        b_np = [np.asarray(c) for c in bcols]
+        # base mirror holds CANONICAL (s,p,o) columns permuted by the order
+        host = {0: bo.c0, 1: bo.c1, 2: bo.c2}
+        for k, axis in enumerate(perm):
+            col = b_np[pos_of[axis]]
+            n = len(bo)
+            assert np.array_equal(col[:n], host[k]), f"device base {name}/{axis}"
+            assert np.all(col[n:] == 0xFFFFFFFF), f"base padding {name}"
+        d_np = [np.asarray(c) for c in dcols]
+        for k, axis in enumerate(perm):
+            col = d_np[pos_of[axis]]
+            n = len(do)
+            assert np.array_equal(col[:n], getattr(do, f"c{k}")), (
+                f"device delta {name}/{axis}"
+            )
+            assert np.all(col[n:] == 0xFFFFFFFF), f"delta padding {name}"
+        dpn = np.asarray(del_pos)
+        assert np.array_equal(dpn[: len(dp)], dp), f"device del_pos {name}"
+        assert np.all(dpn[len(dp):] == 0xFFFFFFFF), f"del_pos padding {name}"
+
+
+def _rand_triple(rng) -> tuple:
+    return (rng.randrange(1, 40), rng.randrange(1, 8), rng.randrange(1, 40))
+
+
+# ------------------------------------------------------------- fuzz oracle
+
+
+def test_interleaved_mutation_fuzz():
+    rng = random.Random(0xC0FFEE)
+    store = ColumnarTripleStore()
+    store.delta_threshold = 48  # force several delta→base merges
+    oracle: set = set()
+    snap = None
+    snap_oracle = None
+    merges = 0
+    last_base = store.base_version
+
+    for step in range(220):
+        op = rng.random()
+        if op < 0.35:
+            t = _rand_triple(rng)
+            store.add(*t)
+            oracle.add(t)
+        elif op < 0.6:
+            rows = [_rand_triple(rng) for _ in range(rng.randrange(1, 12))]
+            arr = np.array(rows, np.uint32)
+            store.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
+            oracle.update(map(tuple, rows))
+        elif op < 0.85:
+            if oracle and rng.random() < 0.7:
+                t = rng.choice(sorted(oracle))
+            else:
+                t = _rand_triple(rng)
+            store.remove(*t)
+            oracle.discard(t)
+        elif op < 0.95:
+            store.compact()
+        else:
+            if snap is None:
+                snap = store.snapshot()
+                snap_oracle = set(oracle)
+            else:
+                store.restore(snap)
+                oracle = set(snap_oracle)
+                snap = None
+
+        if step % 17 == 0:
+            _check_canonical(store, oracle)
+            _check_orders(store, oracle)
+            _check_segments(store, oracle)
+        if store.base_version != last_base:
+            merges += 1
+            last_base = store.base_version
+
+    _check_canonical(store, oracle)
+    _check_orders(store, oracle)
+    _check_segments(store, oracle)
+    _check_device_segments(store)
+    assert merges >= 1, "fuzz never crossed a delta→base merge boundary"
+
+    # mutation after restore must not corrupt anything the snapshot shares
+    store.restore(snap) if snap is not None else None
+
+
+def test_fuzz_matches_full_rebuild_oracle():
+    """The incremental store must be state-identical to a twin running the
+    full-rebuild path on the same mutation stream."""
+    rng = random.Random(42)
+    inc = ColumnarTripleStore()
+    inc.delta_threshold = 32
+    full = ColumnarTripleStore()
+    full.incremental = False
+    for _ in range(150):
+        r = rng.random()
+        if r < 0.5:
+            t = _rand_triple(rng)
+            inc.add(*t)
+            full.add(*t)
+        elif r < 0.8:
+            rows = np.array(
+                [_rand_triple(rng) for _ in range(rng.randrange(1, 8))],
+                np.uint32,
+            )
+            inc.add_batch(rows[:, 0], rows[:, 1], rows[:, 2])
+            full.add_batch(rows[:, 0], rows[:, 1], rows[:, 2])
+        else:
+            t = _rand_triple(rng)
+            inc.remove(*t)
+            full.remove(*t)
+    si, fi = inc.columns(), full.columns()
+    for a, b in zip(si, fi):
+        assert np.array_equal(a, b)
+    for name in _ORDER_PERMS:
+        oi, of = inc.order(name), full.order(name)
+        assert np.array_equal(oi.c0, of.c0) and np.array_equal(oi.c2, of.c2)
+
+
+# ------------------------------------------------- buffered-delete semantics
+
+
+def test_add_batch_disjoint_delete_stays_buffered():
+    store = ColumnarTripleStore()
+    store.add(1, 2, 3)
+    store.add(4, 5, 6)
+    store.compact()
+    v0 = store._version  # raw: the version property itself compacts
+    store.remove(1, 2, 3)
+    # disjoint insert batch: must NOT force the pending delete to compact
+    arr = np.array([[7, 8, 9], [10, 11, 12]], np.uint32)
+    store.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
+    assert store._pending_del, "disjoint batch flushed the delete buffer"
+    assert store._version == v0, "disjoint batch triggered a compaction"
+    store.compact()
+    assert store.triples_set() == {(4, 5, 6), (7, 8, 9), (10, 11, 12)}
+
+
+def test_add_batch_intersecting_delete_compacts_first():
+    store = ColumnarTripleStore()
+    store.add(1, 2, 3)
+    store.compact()
+    store.remove(1, 2, 3)
+    # re-adding the deleted row via a batch must apply the delete FIRST so
+    # the later add wins (chronological semantics)
+    arr = np.array([[1, 2, 3]], np.uint32)
+    store.add_batch(arr[:, 0], arr[:, 1], arr[:, 2])
+    store.compact()
+    assert store.contains(1, 2, 3)
+
+
+def test_remove_then_readd_single():
+    store = ColumnarTripleStore()
+    store.add(1, 2, 3)
+    store.compact()
+    store.remove(1, 2, 3)
+    store.add(1, 2, 3)  # add() discards the pending delete for this row
+    store.compact()
+    assert store.contains(1, 2, 3)
+
+
+# ------------------------------------------------------ triples_set memoing
+
+
+def test_triples_set_incremental_carry():
+    store = ColumnarTripleStore()
+    store.add_batch(
+        np.arange(1, 101, dtype=np.uint32),
+        np.full(100, 7, np.uint32),
+        np.arange(201, 301, dtype=np.uint32),
+    )
+    s0 = store.triples_set()
+    assert len(s0) == 100
+    frozen = set(s0)
+    store.add(999, 7, 999)
+    store.remove(1, 7, 201)
+    s1 = store.triples_set()
+    assert (999, 7, 999) in s1 and (1, 7, 201) not in s1
+    assert len(s1) == 100
+    # the previously returned set must not have been mutated in place
+    assert frozen == s0
+    assert s0 is not s1
+
+
+def test_snapshot_restore_preserves_delta_state():
+    store = ColumnarTripleStore()
+    store.delta_threshold = 1024
+    store.add_batch(
+        np.arange(1, 51, dtype=np.uint32),
+        np.full(50, 3, np.uint32),
+        np.arange(1, 51, dtype=np.uint32),
+    )
+    store.compact()
+    bv = store.base_version
+    store.add(200, 3, 200)
+    store.remove(1, 3, 1)
+    store.compact()
+    assert store.base_version == bv  # small delta: base frozen
+    assert store.delta_epoch >= 1
+    snap = store.snapshot()
+    n0 = len(store)
+    store.add(201, 3, 201)
+    store.compact()
+    assert len(store) == n0 + 1
+    store.restore(snap)
+    assert len(store) == n0
+    assert store.base_version == bv
+    assert store.contains(200, 3, 200) and not store.contains(1, 3, 1)
+    # post-restore mutation works and stays consistent
+    store.add(202, 3, 202)
+    store.compact()
+    assert store.contains(202, 3, 202)
+    _check_segments(store, store.triples_set())
+
+
+# -------------------------------------------------------- no-recompile gate
+
+
+def _employee_db(n=300) -> SparqlDatabase:
+    db = SparqlDatabase()
+    lines = []
+    for i in range(n):
+        e = f"<http://example.org/e{i}>"
+        lines.append(f'{e} <http://example.org/dept> "dept{i % 5}" .')
+        lines.append(f'{e} <http://example.org/salary> "{20 + (i % 50)}" .')
+    db.parse_ntriples("\n".join(lines))
+    db.execution_mode = "device"
+    return db
+
+
+def _host_rows(db, q):
+    mode = db.execution_mode
+    db.execution_mode = "host"
+    try:
+        return execute_query_volcano(q, db)
+    finally:
+        db.execution_mode = mode
+
+
+def test_no_recompile_across_mutation_batches():
+    """ISSUE 4 acceptance gate: the jit compile count must stay flat while
+    a cached template executes across >= 20 interleaved small mutation
+    batches (inserts AND window-evict deletes) under the delta threshold —
+    scan shapes ride (base_cap, delta_cap) and per-ID operands are padded,
+    so nothing retraces."""
+    from kolibrie_tpu.optimizer.device_engine import device_compile_stats
+
+    db = _employee_db(300)
+    db.store.delta_threshold = 512
+    q = (
+        PREFIXES
+        + 'SELECT ?e ?s WHERE { ?e ex:dept "dept0" . ?e ex:salary ?s . '
+        + "FILTER(?s > 25) }"
+    )
+    rows0 = execute_query_volcano(q, db)
+    assert sorted(map(tuple, rows0)) == sorted(map(tuple, _host_rows(db, q)))
+    stats0 = dict(device_compile_stats())
+
+    added = []
+    for b in range(22):
+        ent = f"http://example.org/new{b}"
+        db.parse_ntriples(
+            f'<{ent}> <http://example.org/dept> "dept0" .\n'
+            f'<{ent}> <http://example.org/salary> "{30 + b}" .\n'
+        )
+        added.append(
+            (
+                db.encode_term_str(f"<{ent}>"),
+                db.encode_term_str("<http://example.org/dept>"),
+                db.encode_term_str('"dept0"'),
+            )
+        )
+        if b >= 2:
+            # window-evict shape: delete the entity streamed two batches ago
+            db.delete_triple(Triple(*added[b - 2]))
+        rows = execute_query_volcano(q, db)
+        assert sorted(map(tuple, rows)) == sorted(
+            map(tuple, _host_rows(db, q))
+        ), f"device/host divergence at batch {b}"
+
+    stats1 = dict(device_compile_stats())
+    assert stats1 == stats0, f"recompile detected: {stats0} -> {stats1}"
+
+    # crossing the merge threshold is ALLOWED to retrace (rare full upload)
+    # but must stay correct
+    bulk = "".join(
+        f'<http://example.org/bulk{i}> <http://example.org/dept> "dept0" .\n'
+        f'<http://example.org/bulk{i}> <http://example.org/salary> "{40 + (i % 10)}" .\n'
+        for i in range(600)
+    )
+    db.parse_ntriples(bulk)
+    rows = execute_query_volcano(q, db)
+    assert sorted(map(tuple, rows)) == sorted(map(tuple, _host_rows(db, q)))
+
+
+# ------------------------------------------------------------- obs counters
+
+
+def test_store_metrics_exposed():
+    """The mutation-pipeline counters must land in the default registry
+    (the same one GET /metrics renders)."""
+    from kolibrie_tpu.obs import export as obs_export
+
+    store = ColumnarTripleStore()
+    store.delta_threshold = 8
+    store.add_batch(
+        np.arange(1, 31, dtype=np.uint32),
+        np.full(30, 2, np.uint32),
+        np.arange(1, 31, dtype=np.uint32),
+    )
+    store.compact()
+    for i in range(12):  # overflow the tiny threshold -> at least one merge
+        store.add(100 + i, 2, 100 + i)
+        store.compact()
+    store.device_segment("spo")
+    text = obs_export.render_prometheus()
+    for name in (
+        "kolibrie_store_h2d_bytes_total",
+        "kolibrie_store_delta_merges_total",
+        "kolibrie_store_order_rebuilds_total",
+        "kolibrie_store_delta_rows",
+    ):
+        assert name in text, f"{name} missing from /metrics exposition"
