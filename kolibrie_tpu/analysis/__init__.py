@@ -1,0 +1,33 @@
+"""kolint — repo-native static analysis for kolibrie-tpu.
+
+The serving stack's correctness invariants (template-stable compiled
+shapes, deadline/trace context across thread hops, bounded metric
+cardinality, the shared error taxonomy, lock discipline around shared
+mutable state) are enforced by convention; this package machine-checks
+them.  Stdlib ``ast``/``tokenize`` only — no new dependencies.
+
+Entry points:
+
+- ``python -m kolibrie_tpu.analysis [--json] [--baseline PATH] [paths…]``
+- :func:`run` — programmatic API used by ``tests/test_kolint.py``.
+
+Rule catalog and the suppression/baseline workflow: ``docs/ANALYSIS.md``.
+"""
+
+from kolibrie_tpu.analysis.core import (
+    Finding,
+    RULES,
+    default_baseline_path,
+    load_baseline,
+    run,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "default_baseline_path",
+    "load_baseline",
+    "run",
+    "write_baseline",
+]

@@ -1,0 +1,132 @@
+"""kolint CLI.
+
+    python -m kolibrie_tpu.analysis [paths...]        lint (against baseline)
+    python -m kolibrie_tpu.analysis --json            machine-readable output
+    python -m kolibrie_tpu.analysis --no-baseline     raw findings
+    python -m kolibrie_tpu.analysis --write-baseline  regenerate baseline
+    python -m kolibrie_tpu.analysis --list-rules      rule catalog
+
+Exit status: 0 when no non-baselined findings remain, 1 otherwise,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kolibrie_tpu.analysis import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kolibrie_tpu.analysis",
+        description="kolint: repo-native static analysis for tracing, "
+        "recompile, lock-discipline, and observability invariants.",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the kolibrie_tpu "
+        "package)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument(
+        "--baseline",
+        metavar="PATH",
+        default=None,
+        help="baseline file (default: <repo>/kolint_baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report all findings, ignoring the baseline",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current (post-suppression) findings as the baseline",
+    )
+    ap.add_argument(
+        "--rules",
+        metavar="IDS",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    # import for registration before --list-rules
+    from kolibrie_tpu.analysis import (  # noqa: F401
+        rules_context,
+        rules_errors,
+        rules_locks,
+        rules_obs,
+        rules_tracing,
+    )
+
+    if args.list_rules:
+        for rid in sorted(core.RULES):
+            desc, _ = core.RULES[rid]
+            print(f"{rid}  {desc}")
+        print(f"{core.META_SUPPRESSION}  suppression directive malformed "
+              "(no reason / unknown rule)")
+        print(f"{core.META_PARSE}  file does not parse")
+        return 0
+
+    paths = args.paths or [
+        os.path.join(core.repo_root(), "kolibrie_tpu")
+    ]
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in core.RULES]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or core.default_baseline_path()
+    result = core.run(
+        paths,
+        baseline_path=baseline_path,
+        use_baseline=not (args.no_baseline or args.write_baseline),
+        rules=rule_ids,
+    )
+
+    if args.write_baseline:
+        core.write_baseline(baseline_path, result.findings)
+        print(
+            f"wrote {len(result.findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in result.findings],
+                    "suppressed": len(result.suppressed),
+                    "baselined": len(result.baselined),
+                    "ok": result.ok,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in result.findings:
+            print(f.render())
+        tail = (
+            f"{len(result.findings)} finding(s), "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.baselined)} baselined"
+        )
+        print(tail)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Rule family 5: observability hygiene.
+
+obs/metrics.py's registry enforces no cardinality bound — call sites
+must (its own docstring says so).  The repo's conventions: route labels
+are clamped to a known set before labeling, template fingerprints are
+bounded by the plan cache upstream, and spans are only opened through
+``with span(…)`` so every open has a scope exit.
+
+KL501  metric label value not provably drawn from a bounded set
+       (f-string / format / dict lookup / subscript as a label value)
+KL502  span(…) opened without a `with` scope — the span never exits,
+       never lands in the ring, and corrupts the parent stack
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from kolibrie_tpu.analysis.core import Finding, rule
+from kolibrie_tpu.analysis.project import (
+    Project,
+    iter_own_nodes,
+    terminal_name,
+)
+
+def _label_value_ok(expr: ast.AST) -> bool:
+    """Conservatively bounded label expressions: literals, plain names/
+    attributes (assumed clamped upstream — the rule targets *syntactic*
+    unboundedness), str()/int() of those, `x or "fallback"`, and
+    conditional picks between bounded branches."""
+    if isinstance(expr, (ast.Constant, ast.Name, ast.Attribute)):
+        return True
+    if isinstance(expr, ast.IfExp):
+        return _label_value_ok(expr.body) and _label_value_ok(expr.orelse)
+    if isinstance(expr, ast.BoolOp):
+        return all(_label_value_ok(v) for v in expr.values)
+    if isinstance(expr, ast.Call):
+        fn = terminal_name(expr.func)
+        if fn in ("str", "int") and len(expr.args) == 1:
+            return _label_value_ok(expr.args[0])
+    return False
+
+
+@rule(
+    "KL501",
+    "metric label value not provably bounded (f-string/format/"
+    "subscript/dict-get as a .labels() argument mints unbounded series)",
+)
+def unbounded_label(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for info in f.functions.values():
+            for node in iter_own_nodes(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"
+                ):
+                    continue
+                for arg in node.args:
+                    if not _label_value_ok(arg):
+                        out.append(
+                            Finding(
+                                "KL501",
+                                f.rel,
+                                node.lineno,
+                                "label value is a computed string "
+                                "(f-string/format/lookup); clamp it to a "
+                                "bounded set first (route-clamp pattern, "
+                                "frontends/http_server.py do_POST)",
+                                scope=info.qualname,
+                            )
+                        )
+                        break
+    return out
+
+
+@rule(
+    "KL502",
+    "span(...) opened outside a `with` statement — no scope exit, the "
+    "span never finishes and the parent stack leaks",
+)
+def span_without_scope(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        # `span` imported from obs.spans under any local alias
+        # (executor uses `span as _obs_span`)
+        span_aliases = {
+            alias
+            for alias, (mod, name) in f.imports.items()
+            if name == "span" and "spans" in mod
+        }
+        if not span_aliases:
+            continue
+        for info in f.functions.values():
+            parents = {}
+            for node in iter_own_nodes(info.node):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            for node in iter_own_nodes(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in span_aliases
+                ):
+                    continue
+                p = parents.get(node)
+                if isinstance(p, ast.withitem):
+                    continue
+                out.append(
+                    Finding(
+                        "KL502",
+                        f.rel,
+                        node.lineno,
+                        f"{node.func.id}(…) called outside `with`; use "
+                        "`with span(name):` so the scope always exits",
+                        scope=info.qualname,
+                    )
+                )
+    return out

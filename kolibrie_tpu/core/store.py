@@ -64,7 +64,8 @@ try:  # obs is stdlib-only and imports nothing from the engine (no cycle)
         "kolibrie_store_delta_rows",
         "Current delta occupancy (add rows + tombstones vs the base segment).",
     )
-except Exception:  # pragma: no cover - obs must never block the store
+# kolint: ignore[KL601] import-time obs registration must never block the store; the None sentinels disable instrumentation and every call site guards on them
+except Exception:  # pragma: no cover
     _H2D_BYTES = _DELTA_MERGES = _ORDER_REBUILDS = _DELTA_ROWS = None
 
 

@@ -98,6 +98,15 @@ _BATCH_SIZE = obs_metrics.histogram(
     "requests riding one dispatch",
     buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
 )
+_BATCH_FALLBACKS = obs_metrics.counter(
+    "kolibrie_batcher_fallback_total",
+    "batched dispatches that failed and fell back to solo retries",
+)
+_SESSION_CKPT_FAILURES = obs_metrics.counter(
+    "kolibrie_session_checkpoint_failures_total",
+    "RSP session checkpoint/restore attempts that failed",
+    labels=("op",),
+)
 _BATCH_DISPATCH_LAT = obs_metrics.histogram(
     "kolibrie_batcher_dispatch_seconds",
     "batch dispatch wall time by template fingerprint",
@@ -160,15 +169,15 @@ class EngineSession:
     def __init__(self, engine, streams: List[str]):
         self.engine = engine
         self.streams = streams
-        self.results: List[List[List[str]]] = []
-        self.subscribers: List["queue.Queue[str]"] = []
+        self.results: List[List[List[str]]] = []  # guarded by: lock
+        self.subscribers: List["queue.Queue[str]"] = []  # guarded by: lock
         self.lock = threading.Lock()
         # serializes engine mutation: the RSP engine's single-thread drain
         # path is not safe under concurrent /rsp/push handler threads
         self.push_lock = threading.Lock()
-        self.dropped_subscribers = 0  # pruned dead/stalled SSE queues
-        self.crash_recoveries = 0  # WindowCrash → checkpoint restores
-        self.last_checkpoint: Optional[bytes] = None
+        self.dropped_subscribers = 0  # guarded by: lock
+        self.crash_recoveries = 0  # guarded by: push_lock
+        self.last_checkpoint: Optional[bytes] = None  # guarded by: push_lock
 
     def emit(self, row: Tuple[Tuple[str, str], ...]) -> None:
         table = results_to_table([row])
@@ -205,16 +214,18 @@ class EngineSession:
 
     # --------------------------------------------------- crash recovery
 
-    def maybe_checkpoint(self) -> None:
+    def maybe_checkpoint(self) -> None:  # kolint: holds[push_lock]
         """Snapshot engine state after a successful push (caller holds
         ``push_lock``).  Failures are non-fatal: a stale checkpoint only
         widens the at-least-once replay window on the next recovery."""
         try:
             self.last_checkpoint = self.engine.checkpoint_state()
         except Exception:
-            pass
+            # non-fatal, but never silent: an operator watching this
+            # counter climb knows recovery will replay a widening window
+            _SESSION_CKPT_FAILURES.labels("checkpoint").inc()
 
-    def recover(self) -> bool:
+    def recover(self) -> bool:  # kolint: holds[push_lock]
         """Restore the engine from the last good checkpoint after a
         WindowCrash (caller holds ``push_lock``).  Returns whether the
         session is serving again."""
@@ -223,6 +234,7 @@ class EngineSession:
         try:
             self.engine.restore_state(self.last_checkpoint)
         except Exception:
+            _SESSION_CKPT_FAILURES.labels("restore").inc()
             return False
         self.crash_recoveries += 1
         return True
@@ -271,15 +283,15 @@ class TemplateBatcher:
         self.max_queue_depth = max_queue_depth
         self.lock = threading.Lock()  # guards pending + counters
         self.dispatch_lock = threading.Lock()  # serializes db access
-        self.pending: List[_BatchRequest] = []
-        self.requests = 0
-        self.dispatches = 0
-        self.dedup_hits = 0
-        self.max_batch = 0
-        self.shed_queue_full = 0
-        self.shed_deadline = 0
+        self.pending: List[_BatchRequest] = []  # guarded by: lock
+        self.requests = 0  # guarded by: lock
+        self.dispatches = 0  # guarded by: lock
+        self.dedup_hits = 0  # guarded by: lock
+        self.max_batch = 0  # guarded by: lock
+        self.shed_queue_full = 0  # guarded by: lock
+        self.shed_deadline = 0  # guarded by: lock
         # fp -> {"requests", "dedup_hits", "lat": [dispatch ms, ...]}
-        self.templates: Dict[str, dict] = {}
+        self.templates: Dict[str, dict] = {}  # guarded by: lock
 
     # ------------------------------------------------------------- dispatch
 
@@ -346,7 +358,7 @@ class TemplateBatcher:
                 loosest = r.deadline
         return loosest
 
-    def _run_batch(self, batch: List[_BatchRequest]) -> None:
+    def _run_batch(self, batch: List[_BatchRequest]) -> None:  # kolint: holds[dispatch_lock]
         from kolibrie_tpu.query.executor import (
             execute_queries_batched,
             execute_query_volcano,
@@ -368,6 +380,7 @@ class TemplateBatcher:
                 # one bad member must not fail its batch-mates: solo
                 # retries, each under its OWN deadline and trace (None
                 # masks the leader's scope)
+                _BATCH_FALLBACKS.inc()
                 for r in batch:
                     try:
                         with trace_scope(r.trace_id), deadline_scope(
@@ -384,7 +397,7 @@ class TemplateBatcher:
             r.done.set()
         self._count(batch, texts, uniq, time.perf_counter() - start)
 
-    def _count(self, batch, texts, uniq, elapsed: float) -> None:
+    def _count(self, batch, texts, uniq, elapsed: float) -> None:  # kolint: holds[dispatch_lock]
         ms = elapsed * 1000.0
         parse_cache = self.db.__dict__.get("_plan_cache", {})
         by_fp: Dict[str, List[str]] = {}
@@ -420,10 +433,10 @@ class TemplateBatcher:
 
 class _ServerState:
     def __init__(self):
-        self.sessions: Dict[str, EngineSession] = {}
-        self.stores: Dict[str, TemplateBatcher] = {}
+        self.sessions: Dict[str, EngineSession] = {}  # guarded by: lock
+        self.stores: Dict[str, TemplateBatcher] = {}  # guarded by: lock
         self.lock = threading.Lock()
-        self.counter = itertools.count(1)
+        self.counter = itertools.count(1)  # guarded by: lock
         self.admission = AdmissionController(max_inflight=MAX_INFLIGHT)
 
 

@@ -184,6 +184,7 @@ class MLSchemaConverter:
         if hasattr(model, "get_params"):
             try:
                 params = dict(model.get_params())
+            # kolint: ignore[KL601] best-effort metadata harvest from a foreign model object; empty params is the documented degraded output
             except Exception:
                 params = {}
         elif hasattr(model, "hidden"):  # MlpNeuralPredicate
@@ -255,6 +256,7 @@ class MLSchemaConverter:
                     for i, wb in enumerate(model.params)
                     for nm, arr in zip(("W", "b"), wb)
                 ]
+            # kolint: ignore[KL601] foreign model params may not be (W, b) tuples; skipping weight triples is the documented degraded output
             except Exception:
                 return
             self._add_named_params(

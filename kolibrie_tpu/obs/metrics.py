@@ -141,7 +141,7 @@ class Family:
         self.label_names = label_names
         self.buckets = buckets
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], _Child] = {}
+        self._children: Dict[Tuple[str, ...], _Child] = {}  # guarded by: _lock
         if not label_names:
             self._default = self._make_child()
             self._children[()] = self._default
@@ -160,6 +160,7 @@ class Family:
                 f"got {values!r}"
             )
         key = tuple(str(v) for v in values)
+        # kolint: ignore[KL301] double-checked locking: the lock-free read is a fast path; a miss falls through to the locked re-check below
         child = self._children.get(key)
         if child is None:
             with self._lock:
@@ -189,8 +190,8 @@ class Family:
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._families: Dict[str, Family] = {}
-        self._collectors: List[Callable[[], None]] = []
+        self._families: Dict[str, Family] = {}  # guarded by: _lock
+        self._collectors: List[Callable[[], None]] = []  # guarded by: _lock
 
     def _get_or_create(self, name: str, help: str, kind: str,
                        labels: Sequence[str],
@@ -240,8 +241,9 @@ class Registry:
         for fn in collectors:
             try:
                 fn()
+            # kolint: ignore[KL601] a broken collector must never break the scrape, and counting it here would recurse into the registry being scraped
             except Exception:
-                pass  # a broken collector must never break the scrape
+                pass
 
     def families(self) -> List[Family]:
         with self._lock:

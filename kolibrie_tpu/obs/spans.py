@@ -48,7 +48,7 @@ _tls = threading.local()
 _rand = random.Random()
 
 _ring_lock = threading.Lock()
-_ring: deque = deque(maxlen=DEFAULT_RING_CAPACITY)
+_ring: deque = deque(maxlen=DEFAULT_RING_CAPACITY)  # guarded by: _ring_lock
 
 
 class Span:

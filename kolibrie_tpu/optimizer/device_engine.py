@@ -696,7 +696,8 @@ def device_compile_stats() -> Dict[str, int]:
     ):
         try:
             out[name] = int(fn._cache_size())
-        except Exception:  # cache API absent on some jax versions
+        # kolint: ignore[KL601] jax version probe; -1 is the sentinel the stats endpoint documents for "cache API absent"
+        except Exception:
             out[name] = -1
     return out
 

@@ -58,6 +58,11 @@ _RETRIES = _obs_metrics.counter(
     "poisoned-event retries",
     labels=("window",),
 )
+_CKPT_FAILURES = _obs_metrics.counter(
+    "kolibrie_rsp_checkpoint_failures_total",
+    "supervisor checkpoint/restore attempts that failed",
+    labels=("window", "op"),
+)
 
 
 @dataclass
@@ -141,8 +146,10 @@ class WindowSupervisor:
         if due:
             try:
                 self.last_checkpoint = self.checkpoint_fn()
-            except Exception:  # noqa: BLE001 — a failed snapshot must not
-                pass  # fail the firing; the previous checkpoint stands
+            except Exception:  # a failed snapshot must not fail the
+                # firing; the previous checkpoint stands — but count it,
+                # or a permanently broken checkpoint_fn is invisible
+                _CKPT_FAILURES.labels(self.window_iri, "checkpoint").inc()
 
     def wrap(self, processor: Callable) -> Callable:
         """Single-thread (callback) mode: the registered callback IS the
@@ -201,8 +208,10 @@ class WindowSupervisor:
         if blob is not None and self.restore_fn is not None:
             try:
                 self.restore_fn(blob)
-            except Exception:  # noqa: BLE001 — a failed restore degrades
-                pass  # to restart-without-rewind, never a dead window
+            except Exception:  # a failed restore degrades to restart-
+                # without-rewind, never a dead window — counted so the
+                # silent-degradation mode shows up on a dashboard
+                _CKPT_FAILURES.labels(self.window_iri, "restore").inc()
         return True
 
     # ----------------------------------------------------------------- stats

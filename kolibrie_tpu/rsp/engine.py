@@ -310,8 +310,8 @@ class RSPEngine:
         self.cross_window_rules = cross_window_rules or []
         self.cross_window_context = cross_window_context
         self.cross_window_mode = cross_window_mode
-        self._sds_plus_state: SdsWithExpiry = {}
-        self._latest_contents: Dict[str, List[Tuple[Triple, int]]] = {}
+        self._sds_plus_state: SdsWithExpiry = {}  # guarded by: _cw_lock
+        self._latest_contents: Dict[str, List[Tuple[Triple, int]]] = {}  # guarded by: _cw_lock
         self._cw_lock = threading.Lock()
 
         # single-thread coordination state
@@ -592,6 +592,7 @@ class RSPEngine:
                     max_ts = 0
                 # Wait / Timeout: keep waiting for remaining windows
 
+        # kolint: ignore[KL401] the coordinator is engine-lifetime, not per-request: its emissions aggregate many pushes, so no single submitter trace/deadline is the right scope
         self._coordinator = threading.Thread(target=run, daemon=True)
         self._coordinator.start()
 
@@ -724,15 +725,22 @@ class RSPEngine:
         if mode == CrossWindowReasoningMode.AUTO:
             mode = self._auto_mode(sds)
         if mode == CrossWindowReasoningMode.INCREMENTAL:
+            # checkpoint_state() snapshots _sds_plus_state under _cw_lock
+            # from pusher threads; read and publish under the same lock so
+            # a checkpoint never sees a half-written cycle
+            with self._cw_lock:
+                prev_state = self._sds_plus_state
             new_state = incremental_sds_plus(
-                self.cross_window_rules, sds, self._sds_plus_state, self.dictionary, ts
+                self.cross_window_rules, sds, prev_state, self.dictionary, ts
             )
-            self._sds_plus_state = new_state
+            with self._cw_lock:
+                self._sds_plus_state = new_state
             buckets = sds_with_expiry_to_external(
                 new_state, self.dictionary, all_component_iris(sds)
             )
         else:
-            self._sds_plus_state = {}  # stale for any later incremental cycle
+            with self._cw_lock:
+                self._sds_plus_state = {}  # stale for later incremental cycles
             buckets = naive_sds_plus(
                 self.cross_window_rules, sds, self.dictionary, ts
             )

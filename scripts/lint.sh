@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Repo lint gate: kolint (against the committed baseline), a compile
+# sweep, and a check that no bytecode artifacts are tracked.
+#
+#   scripts/lint.sh            lint the package
+#   scripts/lint.sh --json     machine-readable kolint output
+#
+# Exit nonzero on any finding not covered by kolint_baseline.json, any
+# file that does not compile, or any tracked __pycache__/.pyc artifact.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== kolint =="
+python -m kolibrie_tpu.analysis "$@" kolibrie_tpu/ || rc=1
+
+echo "== compileall =="
+# -q: names only on failure; PYTHONDONTWRITEBYTECODE keeps the tree clean
+PYTHONDONTWRITEBYTECODE=1 python -m compileall -q kolibrie_tpu/ tests/ || rc=1
+
+echo "== bytecode-free tree =="
+tracked=$(git ls-files | grep -E '(__pycache__|\.pyc$)' || true)
+if [ -n "$tracked" ]; then
+    echo "tracked bytecode artifacts:" >&2
+    echo "$tracked" >&2
+    rc=1
+fi
+
+exit $rc

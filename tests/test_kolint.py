@@ -1,0 +1,664 @@
+"""kolint tests: fixture-driven known-bad/known-good pairs for every
+rule family, suppression and baseline mechanics, the CLI surface, and
+the repo-wide gate (the whole package must stay clean against the
+committed baseline) — ISSUE 5."""
+
+import json
+import os
+
+import pytest
+
+from kolibrie_tpu.analysis import core
+from kolibrie_tpu.analysis.__main__ import main as kolint_main
+
+# ------------------------------------------------------------------ helpers
+
+
+def lint(tmp_path, source: str, name: str = "mod.py", **kw):
+    """Write one module and run all rules over it, no baseline."""
+    p = tmp_path / name
+    p.write_text(source)
+    return core.run([str(p)], use_baseline=False, root=str(tmp_path), **kw)
+
+
+def rules_fired(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ------------------------------------------------- KL101: host sync in jit
+
+
+BAD_KL101 = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    return float(y.item())
+"""
+
+GOOD_KL101 = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.sum(x)
+
+def host_side(x):
+    return step(x).item()  # outside any jit region: fine
+"""
+
+
+def test_kl101_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL101)
+    assert rules_fired(res) == ["KL101"]
+    assert len(res.findings) == 1
+    assert ".item()" in res.findings[0].message
+
+
+def test_kl101_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL101)
+    assert res.findings == []
+
+
+def test_kl101_reaches_through_call_graph(tmp_path):
+    # the sync hides one call down from the jit root
+    src = """
+import jax
+
+def inner(x):
+    return x.item()
+
+@jax.jit
+def root(x):
+    return inner(x)
+"""
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == ["KL101"]
+    assert res.findings[0].scope == "inner"
+
+
+def test_kl101_shape_reads_are_static(tmp_path):
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def root(x):
+    return np.asarray(x.shape)  # shape is trace-time static
+"""
+    res = lint(tmp_path, src)
+    assert res.findings == []
+
+
+# -------------------------------------------- KL102: branch on traced value
+
+
+BAD_KL102 = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def clamp(x):
+    if x > 0:
+        return x
+    return -x
+"""
+
+GOOD_KL102 = """
+from functools import partial
+import jax
+import jax.numpy as jnp
+
+@partial(jax.jit, static_argnames=("cap",))
+def clamp(x, cap):
+    if cap > 16:  # static: part of the compilation key
+        return jnp.minimum(x, cap)
+    return x
+
+@jax.jit
+def structural(x, aux):
+    if aux is None:  # pytree-structure check, not a tracer bool
+        return x
+    for piece in aux:  # static unroll over a pytree tuple
+        x = x + piece
+    return x
+"""
+
+
+def test_kl102_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL102)
+    assert rules_fired(res) == ["KL102"]
+    assert "'x'" in res.findings[0].message
+
+
+def test_kl102_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL102)
+    assert res.findings == []
+
+
+def test_kl102_range_over_traced(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def unroll(n):
+    acc = 0
+    for i in range(n):  # tracer -> int conversion
+        acc = acc + i
+    return acc
+"""
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == ["KL102"]
+
+
+# --------------------------------------------------- KL201: jit per call
+
+
+BAD_KL201 = """
+import jax
+
+def run(xs, f):
+    return jax.jit(f)(xs)  # fresh wrapper per call: retrace every time
+"""
+
+GOOD_KL201 = """
+from functools import lru_cache, partial
+import jax
+
+@lru_cache(maxsize=None)
+def compiled(f):
+    return jax.jit(f)
+
+class Engine:
+    def __init__(self, f):
+        self._step = jax.jit(f)  # once per instance
+
+    def build(self, f):
+        self._step = jax.jit(f)  # stored on the instance: survives calls
+"""
+
+
+def test_kl201_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL201)
+    assert rules_fired(res) == ["KL201"]
+
+
+def test_kl201_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL201)
+    assert res.findings == []
+
+
+# ------------------------------------- KL202: per-call static arguments
+
+
+BAD_KL202 = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("tag",))
+def run(x, tag):
+    return x
+
+def serve(x, query_text):
+    return run(x, tag=f"q-{query_text}")  # recompile per query
+"""
+
+GOOD_KL202 = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("cap",))
+def run(x, cap):
+    return x
+
+def serve(x, base_cap):
+    return run(x, cap=base_cap)  # capacity-class value
+"""
+
+
+def test_kl202_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL202)
+    assert rules_fired(res) == ["KL202"]
+    assert "f-string" in res.findings[0].message
+
+
+def test_kl202_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL202)
+    assert res.findings == []
+
+
+# ------------------------------------------------ KL301: guarded state
+
+
+BAD_KL301 = """
+import threading
+
+class Sessions:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.live = {}  # guarded by: lock
+
+    def add(self, k, v):
+        self.live[k] = v  # missing the lock
+"""
+
+GOOD_KL301 = """
+import threading
+
+class Sessions:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.live = {}  # guarded by: lock
+
+    def add(self, k, v):
+        with self.lock:
+            self.live[k] = v
+
+    def drain(self):  # kolint: holds[lock]
+        return list(self.live)
+"""
+
+
+def test_kl301_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL301)
+    assert rules_fired(res) == ["KL301"]
+    assert "self.live" in res.findings[0].message
+
+
+def test_kl301_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL301)
+    assert res.findings == []
+
+
+def test_kl301_module_global(tmp_path):
+    src = """
+import threading
+
+_cache_lock = threading.Lock()
+_cache = {}  # guarded by: _cache_lock
+
+def put(k, v):
+    _cache[k] = v
+"""
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == ["KL301"]
+
+
+# ------------------------------------------- KL302: lock-ordering cycle
+
+
+BAD_KL302 = """
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+def forward():
+    with a_lock:
+        with b_lock:
+            pass
+
+def backward():
+    with b_lock:
+        with a_lock:
+            pass
+"""
+
+GOOD_KL302 = BAD_KL302.replace(
+    "def backward():\n    with b_lock:\n        with a_lock:",
+    "def backward():\n    with a_lock:\n        with b_lock:",
+)
+
+
+def test_kl302_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL302)
+    assert rules_fired(res) == ["KL302"]
+    assert "a_lock" in res.findings[0].message
+    assert "b_lock" in res.findings[0].message
+
+
+def test_kl302_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL302)
+    assert res.findings == []
+
+
+# --------------------------------------- KL401: context across threads
+
+
+BAD_KL401 = """
+import threading
+from kolibrie_tpu.obs.spans import span
+
+def worker():
+    with span("work"):
+        pass
+
+def kickoff():
+    t = threading.Thread(target=worker)
+    t.start()
+"""
+
+GOOD_KL401 = """
+import threading
+from kolibrie_tpu.obs.spans import current_trace_id, span, trace_scope
+
+def worker(trace_id):
+    with trace_scope(trace_id):
+        with span("work"):
+            pass
+
+def kickoff():
+    trace_id = current_trace_id()
+    t = threading.Thread(target=lambda: worker(trace_id))
+    t.start()
+"""
+
+
+def test_kl401_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL401)
+    assert rules_fired(res) == ["KL401"]
+    assert "worker" in res.findings[0].message
+
+
+def test_kl401_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL401)
+    assert res.findings == []
+
+
+# ------------------------------------------------ KL501: label hygiene
+
+
+BAD_KL501 = """
+from kolibrie_tpu.obs import metrics
+
+REQS = metrics.counter("reqs_total", "requests", labels=("route",))
+
+def handle(path):
+    REQS.labels(f"route-{path}").inc()  # unbounded series
+"""
+
+GOOD_KL501 = """
+from kolibrie_tpu.obs import metrics
+
+REQS = metrics.counter("reqs_total", "requests", labels=("route",))
+KNOWN = {"/query", "/stats"}
+
+def handle(path):
+    route = path if path in KNOWN else "other"
+    REQS.labels(route).inc()
+"""
+
+
+def test_kl501_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL501)
+    assert rules_fired(res) == ["KL501"]
+
+
+def test_kl501_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL501)
+    assert res.findings == []
+
+
+# -------------------------------------------- KL502: span without scope
+
+
+BAD_KL502 = """
+from kolibrie_tpu.obs.spans import span
+
+def work():
+    s = span("work")  # never exits: leaks the parent stack
+    return s
+"""
+
+GOOD_KL502 = """
+from kolibrie_tpu.obs.spans import span
+
+def work():
+    with span("work"):
+        return 1
+"""
+
+
+def test_kl502_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL502)
+    assert rules_fired(res) == ["KL502"]
+
+
+def test_kl502_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL502)
+    assert res.findings == []
+
+
+# ------------------------------------------- KL601: swallowed exception
+
+
+BAD_KL601 = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        pass
+"""
+
+GOOD_KL601 = """
+from kolibrie_tpu.obs import metrics
+
+FAILS = metrics.counter("load_failures_total", "failed loads")
+
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        FAILS.inc()
+        return None
+
+def narrow(path):
+    try:
+        return open(path).read()
+    except FileNotFoundError:
+        return None  # narrow except: the taxonomy rule leaves it alone
+"""
+
+
+def test_kl601_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL601)
+    assert rules_fired(res) == ["KL601"]
+
+
+def test_kl601_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL601)
+    assert res.findings == []
+
+
+def test_kl601_module_level_handler(tmp_path):
+    src = """
+try:
+    import optionaldep
+except Exception:
+    optionaldep = None
+"""
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == ["KL601"]
+    assert res.findings[0].scope == "<module>"
+
+
+def test_kl601_stored_exception_counts_as_surfaced(tmp_path):
+    src = """
+def dispatch(req):
+    try:
+        req.result = run(req)
+    except Exception as e:
+        req.error = e  # re-raised by the waiter
+    req.done.set()
+"""
+    res = lint(tmp_path, src)
+    assert res.findings == []
+
+
+# ------------------------------------------------ suppression mechanics
+
+
+def test_suppression_with_reason_is_green(tmp_path):
+    src = BAD_KL601.replace(
+        "    except Exception:",
+        "    # kolint: ignore[KL601] fixture: probe file may not exist\n"
+        "    except Exception:",
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].rule == "KL601"
+
+
+def test_suppression_same_line(tmp_path):
+    src = BAD_KL601.replace(
+        "    except Exception:",
+        "    except Exception:  # kolint: ignore[KL601] fixture probe",
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = BAD_KL601.replace(
+        "    except Exception:",
+        "    except Exception:  # kolint: ignore[KL601]",
+    )
+    res = lint(tmp_path, src)
+    fired = rules_fired(res)
+    # the malformed directive is itself flagged AND the original finding
+    # stays live — a reasonless ignore must never buy a pass
+    assert core.META_SUPPRESSION in fired
+    assert "KL601" in fired
+
+
+def test_suppression_unknown_rule_is_a_finding(tmp_path):
+    src = "x = 1  # kolint: ignore[KL999] no such rule\n"
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == [core.META_SUPPRESSION]
+    assert "KL999" in res.findings[0].message
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    # suppressing a DIFFERENT rule on the line leaves the finding live
+    src = BAD_KL601.replace(
+        "    except Exception:",
+        "    except Exception:  # kolint: ignore[KL101] wrong rule id",
+    )
+    res = lint(tmp_path, src)
+    assert "KL601" in rules_fired(res)
+
+
+# --------------------------------------------------- baseline mechanics
+
+
+def test_baselined_finding_stays_green(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(BAD_KL601)
+    first = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert len(first.findings) == 1
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(str(bl), first.findings)
+    again = core.run(
+        [str(p)], baseline_path=str(bl), root=str(tmp_path)
+    )
+    assert again.ok
+    assert len(again.baselined) == 1
+
+
+def test_new_finding_fails_despite_baseline(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(BAD_KL601)
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(
+        str(bl),
+        core.run([str(p)], use_baseline=False, root=str(tmp_path)).findings,
+    )
+    # a second, NEW violation appears in another function
+    p.write_text(BAD_KL601 + BAD_KL601.replace("def load", "def load2"))
+    res = core.run([str(p)], baseline_path=str(bl), root=str(tmp_path))
+    assert not res.ok
+    assert len(res.findings) == 1  # only the new one
+    assert len(res.baselined) == 1
+
+
+def test_baseline_is_line_number_stable(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(BAD_KL601)
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(
+        str(bl),
+        core.run([str(p)], use_baseline=False, root=str(tmp_path)).findings,
+    )
+    # unrelated edits above shift every line; the baseline still matches
+    p.write_text("# a new header comment\nX = 1\n" + BAD_KL601)
+    res = core.run([str(p)], baseline_path=str(bl), root=str(tmp_path))
+    assert res.ok
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    p = tmp_path / "mod.py"
+    p.write_text(BAD_KL601)
+    bl = tmp_path / "baseline.json"
+    rc = kolint_main(["--json", "--baseline", str(bl), str(p)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"][0]["rule"] == "KL601"
+    assert out["findings"][0]["line"] == 5
+
+    rc = kolint_main(["--write-baseline", "--baseline", str(bl), str(p)])
+    capsys.readouterr()
+    assert rc == 0
+    rc = kolint_main(["--baseline", str(bl), str(p)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_list_rules(capsys):
+    assert kolint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("KL101", "KL102", "KL201", "KL202", "KL301", "KL302",
+                "KL401", "KL501", "KL502", "KL601", "KL001", "KL002"):
+        assert rid in out
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    res = lint(tmp_path, "def broken(:\n")
+    assert rules_fired(res) == [core.META_PARSE]
+
+
+# ------------------------------------------------------- repo-wide gate
+
+
+def test_repo_is_clean_against_baseline():
+    """The committed tree must lint clean against the committed baseline.
+
+    A new hazard anywhere in kolibrie_tpu/ fails THIS test; the fix is
+    either the code, a reasoned `# kolint: ignore[...]`, or (for
+    deliberate grandfathering) a baseline regeneration in the same PR.
+    """
+    pkg = os.path.join(core.repo_root(), "kolibrie_tpu")
+    res = core.run([pkg])
+    msgs = "\n".join(f.render() for f in res.findings)
+    assert res.ok, f"kolint findings not in baseline:\n{msgs}"
+
+
+def test_committed_baseline_is_minimal():
+    """Baseline entries must all still be live findings — a fixed finding
+    leaves a stale entry that silently grandfathers a future regression."""
+    pkg = os.path.join(core.repo_root(), "kolibrie_tpu")
+    res = core.run([pkg], use_baseline=False)
+    live = {}
+    for f in res.findings:
+        live[f.key()] = live.get(f.key(), 0) + 1
+    stale = []
+    for key, n in core.load_baseline(core.default_baseline_path()).items():
+        if live.get(key, 0) < n:
+            stale.append(key)
+    assert not stale, f"stale baseline entries: {stale}"
