@@ -490,6 +490,116 @@ def main():
         store_ingest = {"error": repr(e)}
     note(f"store_ingest sweep done ({store_ingest})")
 
+    # ---- wcoj: worst-case-optimal vs Volcano on cyclic BGPs --------------
+    # Two workloads.  (1) The AGM worst-case triangle: each relation is a
+    # star-in plus star-out through a hub value (2M rows each, all equal
+    # cardinality, so no scan is selective), EVERY pairwise join is M²
+    # rows through the hub, yet only ~3M triangles close — WCOJ's
+    # per-level intermediates must stay at the output scale.  (2) LUBM
+    # Q2/Q9 (the cyclic LUBM shapes) on a miniature campus KG, Volcano vs
+    # WCOJ device wall-clock.  Peak intermediate rows come from the
+    # EXPLAIN host-oracle counts (matched= on binary joins, level rows=
+    # on WCOJ levels).
+    note("wcoj sweep")
+    wcoj_block = None
+    try:
+        import re as _re
+
+        from benches.lubm import LUBM_Q2, LUBM_Q9, generate_fast
+        from kolibrie_tpu.query.engine import QueryEngine
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        def peak_intermediate(dbx, q):
+            explain = QueryEngine(dbx).explain_device(q, exact_counts=True)
+            joins = [
+                int(m) for m in _re.findall(r"matched=(\d+)", explain)
+            ]
+            levels = [
+                int(m)
+                for ln in explain.splitlines()
+                if ln.lstrip().startswith("level ?")
+                for m in _re.findall(r"rows=(\d+)", ln)
+            ]
+            return max(joins + levels, default=0)
+
+        def timed(dbx, q, n=5):
+            rows = execute_query_volcano(q, dbx)  # warm: compile + caps
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                execute_query_volcano(q, dbx)
+                best = min(best, time.perf_counter() - t0)
+            return best * 1000.0, len(rows)
+
+        def ab(dbx, q, n=5):
+            os.environ["KOLIBRIE_WCOJ"] = "off"
+            v_ms, v_rows = timed(dbx, q, n)
+            v_peak = peak_intermediate(dbx, q)
+            os.environ["KOLIBRIE_WCOJ"] = "auto"
+            w_ms, w_rows = timed(dbx, q, n)
+            w_peak = peak_intermediate(dbx, q)
+            assert v_rows == w_rows, f"row mismatch {v_rows} vs {w_rows}"
+            return {
+                "rows": w_rows,
+                "volcano_ms": round(v_ms, 3),
+                "wcoj_ms": round(w_ms, 3),
+                "speedup": round(v_ms / w_ms, 3) if w_ms else None,
+                "volcano_peak_intermediate_rows": v_peak,
+                "wcoj_peak_intermediate_rows": w_peak,
+            }
+
+        wcoj_mode_before = os.environ.get("KOLIBRIE_WCOJ")
+        try:
+            # AGM worst case: p1 = {x_i->y_0} ∪ {x_0->y_i} and cyclically
+            # for p2 (y->z), p3 (z->x) — all relations 2M-1 rows, every
+            # pairwise join M² through the hub, output 3M-2 triangles
+            M = 64
+            tlines = []
+
+            def star(pred, a, b):
+                for i in range(M):
+                    tlines.append(
+                        f"<https://t.example/{a}{i}> "
+                        f"<https://t.example/{pred}> "
+                        f"<https://t.example/{b}0> ."
+                    )
+                    tlines.append(
+                        f"<https://t.example/{a}0> "
+                        f"<https://t.example/{pred}> "
+                        f"<https://t.example/{b}{i}> ."
+                    )
+
+            star("p1", "x", "y")
+            star("p2", "y", "z")
+            star("p3", "z", "x")
+            tdb = SparqlDatabase()
+            tdb.parse_ntriples("\n".join(tlines))
+            tdb.execution_mode = db.execution_mode
+            tri_q = (
+                "PREFIX t: <https://t.example/> SELECT ?x ?y ?z WHERE "
+                "{ ?x t:p1 ?y . ?y t:p2 ?z . ?z t:p3 ?x }"
+            )
+
+            ldb = SparqlDatabase()
+            ls, lp, lo = generate_fast(30, ldb.dictionary)
+            ldb.store.add_batch(ls, lp, lo)
+            ldb.store.compact()
+            ldb.execution_mode = db.execution_mode
+
+            wcoj_block = {
+                "triangle_agm": {"m": M, **ab(tdb, tri_q)},
+                "lubm_q2": ab(ldb, LUBM_Q2),
+                "lubm_q9": ab(ldb, LUBM_Q9),
+            }
+        finally:
+            if wcoj_mode_before is None:
+                os.environ.pop("KOLIBRIE_WCOJ", None)
+            else:
+                os.environ["KOLIBRIE_WCOJ"] = wcoj_mode_before
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        wcoj_block = {"error": repr(e)}
+    note(f"wcoj sweep done ({wcoj_block})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -553,6 +663,7 @@ def main():
                     "resilience": resilience,
                     "obs": obs_block,
                     "store_ingest": store_ingest,
+                    "wcoj": wcoj_block,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

@@ -1,0 +1,139 @@
+"""Worst-case-optimal (leapfrog-triejoin-style) multiway join primitives.
+
+The Volcano binary joins in :mod:`kolibrie_tpu.ops.device_join` materialize
+every pairwise intermediate, which on cyclic basic graph patterns
+(triangles, LUBM q2/q9 shapes) is quadratic in the input even when the
+final result is tiny.  A worst-case-optimal join instead eliminates ONE
+VARIABLE AT A TIME: at each level the candidate values for the variable
+are enumerated from the accessor (pattern) with the smallest sorted-range
+count and validated by existence probes against every other accessor —
+so the intermediate row count is bounded by the output of each prefix
+join (the AGM bound), never by a pairwise product.
+
+The store already maintains all six sorted permutations on device as
+two-tier base + delta segments with tombstone positions
+(:meth:`ColumnarTripleStore.device_segment`), which makes the trie
+navigation a batch of lexicographic ``searchsorted`` probes — a pure
+XLA formulation with static shapes, so it composes with the
+parameterized-template ABI (zero recompiles across constant variants).
+
+This module holds the shared primitives:
+
+- :func:`lex_searchsorted` — batched lexicographic binary search over up
+  to three sorted u32 columns (device, traced inline by the plan body);
+- :func:`host_lex_range` — the numpy twin returning ``[lo, hi)`` ranges,
+  exact for 3-key probes via a dense-rank packing (u64 cannot hold three
+  u32 keys directly).
+
+The level evaluation itself lives in the device plan interpreter
+(``optimizer/device_engine.py`` ``WcojSpec``) because it threads the
+plan's capacity/counts protocol; its math is documented there.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["lex_searchsorted", "host_lex_range"]
+
+# never a real dictionary ID (IDs use bits 0..30 + bit 31 for quoted;
+# dictionary.rs:36-40) — doubles as the device padding fill, so probes for
+# it locate the start of a segment's padding block
+SENTINEL = 0xFFFFFFFF
+
+
+def lex_searchsorted(cols, keys, side: str = "left"):
+    """Batched lexicographic ``searchsorted`` over parallel sorted columns.
+
+    ``cols``: tuple of 1..3 u32 arrays (length N) sorted lexicographically
+    as a column-major tuple; ``keys``: tuple of equally many u32 arrays
+    (length P) — one probe tuple per row.  Returns int32 positions (P,).
+
+    A fixed-trip binary search (``fori_loop`` with a static step count)
+    instead of packing: three u32 keys do not fit one u64 word, and the
+    dense-rank repacking the binary joins use would cost a sort per probe
+    batch.  Intended to be traced INLINE inside the jitted plan body — it
+    is deliberately not jitted itself.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = int(cols[0].shape[0])
+    p = keys[0].shape[0]
+    if n == 0:
+        return jnp.zeros(p, dtype=jnp.int32)
+    right = side == "right"
+
+    def body(_i, lh):
+        lo, hi = lh
+        active = lo < hi
+        mid = jnp.clip((lo + hi) >> 1, 0, n - 1)
+        lt = jnp.zeros(p, dtype=bool)
+        eq = jnp.ones(p, dtype=bool)
+        for c, k in zip(cols, keys):
+            v = c[mid]
+            lt = lt | (eq & (v < k))
+            eq = eq & (v == k)
+        go_right = (lt | eq) if right else lt
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo0 = jnp.zeros(p, dtype=jnp.int32)
+    hi0 = jnp.full(p, n, dtype=jnp.int32)
+    # the search interval [lo, hi] starts at width n and halves every step
+    lo, _hi = lax.fori_loop(0, n.bit_length() + 1, body, (lo0, hi0))
+    return lo
+
+
+def _pack2(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return (a.astype(np.uint64) << np.uint64(32)) | b.astype(np.uint64)
+
+
+def host_lex_range(
+    cols: Sequence[np.ndarray], keys: Sequence[np.ndarray]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy twin of two :func:`lex_searchsorted` calls: ``[lo, hi)`` row
+    ranges of each probe tuple in lexicographically sorted columns.
+
+    1/2-key probes pack into u64 words; 3-key probes ride a dense rank of
+    the leading pair (run-change cumsum), replacing the pair with its rank
+    so ``(rank << 32) | c2`` stays exact — an absent leading pair keeps
+    the plain pair insertion point (left == right there, so the range is
+    empty at the correct position).
+    """
+    n = len(cols[0]) if cols else 0
+    k = len(keys)
+    p = len(keys[0]) if k else 0
+    if n == 0 or k == 0:
+        z = np.zeros(p, dtype=np.int64)
+        return z, z.copy()
+    if k == 1:
+        packed, kp = cols[0], np.asarray(keys[0])
+    elif k == 2:
+        packed = _pack2(cols[0], cols[1])
+        kp = _pack2(np.asarray(keys[0]), np.asarray(keys[1]))
+    else:
+        p01 = _pack2(cols[0], cols[1])
+        change = np.empty(n, dtype=bool)
+        change[0] = True
+        change[1:] = p01[1:] != p01[:-1]
+        rank01 = np.cumsum(change) - 1
+        packed = (rank01.astype(np.uint64) << np.uint64(32)) | cols[2].astype(
+            np.uint64
+        )
+        kp01 = _pack2(np.asarray(keys[0]), np.asarray(keys[1]))
+        i = np.searchsorted(p01, kp01, side="left")
+        ic = np.minimum(i, n - 1)
+        present = p01[ic] == kp01
+        kp = (rank01[ic].astype(np.uint64) << np.uint64(32)) | np.asarray(
+            keys[2]
+        ).astype(np.uint64)
+        lo = np.where(present, np.searchsorted(packed, kp, side="left"), i)
+        hi = np.where(present, np.searchsorted(packed, kp, side="right"), i)
+        return lo.astype(np.int64), hi.astype(np.int64)
+    lo = np.searchsorted(packed, kp, side="left")
+    hi = np.searchsorted(packed, kp, side="right")
+    return lo.astype(np.int64), hi.astype(np.int64)

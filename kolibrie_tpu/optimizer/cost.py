@@ -41,6 +41,14 @@ class CostEstimator:
             for c in cards[1:]:
                 est = max(est * self.stats.join_selectivity(est, c) * c, 1.0)
             return est
+        if isinstance(op, P.WcojNode):
+            # AGM-style bound with the uniform fractional edge cover 1/2 per
+            # pattern: sqrt(prod of pattern cardinalities) — exact exponent
+            # for the triangle, a sound flavor for other cyclic shapes
+            prod = 1.0
+            for s in op.scans:
+                prod *= max(self.cardinality(s), 1.0)
+            return max(prod**0.5, 1.0)
         if isinstance(op, P.PhysFilter):
             return self.cardinality(op.child) * 0.5
         if isinstance(op, P.PhysBind):
@@ -108,6 +116,12 @@ class CostEstimator:
         if isinstance(op, P.PhysStarJoin):
             total = sum(self.estimate_cost(s) for s in op.scans)
             return total + self.cardinality(op) * HASH_JOIN_COST_PER_ROW
+        if isinstance(op, P.WcojNode):
+            # scans feed sorted-range probes, then every level pays one
+            # leapfrog probe round over at most output-bound intermediates
+            total = sum(self.estimate_cost(s) for s in op.scans)
+            levels = max(len(op.elim_order), 1)
+            return total + self.cardinality(op) * HASH_JOIN_COST_PER_ROW * levels
         if isinstance(op, (P.PhysFilter, P.PhysBind, P.PhysProjection)):
             return self.estimate_cost(op.child) + self.cardinality(op.child) * 0.1
         if isinstance(op, P.PhysValues):

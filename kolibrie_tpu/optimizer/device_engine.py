@@ -124,6 +124,22 @@ _DEVICE_BATCH_SIZE = _obs_metrics.histogram(
     "members per stacked-parameter batch dispatch",
     buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
 )
+# Worst-case-optimal join instrumentation (emitted once per converged
+# execution, from the host-read counts — no extra device traffic)
+_WCOJ_LEVEL_ROWS = _obs_metrics.histogram(
+    "kolibrie_wcoj_level_rows",
+    "intermediate rows per WCOJ elimination level (exact, post-converge)",
+    buckets=_obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_WCOJ_CAP_OCCUPANCY = _obs_metrics.histogram(
+    "kolibrie_wcoj_cap_occupancy",
+    "rows/capacity ratio per WCOJ level (cap headroom health)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+)
+_WCOJ_PROBES = _obs_metrics.counter(
+    "kolibrie_wcoj_probes_total",
+    "candidate existence probes issued by WCOJ levels (cap x accessors)",
+)
 
 
 class Unsupported(Exception):
@@ -179,6 +195,49 @@ class JoinSpec:
     join_idx: int  # into the capacity table / counts output
     cap: int
     rsorted: bool = False  # right key column pre-sorted by its scan order
+
+
+@dataclass(frozen=True)
+class WcojAccessor:
+    """One pattern's sorted-order view at a WCOJ level: the order whose
+    perm prefix is exactly the pattern's bound positions (constants +
+    already-eliminated variables) followed by the level variable, so the
+    candidate column comes out sorted and range-probeable.
+
+    ``key_srcs`` supply the bound-prefix key values in PERM order —
+    ``('u', param_idx)`` reads the traced uint32 parameter vector (query
+    constants, incl. the never-an-ID sentinel for unknown terms),
+    ``('v', var)`` reads an already-eliminated variable's column.
+    ``key_pos``/``val_pos`` are canonical column positions (0=s 1=p 2=o)."""
+
+    order_idx: int
+    key_srcs: tuple
+    key_pos: tuple
+    val_pos: int
+
+
+@dataclass(frozen=True)
+class WcojLevel:
+    """Eliminate one variable: candidates come from the accessor with the
+    smallest raw sorted-range count (leapfrog's "smallest iterator leads"),
+    deduplicated to first-of-run, validated by live-existence probes
+    against EVERY accessor.  Shares the join capacity/counts protocol —
+    ``join_idx`` indexes the counts tuple and the convergence cap table."""
+
+    var: str
+    join_idx: int
+    cap: int
+    accessors: tuple
+
+
+@dataclass(frozen=True)
+class WcojSpec:
+    """Worst-case-optimal multiway join over a whole basic graph pattern:
+    one :class:`WcojLevel` per variable, in elimination order.  Intermediate
+    row counts are bounded by each prefix join's OUTPUT (AGM-style), never
+    by a pairwise product — the point of routing cyclic BGPs here."""
+
+    levels: tuple
 
 
 @dataclass(frozen=True)
@@ -636,6 +695,138 @@ def _plan_body(
                     )
             valid = jnp.concatenate([mvalid, keep])
             return out, valid, jnp.sum(valid)
+        if isinstance(node, WcojSpec):
+            # Variable-at-a-time leapfrog over the two-tier sorted orders.
+            # Counts are RAW range sizes (tombstoned/duplicate rows
+            # included): a sound capacity bound whose total is identical in
+            # the numpy twin, so calibration and convergence share the one
+            # protocol.  Liveness and dedup ride per-slot probes:
+            #   valid = in_range & real & first_of_run(chosen segment)
+            #         & AND_r(live_exists_r) & (base_slot | no_base_raw)
+            # where the last term keeps a value enumerated from the chosen
+            # accessor's delta from double-counting when its base also has
+            # raw (possibly all-tombstoned) copies — the base slot is the
+            # unique representative, made live by the delta via the
+            # existence probe.
+            from kolibrie_tpu.ops.wcoj import lex_searchsorted
+
+            SENT = jnp.uint32(0xFFFFFFFF)
+            wcols: Dict = {}
+            wvalid = jnp.ones(1, dtype=bool)
+            for lv in node.levels:
+                pcap = wvalid.shape[0]
+                segs = [order_arrays[a.order_idx] for a in lv.accessors]
+                probes = []
+                for a, (bcols, dcols, del_pos) in zip(lv.accessors, segs):
+                    keys = []
+                    sent = jnp.zeros(pcap, dtype=bool)
+                    for src in a.key_srcs:
+                        if src[0] == "u":
+                            k = jnp.broadcast_to(uparams[src[1]], (pcap,))
+                        else:
+                            k = wcols[src[1]]
+                        sent = sent | (k == SENT)
+                        keys.append(k)
+                    if keys:
+                        kt = tuple(keys)
+                        bsort = tuple(bcols[p] for p in a.key_pos)
+                        dsort = tuple(dcols[p] for p in a.key_pos)
+                        bl = lex_searchsorted(bsort, kt, "left")
+                        bh = lex_searchsorted(bsort, kt, "right")
+                        dl = lex_searchsorted(dsort, kt, "left")
+                        dh = lex_searchsorted(dsort, kt, "right")
+                    else:
+                        # unbound accessor: the whole live prefix (padding
+                        # is all-sentinel and sorts last; the order was
+                        # picked so the level variable IS the first column)
+                        bl = jnp.zeros(pcap, dtype=jnp.int32)
+                        dl = jnp.zeros(pcap, dtype=jnp.int32)
+                        nb0 = jnp.searchsorted(
+                            bcols[a.val_pos], SENT, side="left"
+                        ).astype(jnp.int32)
+                        nd0 = jnp.searchsorted(
+                            dcols[a.val_pos], SENT, side="left"
+                        ).astype(jnp.int32)
+                        bh = jnp.broadcast_to(nb0, (pcap,))
+                        dh = jnp.broadcast_to(nd0, (pcap,))
+                    probes.append((keys, sent, bl, bh, dl, dh))
+                cntm = jnp.stack(
+                    [
+                        jnp.where(sent, 0, (bh - bl) + (dh - dl))
+                        for (_k, sent, bl, bh, dl, dh) in probes
+                    ]
+                )
+                choice = jnp.argmin(cntm, axis=0)
+                cnt = jnp.where(wvalid, jnp.min(cntm, axis=0), 0)
+                total = jnp.sum(cnt.astype(jnp.int64))
+                counts.append(total)
+                cap = lv.cap
+                cum = jnp.cumsum(cnt)
+                slot = jnp.arange(cap, dtype=jnp.int32)
+                row = jnp.searchsorted(cum, slot, side="right").astype(
+                    jnp.int32
+                )
+                row_c = jnp.clip(row, 0, pcap - 1)
+                kk = slot - (cum[row_c] - cnt[row_c])
+                in_range = slot.astype(jnp.int64) < total
+                vals_l, first_l, isb_l = [], [], []
+                for a, (bcols, dcols, _dp), (keys, sent, bl, bh, dl, dh) in zip(
+                    lv.accessors, segs, probes
+                ):
+                    bv, dv = bcols[a.val_pos], dcols[a.val_pos]
+                    nb = bh[row_c] - bl[row_c]
+                    isb = kk < nb
+                    bidx = jnp.clip(bl[row_c] + kk, 0, bv.shape[0] - 1)
+                    didx = jnp.clip(dl[row_c] + (kk - nb), 0, dv.shape[0] - 1)
+                    bval, dval = bv[bidx], dv[didx]
+                    bprev = bv[jnp.clip(bidx - 1, 0, bv.shape[0] - 1)]
+                    dprev = dv[jnp.clip(didx - 1, 0, dv.shape[0] - 1)]
+                    vals_l.append(jnp.where(isb, bval, dval))
+                    first_l.append(
+                        jnp.where(
+                            isb,
+                            (kk == 0) | (bprev != bval),
+                            (kk == nb) | (dprev != dval),
+                        )
+                    )
+                    isb_l.append(isb)
+                ch = choice[row_c]
+                val = jnp.stack(vals_l)[ch, slot]
+                first = jnp.stack(first_l)[ch, slot]
+                is_base = jnp.stack(isb_l)[ch, slot]
+                new_valid = in_range & (val != SENT) & first
+                braw_l = []
+                for a, (bcols, dcols, del_pos), (keys, sent, *_r) in zip(
+                    lv.accessors, segs, probes
+                ):
+                    fkeys = tuple(k[row_c] for k in keys) + (val,)
+                    bsf = tuple(bcols[p] for p in a.key_pos) + (
+                        bcols[a.val_pos],
+                    )
+                    dsf = tuple(dcols[p] for p in a.key_pos) + (
+                        dcols[a.val_pos],
+                    )
+                    fl = lex_searchsorted(bsf, fkeys, "left")
+                    fh = lex_searchsorted(bsf, fkeys, "right")
+                    dl2 = lex_searchsorted(dsf, fkeys, "left")
+                    dh2 = lex_searchsorted(dsf, fkeys, "right")
+                    # tombstoned copies inside [fl, fh): del_pos holds
+                    # sorted base-row positions (sentinel-padded)
+                    tl = jnp.searchsorted(del_pos, fl.astype(jnp.uint32))
+                    th = jnp.searchsorted(del_pos, fh.astype(jnp.uint32))
+                    blive = (fh - fl) - (th - tl).astype(jnp.int32)
+                    live = (blive + (dh2 - dl2)) > 0
+                    new_valid = new_valid & live & ~sent[row_c]
+                    braw_l.append((fh - fl) > 0)
+                braw = jnp.stack(braw_l)[ch, slot]
+                new_valid = new_valid & (is_base | ~braw)
+                wcols = {
+                    v: jnp.where(new_valid, c[row_c], 0)
+                    for v, c in wcols.items()
+                }
+                wcols[lv.var] = jnp.where(new_valid, val, 0)
+                wvalid = new_valid
+            return wcols, wvalid, jnp.sum(wvalid)
         raise TypeError(f"unknown plan spec node {node!r}")
 
     cols, valid, _ = eval_node(spec.root)
@@ -817,7 +1008,7 @@ class LoweredPlan:
                 ),
             ):
                 return _phys_vars(op.left) | _phys_vars(op.right)
-            if isinstance(op, P.PhysStarJoin):
+            if isinstance(op, (P.PhysStarJoin, P.WcojNode)):
                 out: set = set()
                 for s in op.scans:
                     out |= _phys_vars(s)
@@ -848,7 +1039,7 @@ class LoweredPlan:
                 ),
             ):
                 return _statically_empty(op.left) or _statically_empty(op.right)
-            if isinstance(op, P.PhysStarJoin):
+            if isinstance(op, (P.PhysStarJoin, P.WcojNode)):
                 return any(_statically_empty(s) for s in op.scans)
             if isinstance(op, (P.PhysFilter, P.PhysProjection)):
                 return _statically_empty(op.child)
@@ -953,6 +1144,11 @@ class LoweredPlan:
             elif isinstance(node, UnionSpec):
                 for ch in node.children:
                     collect(ch)
+            elif isinstance(node, WcojSpec):
+                for lv in node.levels:
+                    for a in lv.accessors:
+                        if a.order_idx not in used:
+                            used.append(a.order_idx)
 
         collect(self.root)
         remap = {old: new for new, old in enumerate(sorted(used))}
@@ -1007,6 +1203,26 @@ class LoweredPlan:
             if isinstance(node, UnionSpec):
                 return UnionSpec(
                     tuple(rebuild(ch) for ch in node.children), node.vars
+                )
+            if isinstance(node, WcojSpec):
+                return WcojSpec(
+                    tuple(
+                        WcojLevel(
+                            lv.var,
+                            lv.join_idx,
+                            lv.cap,
+                            tuple(
+                                WcojAccessor(
+                                    remap[a.order_idx],
+                                    a.key_srcs,
+                                    a.key_pos,
+                                    a.val_pos,
+                                )
+                                for a in lv.accessors
+                            ),
+                        )
+                        for lv in node.levels
+                    )
                 )
             return node
 
@@ -1067,6 +1283,8 @@ class LoweredPlan:
         if isinstance(op, P.PhysProjection):
             # projection to fewer columns happens after readback (free)
             return self._lower(op.child)
+        if isinstance(op, P.WcojNode):
+            return self._lower_wcoj(op)
         raise Unsupported(f"operator {type(op).__name__}")
 
     _DEFAULT_ORDER = {
@@ -1167,6 +1385,69 @@ class LoweredPlan:
         for _pos, qvar, inner in quoted_at:
             node, bound_vars = self._wrap_quoted(node, qvar, inner, bound_vars)
         return node, bound_vars
+
+    def _lower_wcoj(self, op):
+        """Lower a :class:`WcojNode` to a :class:`WcojSpec`: one level per
+        elimination variable; at each level, every pattern containing the
+        variable contributes an accessor over the order whose perm prefix
+        is exactly its bound positions.  Constants go through the uint32
+        parameter vector (unknown ones as the never-an-ID sentinel, which
+        zeroes the accessor's ranges at run time), so the spec tree — and
+        hence the compiled executable — is a template property."""
+        srcs: List[tuple] = []
+        for scan in op.scans:
+            if not isinstance(scan, (P.PhysIndexScan, P.PhysTableScan)):
+                raise Unsupported("non-scan input to WCOJ")
+            row: List[tuple] = []
+            for t in (scan.pattern.subject, scan.pattern.predicate, scan.pattern.object):
+                if t.kind == "var":
+                    row.append(("v", t.value))
+                elif t.kind == "id":
+                    cid = 0xFFFFFFFF if t.value is None else int(t.value)
+                    row.append(("u", self._uparam(cid)))
+                else:
+                    raise Unsupported("quoted term in WCOJ pattern")
+            srcs.append(tuple(row))
+        pos_of = {"s": 0, "p": 1, "o": 2}
+        from kolibrie_tpu.core.store import ColumnarTripleStore
+
+        eliminated: set = set()
+        levels: List[WcojLevel] = []
+        for var in op.elim_order:
+            accessors: List[WcojAccessor] = []
+            for row in srcs:
+                positions = [i for i, s in enumerate(row) if s == ("v", var)]
+                if not positions:
+                    continue
+                if len(positions) > 1:
+                    raise Unsupported("repeated variable in WCOJ pattern")
+                val_pos = positions[0]
+                bound = frozenset(
+                    i
+                    for i, s in enumerate(row)
+                    if s[0] == "u" or (s[0] == "v" and s[1] in eliminated)
+                )
+                order_name = self._order_for(bound, val_pos)
+                if order_name is None:  # can't happen for |bound| <= 2
+                    raise Unsupported("no covering order for WCOJ accessor")
+                perm = ColumnarTripleStore._ORDER_PERMS[order_name]
+                key_pos = tuple(pos_of[c] for c in perm[: len(bound)])
+                accessors.append(
+                    WcojAccessor(
+                        self._order(order_name),
+                        tuple(row[p] for p in key_pos),
+                        key_pos,
+                        val_pos,
+                    )
+                )
+            if not accessors:
+                raise Unsupported("WCOJ variable not covered by any pattern")
+            levels.append(
+                WcojLevel(var, self.join_count, 0, tuple(accessors))
+            )
+            self.join_count += 1
+            eliminated.add(var)
+        return WcojSpec(tuple(levels)), set(op.elim_order)
 
     def _wrap_quoted(self, node, qvar: str, inner, bound_vars: set):
         """Wrap ``node`` with one :class:`QuotedExpandSpec` for the quoted
@@ -1522,6 +1803,18 @@ class LoweredPlan:
                 ),
                 node.vars,
             )
+        if isinstance(node, WcojSpec):
+            return WcojSpec(
+                tuple(
+                    WcojLevel(
+                        lv.var,
+                        lv.join_idx,
+                        join_caps[lv.join_idx],
+                        lv.accessors,
+                    )
+                    for lv in node.levels
+                )
+            )
         return node
 
     def _node_cap(self, node, scan_caps, join_caps) -> int:
@@ -1544,6 +1837,8 @@ class LoweredPlan:
             )
         if isinstance(node, ValuesSpec):
             return node.n
+        if isinstance(node, WcojSpec):
+            return join_caps[node.levels[-1].join_idx]
         raise TypeError(node)
 
     def _initial_join_caps(self, scan_caps) -> List[int]:
@@ -1573,6 +1868,25 @@ class LoweredPlan:
                 return sum(walk(ch) for ch in node.children)
             if isinstance(node, (FilterSpec, QuotedExpandSpec)):
                 return walk(node.child)  # fill caps of joins under wrappers
+            if isinstance(node, WcojSpec):
+                # optimistic start: each level no larger than its tightest
+                # accessor's largest key-group (template property) or the
+                # previous level, whichever wins; convergence doubles on
+                # real overflow — and totals are exact even when a level
+                # overflows, so each retry fixes a level for good
+                prev = 1
+                for lv in node.levels:
+                    group = min(
+                        template_scan_cap(
+                            self.db,
+                            self.order_names[a.order_idx],
+                            len(a.key_srcs),
+                        )
+                        for a in lv.accessors
+                    )
+                    prev = _round_cap(max(prev, group))
+                    caps[lv.join_idx] = prev
+                return prev
             return self._node_cap(node, scan_caps, caps)
 
         walk(self.root)
@@ -1846,7 +2160,145 @@ class LoweredPlan:
                             ]
                         )
                 return out
+            if isinstance(node, WcojSpec):
+                return eval_wcoj(node)
             raise TypeError(node)
+
+        def eval_wcoj(node) -> Dict[str, np.ndarray]:
+            """Numpy twin of the device WCOJ levels.  Mirrors the RAW-count
+            math bit for bit (tombstoned and duplicate rows included in the
+            candidate counts) so ``counts`` calibrates device capacities
+            exactly; rows are compressed to the valid set after each level
+            instead of padded to a cap."""
+            from kolibrie_tpu.ops.wcoj import host_lex_range
+
+            store = self.db.store
+            SENT = np.uint32(0xFFFFFFFF)
+            pos_of = {"s": 0, "p": 1, "o": 2}
+            seg_cache: Dict[int, tuple] = {}
+
+            def seg(order_idx):
+                cached = seg_cache.get(order_idx)
+                if cached is None:
+                    name = self.order_names[order_idx]
+                    bo = store.base_order(name)
+                    do = store.delta_order(name)
+                    bperm = [pos_of[c] for c in bo.perm]
+                    bcanon = [None, None, None]
+                    dcanon = [None, None, None]
+                    for j, p in enumerate(bperm):
+                        bcanon[p] = (bo.c0, bo.c1, bo.c2)[j]
+                        dcanon[p] = (do.c0, do.c1, do.c2)[j]
+                    cached = (
+                        bcanon,
+                        dcanon,
+                        store.delta_del_positions(name),
+                    )
+                    seg_cache[order_idx] = cached
+                return cached
+
+            cols: Dict[str, np.ndarray] = {}
+            nrows = 1
+            for lv in node.levels:
+                per = []
+                for a in lv.accessors:
+                    bcanon, dcanon, dp = seg(a.order_idx)
+                    keys = []
+                    sent = np.zeros(nrows, dtype=bool)
+                    for src in a.key_srcs:
+                        if src[0] == "u":
+                            k = np.full(
+                                nrows, self.u_params[src[1]], dtype=np.uint32
+                            )
+                        else:
+                            k = cols[src[1]]
+                        sent |= k == SENT
+                        keys.append(k)
+                    if keys:
+                        bl, bh = host_lex_range(
+                            [bcanon[p] for p in a.key_pos], keys
+                        )
+                        dl, dh = host_lex_range(
+                            [dcanon[p] for p in a.key_pos], keys
+                        )
+                    else:
+                        bl = np.zeros(nrows, dtype=np.int64)
+                        dl = np.zeros(nrows, dtype=np.int64)
+                        bh = np.full(
+                            nrows, len(bcanon[a.val_pos]), dtype=np.int64
+                        )
+                        dh = np.full(
+                            nrows, len(dcanon[a.val_pos]), dtype=np.int64
+                        )
+                    cnt = np.where(sent, 0, (bh - bl) + (dh - dl))
+                    per.append(
+                        (a, bcanon, dcanon, dp, keys, sent, bl, bh, dl, cnt)
+                    )
+                cntm = np.stack([p[-1] for p in per])
+                choice = np.argmin(cntm, axis=0)
+                cnt = np.min(cntm, axis=0)
+                total = int(cnt.sum())
+                counts[lv.join_idx] = total
+                rows = np.repeat(np.arange(nrows), cnt)
+                kk = np.arange(total, dtype=np.int64) - np.repeat(
+                    np.cumsum(cnt) - cnt, cnt
+                )
+                ch = choice[rows]
+                val = np.zeros(total, dtype=np.uint32)
+                first = np.zeros(total, dtype=bool)
+                is_base = np.zeros(total, dtype=bool)
+                for ai, (a, bcanon, dcanon, dp, keys, sent, bl, bh, dl, _c) in enumerate(per):
+                    m = ch == ai
+                    if not m.any():
+                        continue
+                    bv = bcanon[a.val_pos]
+                    dv = dcanon[a.val_pos]
+                    rm, km = rows[m], kk[m]
+                    nb = bh[rm] - bl[rm]
+                    isb = km < nb
+                    if len(bv):
+                        bidx = np.clip(bl[rm] + km, 0, len(bv) - 1)
+                        bval = bv[bidx]
+                        bprev = bv[np.clip(bidx - 1, 0, len(bv) - 1)]
+                    else:
+                        bval = bprev = np.zeros(len(km), dtype=np.uint32)
+                    if len(dv):
+                        didx = np.clip(dl[rm] + (km - nb), 0, len(dv) - 1)
+                        dval = dv[didx]
+                        dprev = dv[np.clip(didx - 1, 0, len(dv) - 1)]
+                    else:
+                        dval = dprev = np.zeros(len(km), dtype=np.uint32)
+                    val[m] = np.where(isb, bval, dval)
+                    first[m] = np.where(
+                        isb,
+                        (km == 0) | (bprev != bval),
+                        (km == nb) | (dprev != dval),
+                    )
+                    is_base[m] = isb
+                vvalid = first
+                braw_ch = np.zeros(total, dtype=bool)
+                for ai, (a, bcanon, dcanon, dp, keys, sent, *_r) in enumerate(per):
+                    fkeys = [k[rows] for k in keys] + [val]
+                    fl, fh = host_lex_range(
+                        [bcanon[p] for p in a.key_pos]
+                        + [bcanon[a.val_pos]],
+                        fkeys,
+                    )
+                    dl2, dh2 = host_lex_range(
+                        [dcanon[p] for p in a.key_pos]
+                        + [dcanon[a.val_pos]],
+                        fkeys,
+                    )
+                    tl = np.searchsorted(dp, fl.astype(np.uint32))
+                    th = np.searchsorted(dp, fh.astype(np.uint32))
+                    live = ((fh - fl) - (th - tl) + (dh2 - dl2)) > 0
+                    vvalid = vvalid & live & ~sent[rows]
+                    braw_ch = np.where(ch == ai, (fh - fl) > 0, braw_ch)
+                vvalid = vvalid & (is_base | ~braw_ch)
+                cols = {v: c[rows][vvalid] for v, c in cols.items()}
+                cols[lv.var] = val[vvalid]
+                nrows = int(vvalid.sum())
+            return cols
 
         table = eval_node(self.root)
         return table, counts
@@ -1909,12 +2361,39 @@ class LoweredPlan:
             ]
             if not overflow:
                 self._store_caps()
+                self._emit_wcoj_obs(counts_h)
                 return out_cols, valid
             for i in overflow:
                 self._join_caps[i] = _round_cap(2 * counts_h[i])
             self._store_caps()
             out = self.run()
         raise RuntimeError("device plan capacities failed to converge")
+
+    def _emit_wcoj_obs(self, counts_h: List[int]) -> None:
+        """Per-level WCOJ instrumentation from the converged host-read
+        counts: intermediate rows, cap occupancy, probe volume."""
+
+        def walk(node):
+            if isinstance(node, WcojSpec):
+                for lv in node.levels:
+                    if lv.join_idx >= len(counts_h):
+                        continue
+                    rows = counts_h[lv.join_idx]
+                    cap = self._join_caps[lv.join_idx]
+                    _WCOJ_LEVEL_ROWS.observe(rows)
+                    if cap > 0:
+                        _WCOJ_CAP_OCCUPANCY.observe(rows / cap)
+                    _WCOJ_PROBES.inc(cap * len(lv.accessors))
+            elif isinstance(node, (JoinSpec, AntiJoinSpec, LeftOuterSpec)):
+                walk(node.left)
+                walk(node.right)
+            elif isinstance(node, (FilterSpec, QuotedExpandSpec)):
+                walk(node.child)
+            elif isinstance(node, UnionSpec):
+                for ch in node.children:
+                    walk(ch)
+
+        walk(self.root)
 
     def to_table(self, out_cols, valid) -> BindingTable:
         valid_h = np.asarray(valid)
@@ -1995,6 +2474,28 @@ class LoweredPlan:
                     f"{pad}quoted-expand {node.qvar} -> {vars_ or '(checks only)'}"
                 )
                 walk(node.child, depth + 1)
+            elif isinstance(node, WcojSpec):
+                jcaps = getattr(self, "_join_caps", None)
+                lines.append(
+                    f"{pad}wcoj elim=["
+                    + " ".join(f"?{lv.var}" for lv in node.levels)
+                    + "]"
+                )
+                for lv in node.levels:
+                    cnt = (
+                        f" rows={counts[lv.join_idx]}"
+                        if counts is not None and lv.join_idx < len(counts)
+                        else ""
+                    )
+                    cap = jcaps[lv.join_idx] if jcaps else "?"
+                    accs = ", ".join(
+                        f"{self.order_names[a.order_idx]}"
+                        f"/k{len(a.key_srcs)}"
+                        for a in lv.accessors
+                    )
+                    lines.append(
+                        f"{pad}  level ?{lv.var} cap={cap}{cnt} [{accs}]"
+                    )
             elif isinstance(node, ValuesSpec):
                 lines.append(f"{pad}values({', '.join(node.vars)}) rows={node.n}")
             else:

@@ -107,6 +107,15 @@ class ExecutionEngine:
                 t = self.execute_with_ids(scan)
                 out = t if out is None else equi_join_tables(out, t)
             return out if out is not None else {}
+        if isinstance(op, P.WcojNode):
+            # host fallback: binary joins give the same bindings (set
+            # semantics); the worst-case-optimal evaluation is the DEVICE
+            # lowering's concern
+            wout: Optional[BindingTable] = None
+            for scan in op.scans:
+                t = self.execute_with_ids(scan)
+                wout = t if wout is None else equi_join_tables(wout, t)
+            return wout if wout is not None else {}
         if isinstance(op, P.PhysFilter):
             table = self.execute_with_ids(op.child)
             mask = self.eval_filter(op.expr, table)

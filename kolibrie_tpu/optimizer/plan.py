@@ -126,6 +126,20 @@ class PhysStarJoin:
 
 
 @dataclass
+class WcojNode:
+    """Worst-case-optimal multiway join: ALL patterns of a (cyclic) basic
+    graph pattern joined at once, one variable eliminated per level in
+    ``elim_order`` (leapfrog-triejoin over the store's sorted orders).
+    ``scans`` are the per-pattern physical scan nodes — kept as scans so
+    host fallback, EXPLAIN, and variable accounting reuse the existing
+    machinery; the device lowering reads only their patterns."""
+
+    scans: List["PhysOp"] = field(default_factory=list)
+    elim_order: List[str] = field(default_factory=list)
+    estimated_rows: float = 0.0
+
+
+@dataclass
 class PhysFilter:
     expr: FilterExpression
     child: "PhysOp"

@@ -9,8 +9,10 @@ index scan via ``choose_best_scan``).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
+from kolibrie_tpu.obs import metrics as _obs_metrics
 from kolibrie_tpu.optimizer import plan as P
 from kolibrie_tpu.optimizer.cost import CostEstimator
 from kolibrie_tpu.query.ast import (
@@ -21,6 +23,80 @@ from kolibrie_tpu.query.ast import (
 )
 
 STAR_MIN_PATTERNS = 3  # minimum patterns sharing a variable to form a star
+WCOJ_MIN_PATTERNS = 3  # smallest cycle; 'force' mode relaxes to 2
+
+# join-strategy selection (bounded label set: three literal strategies)
+_JOIN_STRATEGY = _obs_metrics.counter(
+    "kolibrie_planner_join_strategy_total",
+    "multi-pattern groups planned per join strategy",
+    labels=("strategy",),
+)
+
+
+def wcoj_mode() -> str:
+    """Worst-case-optimal join routing mode (``KOLIBRIE_WCOJ``):
+    ``auto`` (default) routes CYCLIC basic graph patterns to the WCOJ
+    node and keeps acyclic chains on the Volcano binary-join path;
+    ``off`` disables WCOJ; ``force`` routes every eligible connected
+    group of >= 2 patterns (test/bench hook).  Read per planning call —
+    the template fingerprint folds the mode in, so flipping it never
+    replays a plan cached under the other strategy."""
+    mode = os.environ.get("KOLIBRIE_WCOJ", "auto").strip().lower()
+    return mode if mode in ("auto", "off", "force") else "auto"
+
+
+def _gyo_cyclic(edge_sets: List[frozenset]) -> bool:
+    """Hypergraph cyclicity via GYO reduction: repeatedly drop vertices
+    that occur in exactly one edge and edges contained in another edge
+    (duplicate-aware).  Alpha-acyclic hypergraphs reduce to nothing; a
+    non-empty fixpoint (e.g. the triangle {xy, yz, zx}) is cyclic —
+    exactly the shapes whose binary-join intermediates exceed the AGM
+    output bound."""
+    edges = [set(e) for e in edge_sets if e]
+    changed = True
+    while changed and edges:
+        changed = False
+        count: Dict[str, int] = {}
+        for e in edges:
+            for v in e:
+                count[v] = count.get(v, 0) + 1
+        for e in edges:
+            lone = {v for v in e if count[v] == 1}
+            if lone:
+                e -= lone
+                changed = True
+        kept: List[set] = []
+        for i, e in enumerate(edges):
+            if not e:
+                changed = True
+                continue
+            contained = any(
+                f and i != j and (e < f or (e == f and i > j))
+                for j, f in enumerate(edges)
+            )
+            if contained:
+                changed = True
+            else:
+                kept.append(e)
+        edges = kept
+    return bool(edges)
+
+
+def _connected(var_sets: List[frozenset]) -> bool:
+    """True when the patterns form ONE join-connected component."""
+    if not var_sets:
+        return False
+    pending = list(range(1, len(var_sets)))
+    reached = set(var_sets[0])
+    grew = True
+    while pending and grew:
+        grew = False
+        for i in list(pending):
+            if var_sets[i] & reached:
+                reached |= var_sets[i]
+                pending.remove(i)
+                grew = True
+    return not pending
 
 
 def build_logical_plan(
@@ -132,18 +208,93 @@ class Streamertail:
                 best = (v, idxs)
         return best
 
+    def _try_wcoj(self, scans: List[object]) -> Optional[P.WcojNode]:
+        """Route eligible pattern groups to the worst-case-optimal multiway
+        join: every leaf a plain triple scan (no quoted terms, no repeated
+        variables, at least one variable each), the join graph connected,
+        and — in ``auto`` mode — GYO-cyclic, the shapes where Volcano
+        binary-join intermediates exceed the AGM output bound.  ``force``
+        mode (tests/benches) relaxes to any connected group of >= 2."""
+        mode = wcoj_mode()
+        if mode == "off":
+            return None
+        min_patterns = 2 if mode == "force" else WCOJ_MIN_PATTERNS
+        if len(scans) < min_patterns:
+            return None
+        var_sets: List[frozenset] = []
+        for s in scans:
+            if not isinstance(s, P.LogicalScan):
+                return None
+            terms = (s.pattern.subject, s.pattern.predicate, s.pattern.object)
+            if any(t.kind == "quoted" for t in terms):
+                return None  # quoted-triple terms stay on the scan machinery
+            vs = [t.value for t in terms if t.kind == "var"]
+            if not vs or len(set(vs)) != len(vs):
+                return None  # const-only or repeated-variable patterns
+            var_sets.append(frozenset(vs))
+        if not _connected(var_sets):
+            return None
+        if mode != "force" and not _gyo_cyclic(var_sets):
+            return None
+        cards = [
+            max(self.stats.pattern_cardinality(s.pattern), 1.0) for s in scans
+        ]
+        node = P.WcojNode(
+            scans=[self._scan_for(s) for s in scans],
+            elim_order=self._elimination_order(var_sets, cards),
+        )
+        node.estimated_rows = self.estimator.cardinality(node)
+        return node
+
+    @staticmethod
+    def _elimination_order(
+        var_sets: List[frozenset], cards: List[float]
+    ) -> List[str]:
+        """Variable elimination order: start from the variable whose
+        tightest covering pattern is smallest (fewest leapfrog candidates),
+        then grow connected-first.  Ties break on the variable name so
+        equal statistics always yield the same order — planning reruns per
+        constant binding, and an order flip would change the lowered spec
+        and recompile."""
+        score: Dict[str, float] = {}
+        for vs, c in zip(var_sets, cards):
+            for v in vs:
+                score[v] = min(score.get(v, float("inf")), c)
+        remaining = set(score)
+        chosen: set = set()
+        order: List[str] = []
+        while remaining:
+            linked = {
+                v
+                for v in remaining
+                if any(v in vs and (vs & chosen) for vs in var_sets)
+            }
+            pool = linked if linked else remaining
+            nxt = min(pool, key=lambda v: (score[v], v))
+            order.append(nxt)
+            remaining.remove(nxt)
+            chosen.add(nxt)
+        return order
+
     def _plan_joins(self, scans: List[object]) -> object:
         if not scans:
             return P.PhysValues(ValuesClause([], []))
         if len(scans) == 1:
             return self._scan_for(scans[0])
 
+        wcoj = self._try_wcoj(scans)
+        if wcoj is not None:
+            _JOIN_STRATEGY.labels("wcoj").inc()
+            return wcoj
+
         star = self._detect_star(scans)
         if star is not None and len(star[1]) == len(scans):
             center, idxs = star
+            _JOIN_STRATEGY.labels("star").inc()
             return P.PhysStarJoin(
                 center, [self._scan_for(scans[i]) for i in idxs]
             )
+        _JOIN_STRATEGY.labels("volcano").inc()
 
         # greedy cheapest-first left-deep join ordering with connectivity
         # preference (reference reorders by estimated logical cost; :252-262)

@@ -35,7 +35,7 @@ joins with ICI all-to-all instead of a shared-memory thread pool.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -333,15 +333,21 @@ class DistGeneralReasoner:
         self.bucket_cap = bucket_cap or round_cap(4 * n_local, 256)
 
     def _round_fn(self):
+        return self._round_fn_for(
+            self.fact_cap, self.delta_cap, self.join_cap, self.bucket_cap
+        )
+
+    @lru_cache(maxsize=8)  # one entry per capacity attempt (infer doubles)
+    def _round_fn_for(self, fact_cap, delta_cap, join_cap, bucket_cap):
         body = partial(
             _general_round,
             rules=self.rules,
             n=self.n,
             axis=self.axis,
-            fact_cap=self.fact_cap,
-            delta_cap=self.delta_cap,
-            join_cap=self.join_cap,
-            bucket_cap=self.bucket_cap,
+            fact_cap=fact_cap,
+            delta_cap=delta_cap,
+            join_cap=join_cap,
+            bucket_cap=bucket_cap,
         )
         spec = P(self.axis, None)
         rep = P()

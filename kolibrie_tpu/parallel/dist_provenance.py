@@ -51,7 +51,7 @@ virtual CPU mesh.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -1019,7 +1019,58 @@ class DistProvenanceReasoner:
         # per-rule NAF seen-relation capacity (addmult exactly-once)
         self.seen_cap = round_cap(4 * n_local, 256)
 
-    def _wrap_body(self, body):
+    def _round_fn(self):
+        return self._pass_fn_for(
+            "round",
+            None,
+            self.fact_cap,
+            self.delta_cap,
+            self.join_cap,
+            self.bucket_cap,
+        )
+
+    def _naf_fn(self, rule_idx=None):
+        """NAF pass program; ``rule_idx`` selects one rule (sequential
+        cross-blocking dispatch), None compiles all NAF rules into one."""
+        return self._pass_fn_for(
+            "naf",
+            rule_idx,
+            self.fact_cap,
+            self.delta_cap,
+            self.join_cap,
+            self.bucket_cap,
+        )
+
+    @lru_cache(maxsize=32)  # keyed per capacity attempt and per NAF rule
+    def _pass_fn_for(self, tag, rule_idx, fact_cap, delta_cap, join_cap, bucket_cap):
+        if tag == "round":
+            body = partial(
+                _tagged_round,
+                rules=self.pos_rules,
+                n=self.n,
+                axis=self.axis,
+                fact_cap=fact_cap,
+                delta_cap=delta_cap,
+                join_cap=join_cap,
+                bucket_cap=bucket_cap,
+                kind=self.kind,
+            )
+        else:
+            body = partial(
+                _naf_pass,
+                rules=(
+                    self.naf_rules
+                    if rule_idx is None
+                    else (self.naf_rules[rule_idx],)
+                ),
+                neg_kind=self.neg_kind,
+                n=self.n,
+                axis=self.axis,
+                fact_cap=fact_cap,
+                delta_cap=delta_cap,
+                join_cap=join_cap,
+                bucket_cap=bucket_cap,
+            )
         spec = P(self.axis, None)
         rep = P()
         n_masks = len(self.bank.exprs)
@@ -1035,43 +1086,27 @@ class DistProvenanceReasoner:
             )
         )
 
-    def _round_fn(self):
-        return self._wrap_body(
-            partial(
-                _tagged_round,
-                rules=self.pos_rules,
-                n=self.n,
-                axis=self.axis,
-                fact_cap=self.fact_cap,
-                delta_cap=self.delta_cap,
-                join_cap=self.join_cap,
-                bucket_cap=self.bucket_cap,
-                kind=self.kind,
-            )
-        )
-
-    def _naf_fn(self, rules=None):
-        return self._wrap_body(
-            partial(
-                _naf_pass,
-                rules=self.naf_rules if rules is None else rules,
-                neg_kind=self.neg_kind,
-                n=self.n,
-                axis=self.axis,
-                fact_cap=self.fact_cap,
-                delta_cap=self.delta_cap,
-                join_cap=self.join_cap,
-                bucket_cap=self.bucket_cap,
-            )
-        )
-
     @staticmethod
     def _rule_vars(lr) -> int:
         return len({v for prem in lr.premises for v, _pos in prem.vars})
 
-    def _naf_addmult_fn(self, rule):
+    def _naf_addmult_fn(self, rule_idx):
+        return self._naf_addmult_fn_for(
+            rule_idx,
+            self.fact_cap,
+            self.delta_cap,
+            self.join_cap,
+            self.bucket_cap,
+            self.seen_cap,
+        )
+
+    @lru_cache(maxsize=32)  # keyed per capacity attempt and per NAF rule
+    def _naf_addmult_fn_for(
+        self, rule_idx, fact_cap, delta_cap, join_cap, bucket_cap, seen_cap
+    ):
         """Wrap :func:`_naf_pass_addmult` for one rule: the state specs
         plus this rule's seen-relation columns (one per rule variable)."""
+        rule = self.naf_rules[rule_idx]
         k = self._rule_vars(rule[0])
         spec = P(self.axis, None)
         rep = P()
@@ -1081,11 +1116,11 @@ class DistProvenanceReasoner:
             rule=rule,
             n=self.n,
             axis=self.axis,
-            fact_cap=self.fact_cap,
-            delta_cap=self.delta_cap,
-            join_cap=self.join_cap,
-            bucket_cap=self.bucket_cap,
-            seen_cap=self.seen_cap,
+            fact_cap=fact_cap,
+            delta_cap=delta_cap,
+            join_cap=join_cap,
+            bucket_cap=bucket_cap,
+            seen_cap=seen_cap,
         )
         return jax.jit(
             _shard_map(
@@ -1208,12 +1243,16 @@ class DistProvenanceReasoner:
             elif self.kind == "addmult":
                 # one mesh program per rule, each threading its own seen
                 # relation (exactly-once accounting across passes)
-                naf_fns = [self._naf_addmult_fn(nr) for nr in self.naf_rules]
+                naf_fns = [
+                    self._naf_addmult_fn(i)
+                    for i in range(len(self.naf_rules))
+                ]
             elif self.naf_sequential:
                 # cross-blocking: one mesh program per rule, dispatched in
                 # host rule order so earlier rules' commits are visible
                 naf_fns = [
-                    self._naf_fn(rules=(nr,)) for nr in self.naf_rules
+                    self._naf_fn(rule_idx=i)
+                    for i in range(len(self.naf_rules))
                 ]
             else:
                 naf_fns = [self._naf_fn()]

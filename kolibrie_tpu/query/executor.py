@@ -988,13 +988,23 @@ def _plan_cache_entry(db, sparql: str):
     occupancy and hit/miss/eviction counters.  Returns ``(entry, slot)``;
     ``entry`` carries the parsed ``cq``, ``slot`` has the
     ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
+    from kolibrie_tpu.optimizer.planner import wcoj_mode
     from kolibrie_tpu.query.template import fingerprint_query
 
     parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
+    # the join-strategy mode is part of the template fingerprint; a mode
+    # flip after parse must refingerprint (not replay the old-mode plan)
+    env_sig = wcoj_mode()
     ent = parse.get(sparql)
-    if ent is None or ent["prefix_sig"] != prefix_sig:
-        ent = {"prefix_sig": prefix_sig, "cq": None, "fp": None, "params": ()}
+    if ent is None or ent["prefix_sig"] != prefix_sig or ent["env_sig"] != env_sig:
+        ent = {
+            "prefix_sig": prefix_sig,
+            "env_sig": env_sig,
+            "cq": None,
+            "fp": None,
+            "params": (),
+        }
         parse[sparql] = ent
     parse.move_to_end(sparql)
     while len(parse) > _PLAN_CACHE_MAX:

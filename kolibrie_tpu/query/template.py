@@ -128,9 +128,16 @@ def _ser(node: Any, params: List[Any]) -> Any:
 
 def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
     """Return ``(structure, params)`` for a parsed query: the hashable
-    template skeleton and the ordered tuple of extracted constants."""
+    template skeleton and the ordered tuple of extracted constants.
+
+    The join-strategy routing mode (``KOLIBRIE_WCOJ``) is folded into the
+    skeleton: strategy selection happens at PLAN time, so a plan cached
+    under one mode must never replay after the mode flips — distinct
+    fingerprints give each strategy its own slot (and device executable)."""
+    from kolibrie_tpu.optimizer.planner import wcoj_mode  # lazy: avoids cycle
+
     params: List[Any] = []
-    structure = _ser(cq, params)
+    structure = ("wcoj", wcoj_mode(), _ser(cq, params))
     return structure, tuple(params)
 
 

@@ -26,5 +26,14 @@ if [ -n "$tracked" ]; then
     echo "$tracked" >&2
     rc=1
 fi
+# Untracked __pycache__ dirs are build debris: a .pyc that outlives its
+# deleted source keeps stale code importable by tooling that scans the
+# tree. Catch them too — report and scrub so the gate leaves a clean tree.
+strays=$(find kolibrie_tpu scripts tests -type d -name '__pycache__' 2>/dev/null || true)
+if [ -n "$strays" ]; then
+    echo "removing untracked bytecode dirs:"
+    echo "$strays"
+    echo "$strays" | xargs rm -rf
+fi
 
 exit $rc
