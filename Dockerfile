@@ -42,6 +42,16 @@ RUN if [ "$ENABLE_WEB_UI" = "true" ]; then mv /app/web.build /app/web; \
 
 ENV PYTHONPATH=/app
 ENV JAX_PLATFORMS=cpu
+# durable mode: set KOLIBRIE_DATA_DIR to a mounted volume (see
+# docker-compose.yml and docs/DURABILITY.md); unset = in-memory server
 EXPOSE 7878
+
+# /healthz answers 200 only once recovery finishes ("ready"); during the
+# recovering/draining phases it answers 503, so orchestrators hold
+# traffic until the WAL replay is done
+HEALTHCHECK --interval=10s --timeout=5s --start-period=30s --retries=3 \
+    CMD python -c "import urllib.request,sys; \
+sys.exit(0 if urllib.request.urlopen('http://127.0.0.1:7878/healthz', timeout=4).status == 200 else 1)" \
+    || exit 1
 
 CMD ["python", "-m", "kolibrie_tpu.frontends.http_server", "0.0.0.0", "7878"]

@@ -600,6 +600,106 @@ def main():
         wcoj_block = {"error": repr(e)}
     note(f"wcoj sweep done ({wcoj_block})")
 
+    # ---- durability: WAL ingest overhead + cold-start recovery -----------
+    # ISSUE-7 acceptance numbers.  (1) The same streamed ntriples ingest
+    # with the WAL attached (default group-commit fsync) vs detached —
+    # target < 15% overhead.  (2) Cold-start recovery of the employee
+    # store: once replaying the full mutation history from the WAL, once
+    # from a snapshot generation (the steady-state boot path).
+    note("durability sweep")
+    durability_block = None
+    try:
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from kolibrie_tpu.durability.manager import DurabilityManager
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        D_BATCHES, D_ROWS, D_REPEATS = 30, 2048, 5
+
+        def wal_ingest(dbx, tag):
+            t0 = time.perf_counter()
+            for b in range(D_BATCHES):
+                lines = [
+                    f"<https://d.example/{tag}/{b}_{j}> "
+                    f"<https://d.example/p{j % 4}> "
+                    f"<https://d.example/v{b}_{j}> ."
+                    for j in range(D_ROWS)
+                ]
+                dbx.parse_ntriples("\n".join(lines))
+            return time.perf_counter() - t0
+
+        rec_dir = _tempfile.mkdtemp(prefix="kolibrie-bench-rec-")
+        try:
+            # best-of-N on each side: one ingest is ~0.15s, where a single
+            # scheduler hiccup would swamp a 15% overhead budget
+            t_wal_off = t_wal_on = float("inf")
+            wal_bytes = 0
+            for r in range(D_REPEATS):
+                db_off = SparqlDatabase()
+                t_wal_off = min(t_wal_off, wal_ingest(db_off, f"off{r}"))
+                wal_dir = _tempfile.mkdtemp(prefix="kolibrie-bench-wal-")
+                try:
+                    mgr = DurabilityManager(wal_dir, fsync_policy="group")
+                    mgr.start()
+                    db_on = SparqlDatabase()
+                    mgr.attach("bench", db_on)
+                    t_wal_on = min(t_wal_on, wal_ingest(db_on, f"on{r}"))
+                    mgr.flush()
+                    wal_bytes = mgr.wal.appended_bytes
+                    mgr.close()
+                finally:
+                    _shutil.rmtree(wal_dir, ignore_errors=True)
+
+            # cold start: journal the employee store's full history, then
+            # recover once from the WAL and once from a snapshot
+            mgr = DurabilityManager(rec_dir, fsync_policy="group")
+            mgr.start()
+            db_emp = SparqlDatabase()
+            mgr.attach("employee", db_emp)
+            db_emp.parse_ntriples(db.to_ntriples())
+            mgr.close()
+            mgr2 = DurabilityManager(rec_dir, fsync_policy="group")
+            t0 = time.perf_counter()
+            rec = mgr2.recover()
+            t_recover_wal = time.perf_counter() - t0
+            n_recovered = len(rec.stores["employee"].store)
+            assert n_recovered == len(db.store), (n_recovered, len(db.store))
+            gen = mgr2.snapshot({"employee": rec.stores["employee"]})
+            mgr2.close()
+            mgr3 = DurabilityManager(rec_dir, fsync_policy="group")
+            t0 = time.perf_counter()
+            rec2 = mgr3.recover()
+            t_recover_snap = time.perf_counter() - t0
+            assert len(rec2.stores["employee"].store) == n_recovered
+            replay_stats = dict(rec.stats)
+            mgr3.close()
+        finally:
+            _shutil.rmtree(rec_dir, ignore_errors=True)
+
+        durability_block = {
+            "fsync_policy": "group",
+            "ingest_batches": D_BATCHES,
+            "rows_per_batch": D_ROWS,
+            "ingest_repeats": D_REPEATS,
+            "ingest_s_wal_off": round(t_wal_off, 4),
+            "ingest_s_wal_on": round(t_wal_on, 4),
+            "wal_overhead_pct": round(
+                (t_wal_on - t_wal_off) / t_wal_off * 100.0, 1
+            ),
+            "wal_overhead_target_pct": 15.0,
+            "wal_bytes_appended": wal_bytes,
+            "recovery_triples": n_recovered,
+            "recovery_from_wal_s": round(t_recover_wal, 3),
+            "recovery_replayed_records": replay_stats["replayed_records"],
+            "recovery_replayed_bytes": replay_stats["replayed_bytes"],
+            "recovery_from_snapshot_s": round(t_recover_snap, 3),
+            "recovery_snapshot_generation": gen,
+        }
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        durability_block = {"error": repr(e)}
+    note(f"durability sweep done ({durability_block})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -664,6 +764,7 @@ def main():
                     "obs": obs_block,
                     "store_ingest": store_ingest,
                     "wcoj": wcoj_block,
+                    "durability": durability_block,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

@@ -178,6 +178,7 @@ def run(
     # rule modules self-register on import
     from kolibrie_tpu.analysis import (  # noqa: F401
         rules_context,
+        rules_durability,
         rules_errors,
         rules_locks,
         rules_obs,

@@ -1,0 +1,94 @@
+"""Rule family 7: durable-write discipline.
+
+Crash safety (docs/DURABILITY.md) hinges on one idiom: durable files
+are written temp → fsync → rename, never in place.  The sanctioned
+choke points live in ``kolibrie_tpu/durability/fsio.py``
+(``atomic_write`` / ``atomic_write_bytes`` / ``atomic_rename_dir``); a
+bare ``open(path, "wb")`` on a durable path is exactly the torn-write
+bug the WAL scanner exists to clean up after — except snapshots and
+manifests get no CRC-scan second chance.
+
+KL701  a write-mode ``open()`` call in a durability-tagged module
+       (anything under ``kolibrie_tpu/durability/`` or any module
+       carrying a ``# kolint: durable-path`` marker comment).
+       ``fsio.py`` itself is exempt — it IS the idiom.  Append-mode
+       WAL segment streams carry an explicit suppression with the
+       reason (``# kolint: ignore[KL701] ...``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from kolibrie_tpu.analysis.core import Finding, rule
+from kolibrie_tpu.analysis.project import Project
+
+_MARKER = "durable-path"
+_WRITE_CHARS = ("w", "a", "x", "+")
+
+
+def _is_durability_tagged(f) -> bool:
+    if f.rel.endswith("/fsio.py") or f.rel == "fsio.py":
+        return False  # the sanctioned choke point itself
+    if "/durability/" in f.rel or f.rel.startswith("durability/"):
+        return True
+    # `# kolint: durable-path` anywhere in the module opts it in
+    return any(
+        "kolint:" in c and _MARKER in c for c in f.comments.values()
+    )
+
+
+def _write_mode(call: ast.Call) -> str:
+    """The mode-string literal of an ``open()`` call if it requests
+    writing, else ''.  Non-literal modes are invisible (conservative:
+    no finding)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        if any(ch in mode.value for ch in _WRITE_CHARS):
+            return mode.value
+    return ""
+
+
+@rule(
+    "KL701",
+    "bare write-mode open() in a durability-tagged module — durable "
+    "files must go temp → fsync → rename via durability/fsio.py",
+)
+def durable_write_path(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _is_durability_tagged(f):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_open = (isinstance(fn, ast.Name) and fn.id == "open") or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "open"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("io", "os")
+            )
+            if not is_open:
+                continue
+            mode = _write_mode(node)
+            if not mode:
+                continue
+            out.append(
+                Finding(
+                    "KL701",
+                    f.rel,
+                    node.lineno,
+                    f"open(..., {mode!r}) writes a durable path in place "
+                    "— use fsio.atomic_write/atomic_write_bytes "
+                    "(temp → fsync → rename) so a crash never tears it",
+                )
+            )
+    return out

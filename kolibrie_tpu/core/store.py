@@ -328,6 +328,15 @@ class ColumnarTripleStore:
         self._o = _EMPTY
         self._pending_add: list = []  # list of (s,p,o) tuples or (N,3) arrays
         self._pending_del: set = set()
+        #: Optional mutation journal hook ``journal(event, payload)`` set by
+        #: the durability manager (docs/DURABILITY.md).  Fires at mutation
+        #: BUFFER time — the exact add_batch/remove units the two-tier
+        #: compactor later nets out — so WAL records ride the same
+        #: delta-batch boundaries the store itself produces.  Events:
+        #: ``("add", (N,3) uint32 array)``, ``("add1", (s,p,o))``,
+        #: ``("del", (s,p,o))``, ``("clear", None)``.  Never set on clones
+        #: or snapshot/restore twins (derived stores are CONFIGURATION).
+        self.journal = None
         self._orders: dict = {}
         self._device_cols = None
         self._device_orders: dict = {}
@@ -362,6 +371,8 @@ class ColumnarTripleStore:
     def add(self, s: int, p: int, o: int) -> None:
         self._pending_add.append((int(s), int(p), int(o)))
         self._pending_del.discard((int(s), int(p), int(o)))
+        if self.journal is not None:
+            self.journal("add1", (int(s), int(p), int(o)))
 
     def add_triple(self, t: Triple) -> None:
         self.add(t.subject, t.predicate, t.object)
@@ -389,10 +400,14 @@ class ColumnarTripleStore:
                 if not rows.isdisjoint(self._pending_del):
                     self.compact()
         self._pending_add.append(arr)
+        if self.journal is not None:
+            self.journal("add", arr)
 
     def remove(self, s: int, p: int, o: int) -> None:
         key = (int(s), int(p), int(o))
         self._pending_del.add(key)
+        if self.journal is not None:
+            self.journal("del", key)
 
     def clear(self) -> None:
         self._s = self._p = self._o = _EMPTY
@@ -400,6 +415,8 @@ class ColumnarTripleStore:
         self._pending_del = set()
         self._invalidate()
         self._merge_base()
+        if self.journal is not None:
+            self.journal("clear", None)
 
     # ------------------------------------------------------------ compaction
 

@@ -1,0 +1,16 @@
+"""Crash-safe durability: write-ahead log, atomic snapshots, recovery.
+
+- :mod:`~kolibrie_tpu.durability.fsio` — temp-write → fsync → rename
+  primitives (the KL701-sanctioned write path)
+- :mod:`~kolibrie_tpu.durability.wal` — checksummed segmented WAL
+- :mod:`~kolibrie_tpu.durability.manager` — snapshot generations,
+  startup recovery, and the store-journal attachment
+
+See docs/DURABILITY.md for the record format, fsync policies, recovery
+semantics, and the ops runbook.
+"""
+
+from kolibrie_tpu.durability.manager import DurabilityManager, RecoveryResult
+from kolibrie_tpu.durability.wal import WalWriter, scan_wal
+
+__all__ = ["DurabilityManager", "RecoveryResult", "WalWriter", "scan_wal"]

@@ -50,6 +50,7 @@ from kolibrie_tpu.resilience.errors import (
     Overloaded,
     QueryError,
     RequestTooLarge,
+    Unavailable,
     WindowCrash,
     error_response,
 )
@@ -105,6 +106,12 @@ _BATCH_FALLBACKS = obs_metrics.counter(
 _SESSION_CKPT_FAILURES = obs_metrics.counter(
     "kolibrie_session_checkpoint_failures_total",
     "RSP session checkpoint/restore attempts that failed",
+    labels=("op",),
+)
+_DURABILITY_ERRORS = obs_metrics.counter(
+    "kolibrie_durability_errors_total",
+    "background durability operations that failed (non-fatal: the WAL "
+    "still covers the data; watch this climbing)",
     labels=("op",),
 )
 _BATCH_DISPATCH_LAT = obs_metrics.histogram(
@@ -178,6 +185,9 @@ class EngineSession:
         self.dropped_subscribers = 0  # guarded by: lock
         self.crash_recoveries = 0  # guarded by: push_lock
         self.last_checkpoint: Optional[bytes] = None  # guarded by: push_lock
+        # set by startup recovery: this session was rebuilt from its
+        # logged CONFIGURATION + last durable checkpoint after a crash
+        self.recovered = False
 
     def emit(self, row: Tuple[Tuple[str, str], ...]) -> None:
         table = results_to_table([row])
@@ -432,12 +442,128 @@ class TemplateBatcher:
 
 
 class _ServerState:
-    def __init__(self):
+    def __init__(self, data_dir: Optional[str] = None):
         self.sessions: Dict[str, EngineSession] = {}  # guarded by: lock
         self.stores: Dict[str, TemplateBatcher] = {}  # guarded by: lock
         self.lock = threading.Lock()
         self.counter = itertools.count(1)  # guarded by: lock
         self.admission = AdmissionController(max_inflight=MAX_INFLIGHT)
+        # serving phase (guarded by: lock for writes; reads are racy-ok
+        # single-word loads): "recovering" -> "ready" -> "draining"
+        self.status = "ready"
+        self.durability = None
+        self.recovery_stats: dict = {}
+        if data_dir:
+            from kolibrie_tpu.durability import DurabilityManager
+
+            self.durability = DurabilityManager(data_dir)
+            self.status = "recovering"
+
+
+def _recover_server_state(state: _ServerState) -> None:
+    """Startup recovery: latest valid snapshot + WAL replay → rebuild the
+    persistent stores and /rsp sessions, then open the gate.  Runs on a
+    background thread so the socket binds (and /healthz answers
+    ``recovering``) while replay is in flight; mutating routes 503 with
+    Retry-After until this flips status to ``ready``."""
+    # fresh trace: recovery spans land in one queryable /debug/traces id
+    # (thread-locals do not cross the make_server -> worker hop)
+    with trace_scope(None):
+        _recover_server_state_traced(state)
+
+
+def _recover_server_state_traced(state: _ServerState) -> None:
+    import re
+
+    failures: Dict[str, str] = {}
+    max_id = 0
+    try:
+        result = state.durability.recover()
+        batchers: Dict[str, TemplateBatcher] = {}
+        for sid, db in result.stores.items():
+            # attach BEFORE serving: mutations from here on re-journal
+            # (log_create=False — the store's existence is already durable)
+            state.durability.attach(sid, db, log_create=False)
+            batchers[sid] = TemplateBatcher(db)
+            m = re.fullmatch(r"store-(\d+)", sid)
+            if m:
+                max_id = max(max_id, int(m.group(1)))
+        with state.lock:
+            state.stores.update(batchers)
+        for sid, rec in result.sessions.items():
+            reg = rec.get("register") or {}
+            if not reg.get("query"):
+                failures[sid] = "no CONFIGURATION logged (checkpoint only)"
+                continue
+            try:
+                _, session, _ = _build_session(
+                    state, reg, restore_blob=rec.get("state"), session_id=sid
+                )
+                session.recovered = True
+                session.last_checkpoint = rec.get("state")
+            except Exception as e:
+                failures[sid] = repr(e)
+                continue
+            if sid.isdigit():
+                max_id = max(max_id, int(sid))
+        stats = dict(result.stats)
+    except Exception as e:
+        # recovery must never wedge the server closed: serve empty, but
+        # leave a loud trace in /healthz and /stats
+        stats = {"error": repr(e)}
+        try:
+            state.durability.start()
+        except Exception:
+            _DURABILITY_ERRORS.labels("recovery_start").inc()
+    if failures:
+        stats["session_failures"] = failures
+    with state.lock:
+        # resume ids PAST everything recovered: a fresh register must
+        # never collide with a recovered session or store id
+        state.counter = itertools.count(max_id + 1)
+        state.recovery_stats = stats
+        state.status = "ready"
+
+
+def _snapshot_now(state: _ServerState) -> int:
+    """Commit a snapshot generation of every store and session.  Stores
+    are captured under their dispatch_lock (per-store atomicity is
+    sufficient: replay of overlapping WAL records is idempotent —
+    see durability/manager.py); session blobs under their push_lock."""
+    with state.lock:
+        batchers = dict(state.stores)
+        sessions = dict(state.sessions)
+    sess_payload: Dict[str, dict] = {}
+    for sid, session in sessions.items():
+        with session.push_lock:
+            blob = session.last_checkpoint
+            try:
+                blob = session.engine.checkpoint_state()
+            except Exception:
+                # stale blob is safe: recovery just replays a wider window
+                _SESSION_CKPT_FAILURES.labels("checkpoint").inc()
+        sess_payload[sid] = {
+            "register": getattr(session, "register_request", {}) or {},
+            "state": blob,
+        }
+    return state.durability.snapshot(
+        {sid: b.db for sid, b in batchers.items()},
+        sess_payload,
+        locks={sid: b.dispatch_lock for sid, b in batchers.items()},
+    )
+
+
+def _maybe_snapshot(state: _ServerState) -> None:
+    """Fold the WAL into a new generation when it has grown past the
+    threshold (advisory check — cheap on every mutating request)."""
+    if state.durability is None or not state.durability.should_snapshot():
+        return
+    try:
+        _snapshot_now(state)
+    except Exception:
+        # a failed snapshot never fails the request that tripped it; the
+        # WAL keeps growing and the next request retries
+        _DURABILITY_ERRORS.labels("snapshot").inc()
 
 
 def _build_rsp_engine(
@@ -473,6 +599,53 @@ def _build_rsp_engine(
     return engine
 
 
+def _build_session(
+    state: _ServerState,
+    reg: dict,
+    restore_blob: Optional[bytes] = None,
+    session_id: Optional[str] = None,
+) -> Tuple[str, EngineSession, List[str]]:
+    """Session factory shared by the /rsp handlers and startup recovery:
+    build the engine from its CONFIGURATION, optionally restore
+    checkpointed state, and register the session under ``session_id``
+    (recovery preserves ids) or a fresh counter id."""
+    holder: List[EngineSession] = []
+
+    def consumer(row):
+        if holder:
+            holder[0].emit(row)
+
+    engine = _build_rsp_engine(
+        reg["query"],
+        reg.get("static_rdf"),
+        reg.get("static_format") or "rdfxml",
+        reg.get("n3logic"),
+        reg.get("sparql_rules"),
+        consumer,
+    )
+    if restore_blob is not None:
+        engine.restore_state(restore_blob)
+    streams = [cfg.stream_iri for cfg in engine.window_configs]
+    session = EngineSession(engine, streams)
+    # keep the CONFIGURATION so /rsp/checkpoint blobs are restorable
+    session.register_request = {
+        k: reg.get(k)
+        for k in (
+            "query",
+            "static_rdf",
+            "static_format",
+            "n3logic",
+            "sparql_rules",
+        )
+    }
+    holder.append(session)
+    with state.lock:
+        if session_id is None:
+            session_id = str(next(state.counter))
+        state.sessions[session_id] = session
+    return session_id, session, streams
+
+
 def _push_event(engine, stream: str, timestamp: int, ntriples: str) -> int:
     """Parse N-Triples and route each triple to the stream's windows."""
     from kolibrie_tpu.query.rdf_parsers import parse_ntriples
@@ -502,6 +675,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     quiet = False
     _trace_id: Optional[str] = None
     _route_label: Optional[str] = None
+    _retry_after: Optional[float] = None
 
     # ------------------------------------------------------------- plumbing
 
@@ -520,6 +694,13 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         )
         if self._trace_id:
             self.send_header("X-Kolibrie-Trace-Id", self._trace_id)
+        if self._retry_after is not None:
+            # RFC 9110 delay-seconds is an integer; round UP so a client
+            # honoring it never comes back early
+            self.send_header(
+                "Retry-After", str(max(1, int(-(-self._retry_after // 1))))
+            )
+            self._retry_after = None
         self.end_headers()
         self.wfile.write(body)
         if self._route_label is not None:
@@ -537,6 +718,8 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         SystemExit) never reach here — the dispatch wrappers catch only
         ``Exception`` and :func:`error_response` re-raises them anyway."""
         status, payload = error_response(exc, context=self.path)
+        if isinstance(payload, dict) and payload.get("retry_after_s"):
+            self._retry_after = float(payload["retry_after_s"])
         self._send_json(payload, status)
 
     def _read_body(self) -> bytes:
@@ -578,12 +761,14 @@ class KolibrieHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path, _, qs = self.path.partition("?")
-        known = ("/", "/playground", "/stats", "/metrics", "/debug/traces")
-        self._route_label = (
-            "/rsp/events"
-            if path.startswith("/rsp/events/")
-            else (path if path in known else "unknown")
-        )
+        known = ("/", "/playground", "/stats", "/metrics", "/healthz",
+                 "/debug/traces")
+        if path.startswith("/rsp/events/"):
+            self._route_label = "/rsp/events"
+        elif path.startswith("/rsp/results/"):
+            self._route_label = "/rsp/results"
+        else:
+            self._route_label = path if path in known else "unknown"
         if path == "/" or path == "/playground":
             try:
                 with open(_PLAYGROUND_PATH, "rb") as f:
@@ -598,8 +783,12 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         routes = {
             "/stats": lambda: self._handle_stats(),
             "/metrics": lambda: self._handle_metrics(),
+            "/healthz": lambda: self._handle_healthz(),
             "/debug/traces": lambda: self._handle_debug_traces(qs),
         }
+        if path.startswith("/rsp/results/"):
+            sid = path[len("/rsp/results/"):]
+            routes[path] = lambda: self._handle_rsp_results(sid)
         with trace_scope(
             self.headers.get("X-Kolibrie-Trace-Id") or None
         ) as tid:
@@ -643,6 +832,12 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 try:
                     if name is None:
                         raise NotFound("not found")
+                    # mutating routes wait out recovery (503 + Retry-After)
+                    # and are refused outright during drain; observability
+                    # GETs (/healthz, /stats, /metrics) stay open throughout
+                    phase = self.state.status
+                    if phase != "ready":
+                        raise Unavailable(phase=phase)
                     getattr(self, name)()
                 except Exception as e:
                     # single choke point: handlers raise taxonomy errors
@@ -771,6 +966,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 db.execution_mode = req.get("mode") or "device"
                 batcher = TemplateBatcher(db)
                 state.stores[sid] = batcher
+                if state.durability is not None:
+                    # attach before the first mutation: every add/delete
+                    # from here on lands in the WAL as a "mut" record
+                    state.durability.attach(sid, db)
         try:
             with batcher.dispatch_lock:
                 if req.get("mode"):
@@ -780,6 +979,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 )
         except Exception as e:
             raise BadRequest(f"RDF parse error: {e}") from e
+        _maybe_snapshot(state)
         self._send_json(
             {"store_id": sid, "loaded": n, "triples": len(batcher.db.store)}
         )
@@ -820,6 +1020,34 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         snapshot, and jit compile counts.  Rendered by obs.export — the
         same source of truth as TemplateBatcher.stats()."""
         self._send_json(obs_export.build_stats(self.state))
+
+    def _handle_healthz(self):
+        """Readiness probe: 200 ``ready`` / 503 ``recovering``/``draining``
+        (Docker HEALTHCHECK and the chaos harness poll this)."""
+        state = self.state
+        body = {"status": state.status}
+        if state.durability is not None:
+            body["durability"] = state.durability.stats()
+            body["recovery"] = state.recovery_stats
+        self._send_json(body, 200 if state.status == "ready" else 503)
+
+    def _handle_rsp_results(self, session_id: str):
+        """The session's server-side result log (what SSE subscribers got),
+        plus its recovery lineage — the chaos harness compares this against
+        the oracle after a kill-restart."""
+        with self.state.lock:
+            session = self.state.sessions.get(session_id)
+        if session is None:
+            raise NotFound("session not found")
+        with session.lock:
+            results = list(session.results)
+        self._send_json(
+            {
+                "results": results,
+                "recovered": session.recovered,
+                "crash_recoveries": session.crash_recoveries,
+            }
+        )
 
     def _handle_metrics(self):
         """Prometheus text exposition of the process-wide registry."""
@@ -929,44 +1157,23 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         CONFIGURATION, optionally restore checkpointed state, register the
         session, and answer with its id.  (docs/PREEMPTION.md: a restore is
         a re-register plus state.)"""
-        holder: List[EngineSession] = []
-
-        def consumer(row):
-            if holder:
-                holder[0].emit(row)
-
+        state = self.state
         try:
-            engine = _build_rsp_engine(
-                reg["query"],
-                reg.get("static_rdf"),
-                reg.get("static_format") or "rdfxml",
-                reg.get("n3logic"),
-                reg.get("sparql_rules"),
-                consumer,
+            session_id, session, streams = _build_session(
+                state, reg, restore_blob=restore_blob
             )
-            if restore_blob is not None:
-                engine.restore_state(restore_blob)
         except Exception as e:
             verb = "restore" if restore_blob is not None else "build"
             raise BadRequest(f"Failed to {verb} RSP engine: {e}") from e
-        streams = [cfg.stream_iri for cfg in engine.window_configs]
-        session = EngineSession(engine, streams)
-        # keep the CONFIGURATION so /rsp/checkpoint blobs are restorable
-        session.register_request = {
-            k: reg.get(k)
-            for k in (
-                "query",
-                "static_rdf",
-                "static_format",
-                "n3logic",
-                "sparql_rules",
+        if state.durability is not None:
+            # CONFIGURATION first, then state: replay order mirrors this
+            state.durability.log_session_register(
+                session_id, session.register_request
             )
-        }
-        holder.append(session)
-        state = self.state
-        with state.lock:
-            session_id = str(next(state.counter))
-            state.sessions[session_id] = session
+            if restore_blob is not None:
+                state.durability.log_session_checkpoint(
+                    session_id, restore_blob
+                )
         self._send_json({"session_id": session_id, "streams": streams})
 
     def _handle_rsp_register(self):
@@ -1018,12 +1225,14 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def _handle_rsp_push(self):
         req = self._read_json()
         state = self.state
+        sid = str(req.get("session_id"))
         with state.lock:
-            session = state.sessions.get(str(req.get("session_id")))
+            session = state.sessions.get(sid)
         if session is None:
             raise NotFound("session not found")
         with session.push_lock, deadline_scope(self._request_deadline(req)):
             try:
+                prev_blob = session.last_checkpoint
                 n = _push_event(
                     session.engine,
                     req.get("stream", ""),
@@ -1034,6 +1243,16 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 # on a later push rolls back to this consistent state and
                 # the client replays from here (at-least-once)
                 session.maybe_checkpoint()
+                if (
+                    state.durability is not None
+                    and session.last_checkpoint is not None
+                    and session.last_checkpoint is not prev_blob
+                ):
+                    # the durable mirror of maybe_checkpoint: a kill -9
+                    # resumes this session from exactly this blob
+                    state.durability.log_session_checkpoint(
+                        sid, session.last_checkpoint
+                    )
             except WindowCrash as e:
                 recovered = session.recover()
                 payload = e.payload(context=self.path)
@@ -1045,10 +1264,21 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 raise
             except Exception as e:
                 raise QueryError(f"Push error: {e}") from e
-        self._send_json({"ok": True, "triples": n})
+        _maybe_snapshot(state)
+        self._send_json({"ok": True, "triples": n, "recovered": session.recovered})
 
     def _handle_sse(self, session_id: str):
         state = self.state
+        if state.status != "ready":
+            # a subscriber arriving mid-recovery would race session
+            # rebuild — 503 with Retry-After like the mutating routes
+            status, payload = error_response(
+                Unavailable(phase=state.status), context=self.path
+            )
+            if payload.get("retry_after_s"):
+                self._retry_after = float(payload["retry_after_s"])
+            self._send_json(payload, status)
+            return
         with state.lock:
             session = state.sessions.get(session_id)
         if session is None:
@@ -1084,20 +1314,82 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             session.unsubscribe(q)
 
 
-def make_server(host: str = "127.0.0.1", port: int = 7878, quiet: bool = False):
+def make_server(
+    host: str = "127.0.0.1",
+    port: int = 7878,
+    quiet: bool = False,
+    data_dir: Optional[str] = None,
+    recover_async: bool = True,
+):
+    """Build the HTTP server.  With ``data_dir`` the server is durable:
+    every store mutation batch and session checkpoint rides the WAL, and
+    boot runs crash recovery (latest valid snapshot + WAL replay) before
+    the gate opens — on a background thread by default so the socket
+    binds immediately and serves 503 + Retry-After while replaying."""
+    state = _ServerState(data_dir=data_dir)
     handler = type(
-        "BoundHandler", (KolibrieHandler,), {"state": _ServerState(), "quiet": quiet}
+        "BoundHandler", (KolibrieHandler,), {"state": state, "quiet": quiet}
     )
-    return ThreadingHTTPServer((host, port), handler)
+    httpd = ThreadingHTTPServer((host, port), handler)
+    if state.durability is not None:
+        if recover_async:
+            threading.Thread(
+                target=_recover_server_state,
+                args=(state,),
+                daemon=True,
+                name="kolibrie-recovery",
+            ).start()
+        else:
+            _recover_server_state(state)
+    return httpd
+
+
+def shutdown_gracefully(httpd, timeout_s: float = 30.0) -> None:
+    """SIGTERM path: gate admissions (``draining`` → new requests 503),
+    wait for in-flight requests to finish, commit a final snapshot, flush
+    and close the WAL, then stop the listener.  Safe to call on a
+    non-durable server (drain + stop only)."""
+    state = httpd.RequestHandlerClass.state
+    with state.lock:
+        state.status = "draining"
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and state.admission.inflight > 0:
+        time.sleep(0.05)
+    if state.durability is not None:
+        try:
+            _snapshot_now(state)
+        except Exception:
+            # WAL replay covers everything the snapshot would have; close
+            # still flushes + fsyncs the tail below
+            _DURABILITY_ERRORS.labels("final_snapshot").inc()
+        state.durability.close()
+    httpd.shutdown()
 
 
 def serve(host: str = "127.0.0.1", port: int = 7878) -> None:
-    httpd = make_server(host, port)
+    import signal
+
+    data_dir = os.environ.get("KOLIBRIE_DATA_DIR") or None
+    httpd = make_server(host, port, data_dir=data_dir)
+
+    def _on_sigterm(signum, frame):
+        # drain on a worker thread: the handler itself must return fast,
+        # and serve_forever unblocks when shutdown() is called
+        threading.Thread(
+            target=shutdown_gracefully, args=(httpd,), daemon=True
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded in tests)
     print(f"kolibrie-tpu server listening on http://{host}:{port}")
+    if data_dir:
+        print(f"durable data dir: {data_dir}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
-        httpd.shutdown()
+        shutdown_gracefully(httpd)
 
 
 if __name__ == "__main__":

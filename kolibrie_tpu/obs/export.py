@@ -141,18 +141,26 @@ def build_stats(state) -> dict:
                 "subscribers": len(s.subscribers),
                 "dropped_subscribers": s.dropped_subscribers,
                 "crash_recoveries": s.crash_recoveries,
+                "recovered": getattr(s, "recovered", False),
             }
         rstats = getattr(s.engine, "resilience_stats", None)
         if rstats is not None:
             info["windows"] = rstats()
         per_session[sid] = info
+    resilience = {
+        "admission": state.admission.snapshot(),
+        "sessions": per_session,
+    }
+    durability = getattr(state, "durability", None)
+    if durability is not None:
+        resilience["durability"] = {
+            "status": getattr(state, "status", "ready"),
+            **durability.stats(),
+        }
     return {
         "stores": {sid: store_stats(b) for sid, b in stores.items()},
         "rsp_sessions": len(sessions),
-        "resilience": {
-            "admission": state.admission.snapshot(),
-            "sessions": per_session,
-        },
+        "resilience": resilience,
     }
 
 

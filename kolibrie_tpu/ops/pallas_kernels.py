@@ -132,7 +132,11 @@ def _merge_join_kernel(
     rows_s,  # VMEM scratch (2*BW, 5): the two resident blocks, contiguous
 ):
     g = pl.program_id(0)
-    base = (row_start_ref[g * G] // BW) * BW  # first resident row
+    # first resident row; lax.div (trunc == floor: row starts are
+    # non-negative) with a concrete i32 divisor — under a caller's
+    # jax.enable_x64 the weak literal `// BW` lowers as an i64 constant
+    # whose floor_divide helper call collides with the i32 instantiation
+    base = lax.div(row_start_ref[g * G], jnp.int32(BW)) * BW
     total = row_start_ref[pl.num_programs(0) * G]
     # Global index of this launch's first output: 0 for the whole-join
     # launch; chunk_index * chunk_out for the chunked driver, whose row
@@ -279,11 +283,15 @@ def _pallas_join_core(
 
     def blk_a(g, rs):
         # clamp: the pipeline evaluates index maps one step past the grid,
-        # where rs[g*G] is the TOTAL (a match count, not a row index)
-        return (jnp.minimum(rs[g * G] // BW, nb - 2), 0, 0)
+        # where rs[g*G] is the TOTAL (a match count, not a row index).
+        # lax.div (trunc == floor: row starts are non-negative) with a
+        # concrete i32 divisor — index maps lower under the CALLER's x64
+        # config, and `// BW` there emits a floor_divide helper call whose
+        # i64 operand collides with the kernel body's i32 instantiation.
+        return (jnp.minimum(lax.div(rs[g * G], jnp.int32(BW)), nb - 2), 0, 0)
 
     def blk_b(g, rs):
-        return (jnp.minimum(rs[g * G] // BW + 1, nb - 1), 0, 0)
+        return (jnp.minimum(lax.div(rs[g * G], jnp.int32(BW)) + 1, nb - 1), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -379,10 +387,15 @@ def _pallas_join_core_chunked(
     out_block = pl.BlockSpec((G, TILE), lambda g, *_: (g, 0))
 
     def blk_a(g, rs):
-        return (jnp.minimum(rs[g * G] // BW, nb_loc - 2), 0, 0)
+        # lax.div + i32 divisor: see the unchunked blk_a on x64 lowering
+        return (jnp.minimum(lax.div(rs[g * G], jnp.int32(BW)), nb_loc - 2), 0, 0)
 
     def blk_b(g, rs):
-        return (jnp.minimum(rs[g * G] // BW + 1, nb_loc - 1), 0, 0)
+        return (
+            jnp.minimum(lax.div(rs[g * G], jnp.int32(BW)) + 1, nb_loc - 1),
+            0,
+            0,
+        )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

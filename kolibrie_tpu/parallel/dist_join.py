@@ -285,7 +285,10 @@ def dist_bgp_join_count(store, p1: int, p2: int) -> int:
     with zero exchange and one scalar psum.  This is the headline
     BGP-join benchmark path (BASELINE.md config 1/5).
     """
-    return int(dist_bgp_join_count_device(store, p1, p2)[0])
+    # host readback, not a device gather: the count array is i64 (the
+    # device path runs under enable_x64) and an eager [0] outside that
+    # scope lowers with an i32 result type against the i64 operand
+    return int(jax.device_get(dist_bgp_join_count_device(store, p1, p2))[0])
 
 
 def dist_bgp_join_count_device(store, p1: int, p2: int):

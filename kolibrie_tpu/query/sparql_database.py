@@ -205,13 +205,16 @@ class SparqlDatabase:
         application and lazily rebuilt from the restored columns.  The
         reference keeps everything in memory with no snapshot at all
         (SURVEY §5 "checkpoint/resume: none")."""
-        import pickle
+        # kolint: durable-path — checkpoints must survive a crash mid-write
+        from kolibrie_tpu.durability.fsio import atomic_write
 
         s, p, o = self.store.columns()
         seeds = self.probability_seeds
         # write through a file object: np.savez_compressed appends ".npz"
-        # to bare string paths, which would break same-path restore
-        with open(path, "wb") as fh:
+        # to bare string paths, which would break same-path restore.
+        # temp → fsync → rename: a kill -9 mid-checkpoint leaves the
+        # previous checkpoint intact, never a torn half-file (KL701)
+        with atomic_write(path) as fh:
             self._checkpoint_to(fh, s, p, o, seeds)
 
     def _checkpoint_to(self, fh, s, p, o, seeds) -> None:

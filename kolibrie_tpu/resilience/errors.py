@@ -122,6 +122,41 @@ class WindowCrash(KolibrieError):
     code = "window_crashed"
 
 
+class Unavailable(KolibrieError):
+    """The server is up but not serving: replaying its WAL after a crash
+    (``recovering``) or draining in-flight work before a SIGTERM exit
+    (``draining``).  Clients should honor ``Retry-After`` — the HTTP
+    layer emits the header from ``retry_after_s``."""
+
+    http_status = 503
+    code = "unavailable"
+
+    def __init__(
+        self,
+        message: str = "server unavailable",
+        phase: str = "recovering",
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message)
+        self.phase = phase
+        self.retry_after_s = retry_after_s
+
+    def payload(self, context: str = "") -> Dict[str, object]:
+        out = super().payload(context)
+        out["phase"] = self.phase
+        out["retry_after_s"] = self.retry_after_s
+        return out
+
+
+class DurabilityError(KolibrieError):
+    """A WAL append, fsync, snapshot, or recovery step failed.  Surfaced
+    as a 500 — the mutation's durability cannot be acknowledged — and the
+    operator runbook (docs/DURABILITY.md) covers triage."""
+
+    http_status = 500
+    code = "durability_failed"
+
+
 def is_device_fault(exc: BaseException) -> bool:
     """Does this exception count against a template's circuit breaker?
 

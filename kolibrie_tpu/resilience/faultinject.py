@@ -3,8 +3,10 @@
 The serving/query/streaming layers call :func:`fault_point(site)` at the
 places where real hardware and real streams fail: device compile
 (``device.lower``), device dispatch (``device.execute``,
-``device.batch``), and window processing (``rsp.window``).  With no plan
-installed a fault point is a single dict lookup — effectively free.
+``device.batch``), window processing (``rsp.window``), and the WAL's
+disk path (``wal.append`` for torn writes and bit flips, ``wal.fsync``
+for partial fsyncs — see :mod:`kolibrie_tpu.durability.wal`).  With no
+plan installed a fault point is a single dict lookup — effectively free.
 
 A :class:`FaultPlan` arms sites with rules.  Every rule is
 DETERMINISTIC: rate-based rules draw from a per-site ``random.Random``
@@ -60,6 +62,23 @@ class InjectedDeviceOOM(DeviceFault, InjectedFault):
 
 class InjectedWindowCrash(WindowCrash, InjectedFault):
     """Simulated window-processor thread crash."""
+
+
+class InjectedTornWrite(InjectedFault):
+    """Simulated crash mid-``write()``: the WAL appender writes a PREFIX
+    of the frame and fails the append (site ``wal.append``).  Recovery
+    must truncate the torn tail."""
+
+
+class InjectedBitFlip(InjectedFault):
+    """Simulated silent corruption: the WAL appender flips one payload
+    bit and completes the append without error (site ``wal.append``).
+    Only the recovery scanner's CRC notices."""
+
+
+class InjectedFsyncFault(InjectedFault, OSError):
+    """Simulated partial/failed fsync (site ``wal.fsync``): data may have
+    reached the disk cache but durability cannot be acknowledged."""
 
 
 class _SiteRule:

@@ -584,7 +584,14 @@ def _window_maintain(*args):
         import jax
 
         _window_maintain_jit = jax.jit(_window_maintain_impl)
-    return _window_maintain_jit(*args)
+    # call (= lowering point) under x64: set_difference_rows packs u64
+    # keys whose LITERALS (shift amounts, pad sentinels) are canonicalized
+    # at lowering time by the ambient config — outside the scope they drop
+    # to u32 and fail the stablehlo verifier against the u64 operands
+    from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64
+
+    with _enable_x64(True):
+        return _window_maintain_jit(*args)
 
 
 def _window_maintain_impl(fs, fp, fo, n, rs, rp, ro, n_rem, as_, ap_, ao_, n_add):

@@ -1,0 +1,349 @@
+"""Kill-restart chaos: a REAL server process killed with SIGKILL mid-load
+and mid-window, restarted on the same data dir, and verified against an
+oracle — ISSUE 7 acceptance.
+
+Runs under ``KOLIBRIE_FSYNC=always`` so every acknowledged response is a
+durability promise: anything a client saw a 200 for must be present after
+recovery (and nothing unacknowledged may be invented).  Torn-write and
+CRC-corrupt WAL tails are staged on the dead server's log before restart
+— the exact debris a power cut leaves — and recovery must truncate them
+and still reach the oracle state.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kolibrie_tpu.durability import wal
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def post(base, path, payload, timeout=60):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(base, path, timeout=60):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerProc:
+    """A real ``http_server`` child process on a durable data dir."""
+
+    def __init__(self, data_dir, port=None):
+        self.data_dir = str(data_dir)
+        self.port = port or _free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        env = dict(os.environ)
+        env.update(
+            {
+                "KOLIBRIE_DATA_DIR": self.data_dir,
+                "KOLIBRIE_FSYNC": "always",
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        self.log_path = self.data_dir + ".server.log"
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kolibrie_tpu.frontends.http_server",
+             "127.0.0.1", str(self.port)],
+            env=env,
+            stdout=self._log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def wait_ready(self, timeout_s=90.0):
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                with open(self.log_path, "rb") as fh:
+                    tail = fh.read()[-2000:].decode("utf-8", "replace")
+                raise AssertionError(
+                    f"server died during boot (rc={self.proc.returncode}):\n{tail}"
+                )
+            try:
+                st, out = get(self.base, "/healthz", timeout=5)
+                last = (st, out)
+                if st == 200 and out.get("status") == "ready":
+                    return out
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.1)
+        raise AssertionError(f"server never became ready: {last}")
+
+    def kill9(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        self._log.close()
+
+
+@pytest.fixture
+def data_dir(tmp_path):
+    return str(tmp_path / "data")
+
+
+def _ntriples(lo, hi):
+    return "\n".join(
+        f"<http://e/s{i}> <http://e/p> <http://e/o{i}> ." for i in range(lo, hi)
+    )
+
+
+def _oracle(lo, hi):
+    return {(f"http://e/s{i}", "http://e/p", f"http://e/o{i}") for i in range(lo, hi)}
+
+
+def _store_rows(base, store_id):
+    st, out = post(
+        base,
+        "/store/query",
+        {
+            "store_id": store_id,
+            "sparql": "SELECT ?s ?p ?o WHERE { ?s ?p ?o }",
+        },
+    )
+    assert st == 200, out
+    return {tuple(r) for r in out["data"]}
+
+
+def _last_segment_path(data_dir):
+    wal_dir = os.path.join(data_dir, "wal")
+    segs = wal.list_segments(wal_dir)
+    assert segs, "no WAL segments on disk after the kill"
+    return wal.segment_path(wal_dir, segs[-1])
+
+
+def _corrupt_tail(data_dir, kind):
+    """Stage post-crash debris on the dead server's newest WAL segment."""
+    path = _last_segment_path(data_dir)
+    with open(path, "ab") as fh:
+        if kind == "torn":
+            # a frame header + half the payload: write() died mid-call
+            frame = wal.encode_record({"k": "mut", "st": "store-1", "ev": "clear"})
+            fh.write(frame[: len(frame) // 2])
+        elif kind == "crc":
+            # full-length frame whose payload rotted on disk
+            frame = bytearray(wal.encode_record({"k": "mut", "st": "store-1", "ev": "clear"}))
+            frame[-1] ^= 0x20
+            fh.write(bytes(frame))
+        else:  # pragma: no cover - test bug
+            raise AssertionError(kind)
+
+
+# ------------------------------------------------------- kill -9 mid-ingest
+
+
+@pytest.mark.parametrize("debris", [None, "torn", "crc"])
+def test_kill9_mid_ingest_recovers_acknowledged_triples(data_dir, debris):
+    """SIGKILL a live server between acknowledged ingest batches; restart
+    on the same data dir.  Every batch the client got a 200 for must be
+    in the recovered store, byte-for-byte equal to the set oracle — also
+    when the crash left a torn or CRC-corrupt record on the WAL tail.
+    Staged debris encodes a destructive `clear`: if recovery replayed it
+    instead of truncating, the oracle check would catch an empty store.
+    """
+    srv = ServerProc(data_dir)
+    try:
+        srv.wait_ready()
+        st, out = post(
+            srv.base,
+            "/store/load",
+            {"rdf": _ntriples(0, 40), "format": "ntriples"},
+        )
+        assert st == 200, out
+        store_id = out["store_id"]
+        st, out = post(
+            srv.base,
+            "/store/load",
+            {"rdf": _ntriples(40, 70), "format": "ntriples",
+             "store_id": store_id},
+        )
+        assert st == 200, out
+        srv.kill9()  # no drain, no final snapshot: the WAL is all there is
+    finally:
+        srv.stop()
+
+    if debris:
+        _corrupt_tail(data_dir, debris)
+
+    srv2 = ServerProc(data_dir, port=srv.port)
+    try:
+        health = srv2.wait_ready()
+        rec = health["recovery"]
+        assert store_id in rec["stores"]
+        assert rec["replayed_records"] > 0
+        if debris:
+            assert rec["truncated_records"] >= 1
+            assert rec["corrupt_reason"] is not None
+        assert _store_rows(srv2.base, store_id) == _oracle(0, 70)
+        # the recovered store is live: mutations append to the new WAL
+        st, out = post(
+            srv2.base,
+            "/store/load",
+            {"rdf": _ntriples(70, 75), "format": "ntriples",
+             "store_id": store_id},
+        )
+        assert st == 200, out
+        assert _store_rows(srv2.base, store_id) == _oracle(0, 75)
+    finally:
+        srv2.stop()
+
+
+def test_kill9_unacknowledged_data_is_not_invented(data_dir):
+    """Recovery must never contain triples the client was not acked for:
+    the staged torn tail is a half-written insert batch, and the store
+    must come back WITHOUT it."""
+    srv = ServerProc(data_dir)
+    try:
+        srv.wait_ready()
+        st, out = post(
+            srv.base,
+            "/store/load",
+            {"rdf": _ntriples(0, 20), "format": "ntriples"},
+        )
+        assert st == 200, out
+        store_id = out["store_id"]
+        srv.kill9()
+    finally:
+        srv.stop()
+
+    # a torn half-frame of an insert that was never acknowledged
+    path = _last_segment_path(data_dir)
+    frame = wal.encode_record(
+        {"k": "mut", "st": store_id, "ev": "add", "n": 1},
+        b"\x00" * 12,
+    )
+    with open(path, "ab") as fh:
+        fh.write(frame[: len(frame) - 3])
+
+    srv2 = ServerProc(data_dir, port=srv.port)
+    try:
+        health = srv2.wait_ready()
+        assert health["recovery"]["truncated_records"] >= 1
+        assert _store_rows(srv2.base, store_id) == _oracle(0, 20)
+    finally:
+        srv2.stop()
+
+
+# ------------------------------------------------- kill -9 mid-window (RSP)
+
+
+RSP_QUERY = (
+    "REGISTER RSTREAM <out> AS SELECT * "
+    "FROM NAMED WINDOW <w> ON <stream1> [RANGE 10 STEP 2] "
+    "WHERE { WINDOW <w> { ?s ?p ?o } }"
+)
+
+
+def _push(base, sid, ts):
+    return post(
+        base,
+        "/rsp/push",
+        {
+            "session_id": sid,
+            "stream": "stream1",
+            "timestamp": ts,
+            "ntriples": f"<http://e/s{ts}> <http://e/p> <http://e/o{ts}> .",
+        },
+    )
+
+
+def _session_results(base, sid):
+    st, out = get(base, f"/rsp/results/{sid}")
+    assert st == 200, out
+    return out
+
+
+def test_kill9_mid_window_session_resumes_from_checkpoint(data_dir, tmp_path):
+    """SIGKILL with a live /rsp session mid-window; the restarted server
+    re-creates the session from its logged CONFIGURATION + last durable
+    checkpoint, flags it `recovered`, and the pre-crash result log plus
+    the post-restart emissions equal an uninterrupted reference run."""
+    # reference: the same event sequence on one uninterrupted server
+    ref_dir = str(tmp_path / "ref-data")
+    ref = ServerProc(ref_dir)
+    try:
+        ref.wait_ready()
+        st, reg = post(ref.base, "/rsp/register", {"query": RSP_QUERY})
+        assert st == 200, reg
+        ref_sid = reg["session_id"]
+        for ts in [1, 2, 3, 4, 5, 6]:
+            st, out = _push(ref.base, ref_sid, ts)
+            assert st == 200, out
+        ref_rows = _session_results(ref.base, ref_sid)["results"]
+    finally:
+        ref.stop()
+
+    srv = ServerProc(data_dir)
+    try:
+        srv.wait_ready()
+        st, reg = post(srv.base, "/rsp/register", {"query": RSP_QUERY})
+        assert st == 200, reg
+        sid = reg["session_id"]
+        for ts in [1, 2, 3, 4]:
+            st, out = _push(srv.base, sid, ts)
+            assert st == 200, out
+            assert out["recovered"] is False
+        pre_crash = _session_results(srv.base, sid)
+        assert pre_crash["recovered"] is False
+        srv.kill9()  # mid-stream: the window at ts=4 is still open
+    finally:
+        srv.stop()
+
+    srv2 = ServerProc(data_dir, port=srv.port)
+    try:
+        health = srv2.wait_ready()
+        assert sid in health["recovery"]["sessions"]
+        post_crash = _session_results(srv2.base, sid)
+        assert post_crash["recovered"] is True
+        for ts in [5, 6]:
+            st, out = _push(srv2.base, sid, ts)
+            assert st == 200, out
+            assert out["recovered"] is True  # the session survived a crash
+        combined = pre_crash["results"] + _session_results(srv2.base, sid)["results"]
+        assert combined == ref_rows
+        # a session registered AFTER recovery must not collide with the
+        # recovered id and starts unrecovered
+        st, reg2 = post(srv2.base, "/rsp/register", {"query": RSP_QUERY})
+        assert st == 200, reg2
+        assert reg2["session_id"] != sid
+        st, out = _push(srv2.base, reg2["session_id"], 1)
+        assert st == 200 and out["recovered"] is False
+    finally:
+        srv2.stop()

@@ -498,6 +498,93 @@ def dispatch(req):
     assert res.findings == []
 
 
+# ------------------------------------------ KL701: durable-write discipline
+
+
+BAD_KL701 = """
+# kolint: durable-path — this module writes the snapshot manifest
+
+def write_manifest(path, payload):
+    with open(path, "wb") as fh:  # in-place: a crash tears the manifest
+        fh.write(payload)
+"""
+
+GOOD_KL701 = """
+# kolint: durable-path — this module writes the snapshot manifest
+from kolibrie_tpu.durability.fsio import atomic_write_bytes
+
+def write_manifest(path, payload):
+    atomic_write_bytes(path, payload)
+
+def read_manifest(path):
+    with open(path, "rb") as fh:  # read-mode: not a durability hazard
+        return fh.read()
+"""
+
+
+def test_kl701_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL701)
+    assert rules_fired(res) == ["KL701"]
+    assert "'wb'" in res.findings[0].message
+    assert "atomic_write" in res.findings[0].message
+
+
+def test_kl701_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL701)
+    assert res.findings == []
+
+
+def test_kl701_untagged_module_is_exempt(tmp_path):
+    # same bare write, but the module never opts into durable-path and
+    # does not live under durability/ — scratch files are fine
+    src = BAD_KL701.replace(
+        "# kolint: durable-path — this module writes the snapshot manifest",
+        "",
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+
+
+def test_kl701_durability_package_is_auto_tagged(tmp_path):
+    # anything under kolibrie_tpu/durability/ needs no marker comment
+    sub = tmp_path / "durability"
+    sub.mkdir()
+    src = BAD_KL701.replace(
+        "# kolint: durable-path — this module writes the snapshot manifest",
+        "",
+    )
+    p = sub / "manifest.py"
+    p.write_text(src)
+    res = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert rules_fired(res) == ["KL701"]
+
+
+def test_kl701_fsio_is_the_sanctioned_choke_point(tmp_path):
+    # fsio.py IS the temp → fsync → rename idiom; it must open in place
+    sub = tmp_path / "durability"
+    sub.mkdir()
+    p = sub / "fsio.py"
+    p.write_text(
+        "def atomic_write_bytes(path, payload):\n"
+        "    with open(path + '.tmp', 'wb') as fh:\n"
+        "        fh.write(payload)\n"
+    )
+    res = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert res.findings == []
+
+
+def test_kl701_suppression_with_reason(tmp_path):
+    src = BAD_KL701.replace(
+        '    with open(path, "wb") as fh:  # in-place: a crash tears the manifest',
+        '    # kolint: ignore[KL701] fixture: this path is a scratch spool\n'
+        '    with open(path, "wb") as fh:',
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].rule == "KL701"
+
+
 # ------------------------------------------------ suppression mechanics
 
 
@@ -624,7 +711,8 @@ def test_cli_list_rules(capsys):
     assert kolint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("KL101", "KL102", "KL201", "KL202", "KL301", "KL302",
-                "KL401", "KL501", "KL502", "KL601", "KL001", "KL002"):
+                "KL401", "KL501", "KL502", "KL601", "KL701",
+                "KL001", "KL002"):
         assert rid in out
 
 

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 import jax.numpy as jnp
 
+from kolibrie_tpu.ops.jax_compat import enable_x64 as _enable_x64
 from kolibrie_tpu.ops.pallas_kernels import (
     TILE,
     filter_mask,
