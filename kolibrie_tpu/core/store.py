@@ -797,6 +797,40 @@ class ColumnarTripleStore:
             self._delta_del_pos[name] = pos
         return pos
 
+    def segment_signature(self) -> Tuple[int, int, int, int]:
+        """Identity of the live two-tier state:
+        ``(base_version, delta_epoch, n_delta_adds, n_delta_dels)``.
+
+        ``(base_version, delta_epoch)`` alone identifies state within one
+        store lineage; the delta counts make the tuple robust across
+        :meth:`snapshot`/:meth:`restore` round trips that land on the same
+        epoch counters with different pending deltas.  Derived mirrors
+        (the sharded serving layer's per-shard device blocks) key their
+        staleness checks on this tuple."""
+        self.compact()
+        return (
+            self._base_version,
+            self._delta_epoch,
+            len(self._delta_add_set),
+            len(self._delta_del_set),
+        )
+
+    def base_rows(self, name: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Canonical ``(s, p, o)`` host columns of the FROZEN base in
+        ``name``'s row permutation, unpadded.  Row index ``i`` here is the
+        coordinate space of :meth:`delta_del_positions` — partitioners that
+        keep a row→shard map can translate tombstones without re-probing."""
+        so = self.base_order(name)
+        canon = {so.perm[0]: so.c0, so.perm[1]: so.c1, so.perm[2]: so.c2}
+        return canon["s"], canon["p"], canon["o"]
+
+    def delta_rows(self, name: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Canonical ``(s, p, o)`` host columns of the delta ADD rows in
+        ``name``'s permutation, unpadded (sorted, O(delta) small)."""
+        so = self.delta_order(name)
+        canon = {so.perm[0]: so.c0, so.perm[1]: so.c1, so.perm[2]: so.c2}
+        return canon["s"], canon["p"], canon["o"]
+
     def device_segment(self, name: str):
         """Two-tier device mirror of one sort order:
         ``(base_cols, delta_cols, del_pos)`` where

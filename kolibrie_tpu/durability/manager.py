@@ -321,6 +321,11 @@ class DurabilityManager:
         self.generation = self._latest_generation()
         self.last_recovery: Optional[dict] = None
         self._bytes_at_snapshot = 0
+        # invoked as on_store_recovered(store_id, db) per store at the END
+        # of recover(), after snapshot restore + WAL replay + compact —
+        # the hook the serving layer uses to rebuild device-resident
+        # sharded mirrors from recovered state (parallel/sharded_serving)
+        self.on_store_recovered = None
 
     # ------------------------------------------------------------ generations
 
@@ -444,6 +449,11 @@ class DurabilityManager:
         for sid, db in res.stores.items():
             db.store.compact()
             res.modes.setdefault(sid, db.execution_mode)
+            if self.on_store_recovered is not None:
+                # derived device state (e.g. sharded serving mirrors) is
+                # NOT in the snapshot/WAL — it rebuilds from the recovered
+                # host store here, before the store starts serving
+                self.on_store_recovered(sid, db)
         # resume appends on a FRESH segment — never into a truncated file
         segs = list_segments(self.wal_dir)
         next_seg = (segs[-1] + 1) if segs else max(wal_start, 1)

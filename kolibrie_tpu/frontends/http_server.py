@@ -65,6 +65,13 @@ MAX_QUEUE_DEPTH = int(os.environ.get("KOLIBRIE_MAX_QUEUE_DEPTH", "256"))
 SSE_SUBSCRIBER_QUEUE_MAX = int(
     os.environ.get("KOLIBRIE_SSE_QUEUE_MAX", "1024")
 )
+# Opt-in mesh serving (docs/SHARDING.md): persistent stores attach a
+# ShardedDatabase so batched same-template groups run as one shard_map
+# dispatch.  Requires a multi-device runtime; silently stays single-device
+# otherwise (degraded path).
+SHARDED_SERVING = os.environ.get("KOLIBRIE_SHARDED", "").strip().lower() not in (
+    "", "0", "off", "false",
+)
 
 # ------------------------------------------------------- serving metrics
 # (docs/OBSERVABILITY.md has the full catalog)
@@ -119,6 +126,11 @@ _BATCH_DISPATCH_LAT = obs_metrics.histogram(
     "batch dispatch wall time by template fingerprint",
     labels=("template",),
 )
+_SHARDED_ATTACH_ERRORS = obs_metrics.counter(
+    "kolibrie_shard_attach_errors_total",
+    "sharded-serving attach/refresh attempts that failed (store keeps "
+    "serving single-device — the degraded path)",
+)
 
 _PLAYGROUND_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -153,6 +165,23 @@ def _parsed_term_to_str(term) -> str:
             f"{_parsed_term_to_str(o)} >>"
         )
     return term
+
+
+def _maybe_attach_sharded(db) -> None:
+    """Attach (or refresh) the mesh serving layer for one store when
+    KOLIBRIE_SHARDED is on.  Never fails the surrounding request: a
+    single-device runtime, or an attach/refresh fault, leaves the store
+    serving on the single-device path (that IS the degraded mode)."""
+    if not SHARDED_SERVING:
+        return
+    try:
+        from kolibrie_tpu.parallel.sharded_serving import attach_sharded
+
+        sh = attach_sharded(db)
+        if sh is not None:
+            sh.refresh()
+    except Exception:
+        _SHARDED_ATTACH_ERRORS.inc()
 
 
 def _load_rdf_into(db, data: str, fmt: str) -> int:
@@ -478,6 +507,12 @@ def _recover_server_state_traced(state: _ServerState) -> None:
     failures: Dict[str, str] = {}
     max_id = 0
     try:
+        # recovered stores come back mesh-attached: snapshot restore + WAL
+        # replay rebuild the host store, then this hook rebuilds the
+        # device-resident sharded mirrors before the store starts serving
+        state.durability.on_store_recovered = (
+            lambda _sid, db: _maybe_attach_sharded(db)
+        )
         result = state.durability.recover()
         batchers: Dict[str, TemplateBatcher] = {}
         for sid, db in result.stores.items():
@@ -977,6 +1012,9 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 n = _load_rdf_into(
                     batcher.db, req.get("rdf") or "", req.get("format", "ntriples")
                 )
+                # eager mirror upload while we already hold the lock: the
+                # first query after a load pays dispatch, not partitioning
+                _maybe_attach_sharded(batcher.db)
         except Exception as e:
             raise BadRequest(f"RDF parse error: {e}") from e
         _maybe_snapshot(state)

@@ -123,6 +123,11 @@ def store_stats(batcher) -> dict:
         out["triples"] = len(batcher.db.store)
         out["plan_cache"] = plan_cache_info(batcher.db)
         out["breakers"] = breaker_board(batcher.db).snapshot()
+        sharded = batcher.db.__dict__.get("_sharded_serving")
+        if sharded is not None:
+            # shard count, per-shard occupancy, imbalance, last cap hit —
+            # the degraded-routing signals (docs/SHARDING.md)
+            out["sharding"] = sharded.stats()
     out["device_compiles"] = device_compile_stats()
     return out
 
@@ -188,6 +193,16 @@ _queue_depth_gauge = metrics.gauge(
 _rsp_sessions_gauge = metrics.gauge(
     "kolibrie_rsp_sessions", "live RSP sessions"
 )
+_store_shards_gauge = metrics.gauge(
+    "kolibrie_store_shards",
+    "mesh shard count serving a store (0 rows absent = single-device)",
+    labels=("store",),
+)
+_store_shard_imbalance_gauge = metrics.gauge(
+    "kolibrie_store_shard_imbalance",
+    "per-store max/mean shard row occupancy",
+    labels=("store",),
+)
 _plan_cache_gauges = {
     "parse_entries": metrics.gauge(
         "kolibrie_plan_cache_parse_entries",
@@ -214,3 +229,11 @@ def refresh_server_gauges(state) -> None:
         info = plan_cache_info(b.db)
         for key, g in _plan_cache_gauges.items():
             g.labels(sid).set(info[key])
+        sharded = b.db.__dict__.get("_sharded_serving")
+        if sharded is not None:
+            sh_stats = sharded.stats()
+            _store_shards_gauge.labels(sid).set(sh_stats["shards"])
+            if "imbalance" in sh_stats:
+                _store_shard_imbalance_gauge.labels(sid).set(
+                    sh_stats["imbalance"]
+                )

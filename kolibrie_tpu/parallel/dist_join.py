@@ -306,7 +306,7 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
             store.by_obj[1],
             store.by_obj[2],
             store.by_obj_valid,
-            store.subj_packed_sorted,
+            *store.subj_index_parts,
         )
 
 
@@ -314,23 +314,31 @@ def dist_bgp_join_count_device(store, p1: int, p2: int):
 def _bgp_count_fn(mesh):
     axis = mesh.axis_names[0]
 
-    def body(p1, p2, op, oo, ov, subj_packed):
+    def body(p1, p2, op, oo, ov, subj_base, subj_tombs, subj_delta):
         op, oo, ov = op[0], oo[0], ov[0]
-        packed = subj_packed[0]  # PRE-SORTED (pred<<32|subj) — no sort here
+        # PRE-SORTED (pred<<32|subj) packs — no sort here.  Two-tier probe
+        # (sharded_store.refresh_subj_index): a key's live multiplicity is
+        # count(base) - count(tombstones) + count(delta adds); monolithic
+        # indexes arrive with all-sentinel tomb/delta packs (counts 0).
+        parts = (subj_base[0], subj_tombs[0], subj_delta[0])
         lv = ov & (op == p1)
         p2_hi = p2.astype(jnp.uint64) << np.uint64(32)
         # Invalid left rows get a probe key beyond every real packed key.
         # This relies on dictionary IDs never reaching 0xFFFFFFFF (IDs use
         # bits 0..30 + quoted bit 31, asserted in core.dictionary): a real
         # (pred, subj) = (0xFFFFFFFF, 0xFFFFFFFF) row would be
-        # indistinguishable from the all-ones padding in subj_packed_sorted
+        # indistinguishable from the all-ones padding in the sorted packs
         # and a probe for it would overcount against padding entries.
         lkey = jnp.where(
             lv, p2_hi | oo.astype(jnp.uint64), np.uint64(0xFFFFFFFFFFFFFFFF)
         )
-        lo = jnp.searchsorted(packed, lkey, side="left")
-        hi = jnp.searchsorted(packed, lkey, side="right")
-        total = jnp.sum(jnp.where(lv, hi - lo, 0).astype(jnp.int32))
+
+        def count(packed):
+            lo = jnp.searchsorted(packed, lkey, side="left")
+            hi = jnp.searchsorted(packed, lkey, side="right")
+            return jnp.sum(jnp.where(lv, hi - lo, 0).astype(jnp.int32))
+
+        total = count(parts[0]) - count(parts[1]) + count(parts[2])
         return lax.psum(total, axis)[None]
 
     spec = P(axis, None)
@@ -338,7 +346,7 @@ def _bgp_count_fn(mesh):
         _shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P()) + (spec,) * 4,
+            in_specs=(P(), P()) + (spec,) * 6,
             out_specs=P(axis),
         )
     )

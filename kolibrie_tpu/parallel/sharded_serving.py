@@ -1,0 +1,902 @@
+"""Sharded serving: the HTTP front door's mesh execution layer.
+
+ROADMAP item 1 closes here: the serving path (http_server -> TemplateBatcher
+-> executor) gains a :class:`ShardedDatabase` that keeps the two-tier store
+(frozen base + delta segment + tombstones, ``core/store.py``) hash-partitioned
+across the device mesh and device-RESIDENT, so a batched same-template query
+group becomes ONE ``shard_map`` dispatch instead of B single-device programs.
+
+Three design rules, inherited from the systems this reproduces (MapSQ's
+partition-match-merge split, arXiv:1702.03484; GPU Datalog's resident
+relations + delta-only transfer, arXiv:2311.02206):
+
+1. **Partition once, mutate by delta.**  The frozen base partitions by
+   ``mix32(key) % n`` into per-shard ``[n, base_cap]`` blocks — uploaded once
+   per ``base_version``.  Mutation batches under ``delta_threshold`` re-upload
+   only the O(delta) add blocks and tombstone positions; the combined view is
+   reassembled on device (:func:`_assemble`), so shapes — and therefore every
+   compiled serving program — survive sustained insert/delete traffic with
+   ZERO recompiles.
+2. **One dispatch per template group.**  Same-template queries differ only in
+   constants (``query/template.py``); the batched program moves those
+   constants into a traced ``[B, n_slots]`` parameter matrix and evaluates the
+   whole group with ``lax.map`` INSIDE one ``shard_map`` body — per member:
+   shard-local seed scan, fixed-cap ``all_to_all`` binding-table exchange,
+   local joins, replicated filter masks.  The host merge
+   (``_finish_select_table``) is deterministic and identical to the solo path.
+3. **Cross-cutting layers ride the shard hop.**  Deadlines are checked before
+   dispatch (``shard.dispatch`` is also a fault-injection site), per-template
+   breakers gate the group in the executor, per-shard span children and
+   ``kolibrie_shard_*`` counters make imbalance and exchange pressure
+   observable, and recovery (WAL replay / snapshot restore) rebuilds the
+   mirrors through the same :meth:`ShardedDatabase.refresh` staleness check.
+
+Plan-cache interaction: the executor's per-template state key carries
+:attr:`ShardedDatabase.signature` (the mesh signature), so attaching or
+detaching the mesh can never replay a plan lowered for the other topology.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from functools import lru_cache, partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kolibrie_tpu.obs import metrics as _m
+from kolibrie_tpu.obs.spans import span
+from kolibrie_tpu.ops.jax_compat import (
+    enable_x64 as _enable_x64,
+    shard_map as _shard_map,
+)
+from kolibrie_tpu.parallel.dist_general import _exchange_table
+from kolibrie_tpu.parallel.dist_join import (
+    _LPAD32 as _JLPAD,
+    _RPAD32 as _JRPAD,
+    _dist_check_vma,
+)
+from kolibrie_tpu.parallel.mesh import make_mesh
+from kolibrie_tpu.parallel.sharded_store import ShardedTripleStore, shard_of
+from kolibrie_tpu.resilience.deadline import check_deadline
+from kolibrie_tpu.resilience.faultinject import fault_point
+from kolibrie_tpu.reasoner.device_fixpoint import Unsupported
+
+__all__ = [
+    "ShardedDatabase",
+    "attach_sharded",
+    "detach_sharded",
+    "active_sharded",
+    "sharded_compile_stats",
+    "Unsupported",
+]
+
+# ------------------------------------------------------------------ metrics
+_SHARD_DISPATCH = _m.counter(
+    "kolibrie_shard_dispatch_total",
+    "Mesh serving dispatches by path",
+    labels=("path",),
+)
+_SHARD_QUERIES = _m.counter(
+    "kolibrie_shard_queries_total", "Queries served through the mesh path"
+)
+_SHARD_ROWS = _m.counter(
+    "kolibrie_shard_rows_scanned_total",
+    "Resident rows visited by shard-local premise scans (static bound)",
+)
+_SHARD_XBYTES = _m.counter(
+    "kolibrie_shard_exchanged_bytes_total",
+    "Bytes moved by fixed-cap all-to-all binding-table exchanges "
+    "(static buffer size - what actually rides the interconnect)",
+)
+_SHARD_H2D = _m.counter(
+    "kolibrie_shard_h2d_bytes_total",
+    "Host->device mirror upload bytes by segment",
+    labels=("segment",),
+)
+_SHARD_IMBALANCE = _m.gauge(
+    "kolibrie_shard_imbalance",
+    "max/mean per-shard row occupancy (1.0 = perfectly balanced)",
+)
+_SHARD_OCCUPANCY = _m.gauge(
+    "kolibrie_shard_rows", "Live rows resident per shard", labels=("shard",)
+)
+_SHARD_CAP_HITS = _m.counter(
+    "kolibrie_shard_exchange_cap_hits_total",
+    "Dispatches that overflowed a join/exchange capacity and retried doubled",
+)
+_SHARD_FALLBACKS = _m.counter(
+    "kolibrie_shard_fallback_total",
+    "Template groups the mesh path declined",
+    labels=("reason",),
+)
+_SHARD_DISPATCH_LAT = _m.histogram(
+    "kolibrie_shard_dispatch_seconds", "Mesh dispatch latency (one group)"
+)
+
+# ------------------------------------------------- compile-surface tracking
+# One entry per distinct batched program / assemble shape ever built — the
+# no-recompile regression asserts these stay flat across mutation batches.
+_compile_stats = {"batched_programs": 0, "assemble_shapes": 0}
+_ASSEMBLE_SHAPES: set = set()
+
+
+def sharded_compile_stats() -> Dict[str, int]:
+    """Counters of distinct compiled surfaces on the sharded serving path
+    (monotonic; flat across mutation batches under ``delta_threshold``)."""
+    return dict(_compile_stats)
+
+
+# ------------------------------------------------------------ device pieces
+
+
+@jax.jit
+def _assemble(base_cols, base_valid, add_cols, add_valid, del_pos):
+    """Combine the resident base blocks with the O(delta) add blocks and
+    tombstones into the view the mesh programs scan: tombstoned base rows
+    flip invalid (scatter at intra-shard positions; the ``base_cap``
+    sentinel lands out of bounds and drops), then base and delta concat
+    along the row axis.  Shapes are a function of ``(n, base_cap,
+    delta_cap)`` only — mutation batches reuse the same executable."""
+    bv = jax.vmap(lambda v, p: v.at[p].set(False, mode="drop"))(
+        base_valid, del_pos
+    )
+    cols = tuple(
+        jnp.concatenate([b, a], axis=1) for b, a in zip(base_cols, add_cols)
+    )
+    return cols, jnp.concatenate([bv, add_valid], axis=1)
+
+
+def _strmask_verdict(col, masks, f):
+    from kolibrie_tpu.parallel.dist_query import _strmask_verdict as _sv
+
+    return _sv(col, masks, f)
+
+
+def _join_presorted(lkey, lvalid, rsorted, order, cap):
+    """:func:`dist_join.local_join_u32` against a PRE-sorted right side:
+    identical ``(li, ri, valid, total)`` contract, minus the per-call
+    ``argsort`` — the batched body joins every ``lax.map`` member against
+    the same resident mirror, so the sort is loop-invariant and hoisted
+    to once per dispatch.  ``total`` counts UNFILTERED key matches (the
+    side premise's constant filters apply post-join), so the overflow
+    retry doubles against that looser bound."""
+    ln, rn = lkey.shape[0], rsorted.shape[0]
+    lk = jnp.where(lvalid, lkey.astype(jnp.uint32), _JLPAD)
+    lo = jnp.searchsorted(rsorted, lk, side="left")
+    hi = jnp.searchsorted(rsorted, lk, side="right")
+    counts = (hi - lo).astype(jnp.int32)
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    row = jnp.searchsorted(cum, idx, side="right")
+    row_c = jnp.clip(row, 0, ln - 1)
+    start = cum[row_c] - counts[row_c]
+    pos = lo[row_c] + (idx - start)
+    valid = idx < total
+    li = jnp.where(valid, row_c, 0).astype(jnp.int32)
+    ri = jnp.where(
+        valid, order[jnp.clip(pos, 0, rn - 1)], 0
+    ).astype(jnp.int32)
+    return li, ri, valid, total
+
+
+def _batched_body(
+    state,
+    masks,
+    params,
+    *,
+    premises,
+    seed,
+    steps,
+    filters,
+    out_vars,
+    n,
+    axis,
+    join_cap,
+    bucket_cap,
+):
+    """One template group in one mesh program: ``lax.map`` over the
+    ``[B, n_slots]`` constant matrix, each member running the shard-local
+    scan -> routed-join -> filter pipeline of ``dist_query._query_body``.
+    Premise ``consts`` here hold SLOT INDICES into the parameter vector
+    (the template's constant-free twin), so every constant-variant of the
+    template shares this one executable."""
+    fs, fp, fo, fv, gs, gp, go, gv = (a[0] for a in state)
+    masks = tuple(masks)
+    fcols = (fs, fp, fo)
+
+    # Hoisted per-step side sorts: every lax.map member joins against the
+    # same resident mirror, so the right-side argsort is loop-invariant —
+    # sort once per dispatch, not once per member.  The side premise's
+    # constant filters (which DO vary per member) apply post-join at the
+    # matched rows instead of pre-masking the sort input.
+    sides = []
+    for (j, kv, kpos, extra) in steps:
+        if kpos == 0:
+            side_cols, side_valid, side_key = fcols, fv, fs
+        else:
+            side_cols, side_valid, side_key = (gs, gp, go), gv, go
+        rk = jnp.where(side_valid, side_key.astype(jnp.uint32), _JRPAD)
+        # lax.sort carries the values through the sort instead of
+        # argsort-then-gather: XLA:CPU fuses the ``rk[order]`` gather into
+        # the consuming searchsorted incorrectly under shard_map (observed
+        # as phantom join matches), and the fused form is also slower.
+        iota = jnp.arange(rk.shape[0], dtype=jnp.int32)
+        rsorted, order = lax.sort((rk, iota), num_keys=1)
+        sides.append((side_cols, order, rsorted))
+
+    def scan_param(prem, cols, valid, prm):
+        m = valid
+        for c, col in zip(prem.consts, cols):
+            if c is not None:
+                m = m & (col == prm[c])
+        for a, b in prem.eq_pairs:
+            m = m & (cols[a] == cols[b])
+        table = {v: cols[pos] for v, pos in prem.vars}
+        return table, m
+
+    def one(prm):
+        ov = jnp.int32(0)
+        table, valid = scan_param(premises[seed], fcols, fv, prm)
+        # Partition tracking for exchange elision: the seed scans the
+        # subject-partitioned mirror, so rows start partitioned by the
+        # seed's subject var; the side mirrors are partitioned by their
+        # probe key, so a step whose join key equals the current
+        # partition var is already co-located and the all-to-all is an
+        # identity permutation — skip it (trace-time decision; the
+        # program cache key covers seed/steps).  Subject-keyed star
+        # joins — the dominant serving templates — exchange nothing.
+        part = next((v for v, pos in premises[seed].vars if pos == 0), None)
+        for (j, kv, kpos, extra), (side_cols, order, rsorted) in zip(
+            steps, sides
+        ):
+            prem = premises[j]
+            if n > 1 and kv != part:
+                table, valid, dropped = _exchange_table(
+                    table, valid, kv, n, axis, bucket_cap
+                )
+                ov = ov + dropped.astype(jnp.int32)
+            part = kv
+            li, ri, jvalid, total = _join_presorted(
+                table[kv], valid, rsorted, order, join_cap
+            )
+            ov = ov + lax.psum(
+                jnp.maximum(total - join_cap, 0).astype(jnp.int32), axis
+            )
+            # side premise filters, post-join at the matched rows
+            for c, col in zip(prem.consts, side_cols):
+                if c is not None:
+                    jvalid = jvalid & (col[ri] == prm[c])
+            for a, b in prem.eq_pairs:
+                jvalid = jvalid & (side_cols[a][ri] == side_cols[b][ri])
+            ptable = {v: side_cols[pos] for v, pos in prem.vars}
+            new_table = {v: c[li] for v, c in table.items()}
+            for v, c in ptable.items():
+                if v not in new_table:
+                    new_table[v] = c[ri]
+                elif v in extra:
+                    jvalid = jvalid & (new_table[v] == c[ri])
+            table, valid = new_table, jvalid
+        for f in filters:
+            col = table[f.var]
+            if f.kind == "eq":
+                valid = valid & (col == jnp.uint32(f.const_id))
+            elif f.kind == "ne":
+                valid = valid & (col != jnp.uint32(f.const_id))
+            elif f.kind == "strmask":
+                valid = valid & _strmask_verdict(col, masks, f)
+            else:
+                m = masks[f.mask_idx]
+                valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+        outs = tuple(jnp.where(valid, table[v], 0) for v in out_vars)
+        return outs, valid, ov
+
+    outs, valid, ovs = lax.map(one, params)
+    overflow = jnp.sum(ovs)  # each member's ov is already a global psum
+    return (
+        tuple(o[:, None] for o in outs),
+        valid[:, None],
+        overflow[None],
+    )
+
+
+# Memoized program factory (the sanctioned jit-factory pattern) — the key
+# is the template's constant-free shape, so constant-variants and mutation
+# epochs share one executable.
+
+
+@lru_cache(maxsize=64)
+def _get_batched_fn(
+    mesh, premises, seed, steps, filters, out_vars, n_masks, join_cap,
+    bucket_cap, b_pad,
+):
+    _compile_stats["batched_programs"] += 1
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    body = partial(
+        _batched_body,
+        premises=premises,
+        seed=seed,
+        steps=steps,
+        filters=filters,
+        out_vars=out_vars,
+        n=n,
+        axis=axis,
+        join_cap=join_cap,
+        bucket_cap=bucket_cap,
+    )
+    spec = P(axis, None)
+    bspec = P(None, axis, None)
+    return jax.jit(
+        _shard_map(
+            lambda state, masks, params: body(state, masks, params),
+            mesh=mesh,
+            check_vma=_dist_check_vma(),
+            in_specs=((spec,) * 8, (P(),) * n_masks, P()),
+            out_specs=((bspec,) * len(out_vars), bspec, P(axis)),
+        )
+    )
+
+
+def _pad_pow2_mask(m: np.ndarray) -> np.ndarray:
+    """Pad a per-ID boolean mask to a power of two with False — mask SHAPES
+    then move only when the dictionary doubles, not on every intern, so
+    mutation batches keep the batched executable."""
+    n = len(m)
+    cap = max(8, 1 << max(n - 1, 1).bit_length())
+    if cap == n:
+        return m
+    out = np.zeros(cap, dtype=bool)
+    out[:n] = m
+    return out
+
+
+# --------------------------------------------------------------- partitioning
+
+
+class _HashMirror:
+    """One hash-partitioned two-tier mirror (key = subject or object column).
+
+    Holds the device-resident base blocks plus the host row->shard map
+    (``base_dest``/``base_intra``) that translates the store's global
+    tombstone positions into per-shard scatter positions in O(delta)."""
+
+    def __init__(self, key_pos: int):
+        self.key_pos = key_pos
+        self.base_cols = None  # device [n, base_cap] x3
+        self.base_valid = None  # device [n, base_cap], PRE-tombstone
+        self.base_dest = None  # host [N] shard of each base row
+        self.base_intra = None  # host [N] position within its shard block
+        self.base_counts = None  # host [n]
+        self.add_cols = None  # device [n, delta_cap] x3
+        self.add_valid = None
+        self.del_pos = None  # device [n, delta_cap] int32, sentinel=base_cap
+        self.add_counts = None  # host [n]
+        self.del_counts = None  # host [n]
+
+    def rebuild_base(self, cols, n: int, base_cap: int, sharding) -> None:
+        key = cols[self.key_pos]
+        dest = shard_of(key, n)
+        counts = np.bincount(dest, minlength=n)
+        order = np.argsort(dest, kind="stable")
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        intra = np.empty(len(key), dtype=np.int64)
+        blocks = [np.zeros((n, base_cap), dtype=np.uint32) for _ in range(3)]
+        valid = np.zeros((n, base_cap), dtype=bool)
+        for sh in range(n):
+            rows = order[offs[sh] : offs[sh + 1]]
+            intra[rows] = np.arange(len(rows))
+            for blk, col in zip(blocks, cols):
+                blk[sh, : len(rows)] = col[rows]
+            valid[sh, : len(rows)] = True
+        put = lambda a: jax.device_put(a, sharding)  # noqa: E731
+        self.base_cols = tuple(put(b) for b in blocks)
+        self.base_valid = put(valid)
+        self.base_dest = dest
+        self.base_intra = intra
+        self.base_counts = counts
+        _SHARD_H2D.labels("base").inc(n * base_cap * (3 * 4 + 1))
+
+    def refresh_delta(
+        self, add_cols, del_global_pos, n: int, base_cap: int,
+        delta_cap: int, sharding,
+    ) -> None:
+        key = add_cols[self.key_pos]
+        dest = shard_of(key, n)
+        counts = np.bincount(dest, minlength=n)
+        if counts.max(initial=0) > delta_cap:
+            raise OverflowError("delta shard load exceeds delta_device_cap")
+        order = np.argsort(dest, kind="stable")
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        blocks = [np.zeros((n, delta_cap), dtype=np.uint32) for _ in range(3)]
+        valid = np.zeros((n, delta_cap), dtype=bool)
+        for sh in range(n):
+            rows = order[offs[sh] : offs[sh + 1]]
+            for blk, col in zip(blocks, add_cols):
+                blk[sh, : len(rows)] = col[rows]
+            valid[sh, : len(rows)] = True
+        # tombstones: global base positions -> (shard, intra) via the maps
+        # recorded at base partition time; sentinel base_cap drops in the
+        # _assemble scatter
+        dpos = np.full((n, delta_cap), base_cap, dtype=np.int32)
+        dd = self.base_dest[del_global_pos]
+        di = self.base_intra[del_global_pos]
+        dcounts = np.bincount(dd, minlength=n)
+        if dcounts.max(initial=0) > delta_cap:
+            raise OverflowError("tombstone shard load exceeds delta_device_cap")
+        dorder = np.argsort(dd, kind="stable")
+        doffs = np.concatenate([[0], np.cumsum(dcounts)])
+        for sh in range(n):
+            rows = dorder[doffs[sh] : doffs[sh + 1]]
+            dpos[sh, : len(rows)] = di[rows]
+        put = lambda a: jax.device_put(a, sharding)  # noqa: E731
+        self.add_cols = tuple(put(b) for b in blocks)
+        self.add_valid = put(valid)
+        self.del_pos = put(dpos)
+        self.add_counts = counts
+        self.del_counts = dcounts
+        _SHARD_H2D.labels("delta").inc(n * delta_cap * (3 * 4 + 1 + 4))
+
+    def assemble(self):
+        shape = (
+            self.base_valid.shape[0],
+            self.base_valid.shape[1],
+            self.add_valid.shape[1],
+        )
+        if shape not in _ASSEMBLE_SHAPES:
+            _ASSEMBLE_SHAPES.add(shape)
+            _compile_stats["assemble_shapes"] += 1
+        return _assemble(
+            self.base_cols,
+            self.base_valid,
+            self.add_cols,
+            self.add_valid,
+            self.del_pos,
+        )
+
+    def occupancy(self) -> np.ndarray:
+        return self.base_counts + self.add_counts - self.del_counts
+
+
+# -------------------------------------------------------------- the database
+
+
+class ShardedDatabase:
+    """Mesh-resident serving twin of one :class:`SparqlDatabase`.
+
+    Owns the two hash mirrors (subject- and object-partitioned), the
+    combined :class:`ShardedTripleStore` view the distributed executors
+    scan, per-template pinned capacities, and the batched dispatch path.
+    All mutating entry points hold :attr:`lock`; the executor calls them
+    under the HTTP batcher's ``dispatch_lock`` as well."""
+
+    def __init__(self, db, mesh=None):
+        if mesh is None:
+            mesh = make_mesh()
+        self.db = db
+        self.mesh = mesh
+        self.n = mesh.devices.size
+        self.axis = mesh.axis_names[0]
+        self.lock = threading.RLock()
+        self._subj = _HashMirror(0)
+        self._obj = _HashMirror(2)
+        self.view: Optional[ShardedTripleStore] = None  # guarded by: lock
+        self._sig = None  # guarded by: lock
+        self._base_ref = None  # guarded by: lock
+        self._base_cap_s = 0
+        self._base_cap_o = 0
+        self._delta_cap = 0
+        self._caps: Dict[tuple, Tuple[int, int]] = {}  # guarded by: lock
+        self.stats_counters = {
+            "base_rebuilds": 0,
+            "delta_refreshes": 0,
+            "dispatches": 0,
+            "batched_queries": 0,
+            "fallbacks": 0,
+            "cap_hits": 0,
+            "last_cap_hit": None,
+        }  # guarded by: lock
+
+    @property
+    def signature(self) -> tuple:
+        """Hashable mesh identity for plan-cache state keys: attaching,
+        detaching, or resizing the mesh must never replay a plan lowered
+        for another topology."""
+        return ("shards", self.n, self.axis)
+
+    # ------------------------------------------------------------- mirrors
+
+    def refresh(self, force: bool = False) -> bool:
+        """Sync the device mirrors to the store's live two-tier state.
+        Base blocks re-partition only when ``base_version`` moved (or the
+        base arrays were swapped by ``restore()``); otherwise only the
+        O(delta) add/tombstone blocks re-upload.  Returns True when any
+        device state moved."""
+        with self.lock:
+            st = self.db.store
+            sig = st.segment_signature()
+            anchor = st.base_rows("spo")[0]
+            base_same = (
+                self._base_ref is not None and self._base_ref() is anchor
+            )
+            if not force and sig == self._sig and base_same:
+                return False
+            base_changed = force or not base_same
+            sharding = NamedSharding(self.mesh, P(self.axis, None))
+            if base_changed:
+                bs, bp, bo = st.base_rows("spo")
+                # independent caps per mirror: the object partitioning is
+                # skew-prone (rdf:type objects pile onto one shard) and
+                # must not inflate the subject mirror's scan range — every
+                # serving program scans the subject mirror at least twice
+                def _cap_for(col):
+                    need = (
+                        np.bincount(
+                            shard_of(col, self.n), minlength=self.n
+                        ).max()
+                        if len(col)
+                        else 0
+                    )
+                    return max(8, 1 << max(int(need) - 1, 1).bit_length())
+
+                self._base_cap_s = _cap_for(bs)
+                self._base_cap_o = _cap_for(bo)
+                self._delta_cap = int(st.delta_device_cap)
+                self._subj.rebuild_base(
+                    (bs, bp, bo), self.n, self._base_cap_s, sharding
+                )
+                self._obj.rebuild_base(
+                    (bs, bp, bo), self.n, self._base_cap_o, sharding
+                )
+                self.stats_counters["base_rebuilds"] += 1
+            adds = st.delta_rows("spo")
+            dels = st.delta_del_positions("spo")
+            for mirror, bcap in (
+                (self._subj, self._base_cap_s),
+                (self._obj, self._base_cap_o),
+            ):
+                mirror.refresh_delta(
+                    adds, dels, self.n, bcap, self._delta_cap, sharding
+                )
+            if self.view is None or base_changed:
+                cap = self._base_cap_s + self._delta_cap
+                view = ShardedTripleStore.__new__(ShardedTripleStore)
+                view.mesh = self.mesh
+                view.axis = self.axis
+                view.n_shards = self.n
+                view.cap = cap
+                view.sharding = sharding
+                view.subj_packed_sorted = None
+                view._subj_index_src = None
+                view.subj_index_parts = None
+                view._subj_base_packed = None
+                view._subj_base_end = None
+                view.subj_index_base_builds = 0
+                view.subj_index_delta_builds = 0
+                self.view = view
+            self.view.by_subj, self.view.by_subj_valid = self._subj.assemble()
+            self.view.by_obj, self.view.by_obj_valid = self._obj.assemble()
+            # two-tier probe index: base pack survives delta refreshes
+            self.view.refresh_subj_index(
+                base_end=self._base_cap_s,
+                base_valid=self._subj.base_valid,
+                del_pos=self._subj.del_pos,
+                base_unchanged=not base_changed,
+            )
+            self._sig = sig
+            self._base_ref = weakref.ref(anchor)
+            self.stats_counters["delta_refreshes"] += 1
+            occ = self._subj.occupancy()
+            mean = float(occ.mean()) if len(occ) else 0.0
+            imb = float(occ.max()) / mean if mean > 0 else 1.0
+            _SHARD_IMBALANCE.set(imb)
+            for sh in range(self.n):
+                _SHARD_OCCUPANCY.labels(str(sh)).set(int(occ[sh]))
+            return True
+
+    # ------------------------------------------------------------ execution
+
+    def _pinned_caps(self, fp: str) -> Optional[Tuple[int, int]]:  # kolint: holds[lock]
+        bv = self._sig[0] if self._sig else None
+        for k in [k for k in self._caps if k[1] != bv]:
+            self._caps.pop(k)
+        return self._caps.get((fp, bv))
+
+    def execute(self, sparql: str) -> List[List[str]]:
+        """Solo mesh execution of one SELECT (bench/diagnostic path; the
+        serving integration dispatches template GROUPS via
+        :meth:`execute_batch`).  Raises :class:`Unsupported` for queries
+        the distributed lowering declines."""
+        from kolibrie_tpu.parallel.dist_query import DistQueryExecutor
+
+        with self.lock:
+            self.refresh()
+            check_deadline("shard.dispatch")
+            fault_point("shard.dispatch")
+            ex = DistQueryExecutor(
+                self.mesh, self.db, sparql, store=self.view
+            )
+            t0 = time.perf_counter()
+            with span("shard.dispatch", shards=self.n, batch=1):
+                rows = ex.run()
+            _SHARD_DISPATCH_LAT.observe(time.perf_counter() - t0)
+            _SHARD_DISPATCH.labels("solo").inc()
+            _SHARD_QUERIES.inc()
+            self.stats_counters["dispatches"] += 1
+            return rows
+
+    def execute_batch(
+        self, fp: str, items: List[Tuple[int, str]]
+    ) -> Dict[int, List[List[str]]]:
+        """One template group -> one mesh dispatch.  ``items`` is
+        ``[(caller_index, sparql), ...]`` of same-fingerprint plain
+        SELECTs; returns ``{caller_index: rows}`` with rows identical to
+        the solo host path.  Raises :class:`Unsupported` when the group
+        cannot ride the parameterized program (the caller falls through
+        to the single-device vmap path), and lets device faults /
+        deadline misses propagate for the breaker protocol."""
+        from kolibrie_tpu.parallel.dist_query import (
+            DistQueryExecutor,
+            _materialize_masks,
+        )
+        from kolibrie_tpu.reasoner.device_fixpoint import LoweredPremise
+
+        with self.lock:
+            self.refresh()
+            check_deadline("shard.dispatch")
+            caps = self._pinned_caps(fp)
+            kw = (
+                {"join_cap": caps[0], "bucket_cap": caps[1]}
+                if caps
+                else {}
+            )
+            try:
+                exemplar = DistQueryExecutor(
+                    self.mesh, self.db, items[0][1], store=self.view, **kw
+                )
+            except Unsupported:
+                self.stats_counters["fallbacks"] += 1
+                _SHARD_FALLBACKS.labels("unsupported").inc()
+                raise
+            if (
+                exemplar.agg_items
+                or exemplar.query.group_by
+                or exemplar.binds
+                or exemplar.union_specs
+                or exemplar.optional_specs
+                or exemplar.anti
+                or exemplar.values_var is not None
+                or exemplar.query.order_by
+            ):
+                # _batchable_select should have filtered these; belt and
+                # braces for direct callers
+                self.stats_counters["fallbacks"] += 1
+                _SHARD_FALLBACKS.labels("shape").inc()
+                raise Unsupported("clause shape stays on the vmap path")
+            execs = [exemplar]
+            for _idx, text in items[1:]:
+                execs.append(
+                    DistQueryExecutor(
+                        self.mesh,
+                        self.db,
+                        text,
+                        store=self.view,
+                        join_cap=exemplar.join_cap,
+                        bucket_cap=exemplar.bucket_cap,
+                    )
+                )
+            # structural agreement: the group shares one constant-free
+            # shape; filter constants must MATCH (the single-device vmap
+            # path parameterizes those — this path parameterizes pattern
+            # constants, by far the common serving variation)
+            def shape_of(ex):
+                return (
+                    tuple(
+                        (
+                            tuple(c is not None for c in pr.consts),
+                            pr.vars,
+                            pr.eq_pairs,
+                        )
+                        for pr in ex.premises
+                    ),
+                    ex.seed,
+                    ex.steps,
+                    ex.filters,
+                    ex.mask_exprs,
+                    ex.out_vars,
+                )
+
+            shape0 = shape_of(exemplar)
+            if any(shape_of(ex) != shape0 for ex in execs[1:]):
+                self.stats_counters["fallbacks"] += 1
+                _SHARD_FALLBACKS.labels("divergent").inc()
+                raise Unsupported(
+                    "group members diverge beyond pattern constants"
+                )
+            # constant slots -> parameter matrix [B, n_slots]
+            slots = [
+                (i, pos)
+                for i, pr in enumerate(exemplar.premises)
+                for pos in range(3)
+                if pr.consts[pos] is not None
+            ]
+            slot_idx = {sp: k for k, sp in enumerate(slots)}
+            param_premises = tuple(
+                LoweredPremise(
+                    tuple(
+                        slot_idx[(i, pos)] if c is not None else None
+                        for pos, c in enumerate(pr.consts)
+                    ),
+                    pr.vars,
+                    pr.eq_pairs,
+                )
+                for i, pr in enumerate(exemplar.premises)
+            )
+            b = len(execs)
+            b_pad = max(2, 1 << max(b - 1, 1).bit_length())
+            params = np.zeros((b_pad, max(len(slots), 1)), dtype=np.uint32)
+            for r, ex in enumerate(execs):
+                for k, (i, pos) in enumerate(slots):
+                    params[r, k] = np.uint32(ex.premises[i].consts[pos])
+            params[b:] = params[0]  # pad rows re-run member 0, discarded
+            masks = tuple(
+                jnp.asarray(_pad_pow2_mask(np.asarray(m)))
+                for m in _materialize_masks(self.db, exemplar.mask_exprs)
+            )
+            state = (
+                *self.view.by_subj,
+                self.view.by_subj_valid,
+                *self.view.by_obj,
+                self.view.by_obj_valid,
+            )
+            fault_point("shard.dispatch")
+            join_cap, bucket_cap = exemplar.join_cap, exemplar.bucket_cap
+            t0 = time.perf_counter()
+            with span(
+                "shard.dispatch",
+                shards=self.n,
+                batch=b,
+                template=fp,
+            ):
+                for _attempt in range(8):
+                    fn = _get_batched_fn(
+                        self.mesh,
+                        param_premises,
+                        exemplar.seed,
+                        exemplar.steps,
+                        exemplar.filters,
+                        exemplar.out_vars,
+                        len(masks),
+                        join_cap,
+                        bucket_cap,
+                        b_pad,
+                    )
+                    with _enable_x64(True):
+                        outs, valid, overflow = fn(state, masks, params)
+                    if int(np.asarray(overflow)[0]) == 0:
+                        break
+                    join_cap *= 2
+                    bucket_cap *= 2
+                    self.stats_counters["cap_hits"] += 1
+                    self.stats_counters["last_cap_hit"] = time.time()
+                    _SHARD_CAP_HITS.inc()
+                else:
+                    raise RuntimeError(
+                        "sharded batch capacities failed to converge"
+                    )
+                valid_np = np.asarray(valid)
+                out_np = [np.asarray(o) for o in outs]
+                # per-shard span children: surviving rows per shard across
+                # the group (observable imbalance of THIS dispatch)
+                per_shard = valid_np[:b].sum(axis=(0, 2))
+                for sh in range(self.n):
+                    with span(
+                        "shard.partition", shard=sh, rows=int(per_shard[sh])
+                    ):
+                        pass
+            _SHARD_DISPATCH_LAT.observe(time.perf_counter() - t0)
+            bv = self._sig[0]
+            self._caps[(fp, bv)] = (join_cap, bucket_cap)
+            occ_total = int(self._subj.occupancy().sum())
+            n_scans = 1 + len(exemplar.steps)
+            _SHARD_ROWS.inc(occ_total * n_scans * b)
+            width = len(
+                {v for v, _ in exemplar.premises[exemplar.seed].vars}
+            )
+            xbytes = 0
+            # mirror _batched_body's elision: co-partitioned steps move
+            # no bytes
+            part = next(
+                (
+                    v
+                    for v, pos in exemplar.premises[exemplar.seed].vars
+                    if pos == 0
+                ),
+                None,
+            )
+            for (j, kv, _kpos, _extra) in exemplar.steps:
+                if self.n > 1 and kv != part:
+                    xbytes += width * self.n * self.n * bucket_cap * 4
+                part = kv
+                width += len(
+                    {v for v, _ in exemplar.premises[j].vars}
+                )
+            _SHARD_XBYTES.inc(xbytes * b)
+            _SHARD_DISPATCH.labels("batched").inc()
+            _SHARD_QUERIES.inc(b)
+            self.stats_counters["dispatches"] += 1
+            self.stats_counters["batched_queries"] += b
+            # host merge: per member, identical post-pass to the solo path
+            from kolibrie_tpu.query.executor import _finish_select_table
+
+            results: Dict[int, List[List[str]]] = {}
+            for r, ((idx, _text), ex) in enumerate(zip(items, execs)):
+                v = valid_np[r].ravel()
+                table = {
+                    var: out_np[k][r].ravel()[v].astype(np.uint32)
+                    for k, var in enumerate(exemplar.out_vars)
+                }
+                results[idx] = _finish_select_table(self.db, ex.query, table)
+            return results
+
+    # -------------------------------------------------------------- health
+
+    def stats(self) -> dict:
+        """Shard-level health for ``/stats`` (and the ``/metrics`` gauges):
+        shard count, per-shard row occupancy, imbalance, last exchange cap
+        hit, rebuild/dispatch counters."""
+        with self.lock:
+            out = {
+                "shards": self.n,
+                "signature": list(self.signature),
+                "base_cap": {
+                    "subj": self._base_cap_s,
+                    "obj": self._base_cap_o,
+                },
+                "delta_cap": self._delta_cap,
+            }
+            out.update(self.stats_counters)
+            if self._subj.base_counts is not None:
+                occ = self._subj.occupancy()
+                mean = float(occ.mean()) if len(occ) else 0.0
+                out["occupancy"] = [int(x) for x in occ]
+                out["imbalance"] = (
+                    float(occ.max()) / mean if mean > 0 else 1.0
+                )
+            out["compile_surfaces"] = sharded_compile_stats()
+            return out
+
+
+# ----------------------------------------------------------------- attaching
+
+
+def attach_sharded(db, mesh=None) -> Optional[ShardedDatabase]:
+    """Create (or return) the :class:`ShardedDatabase` riding ``db``.
+    Requires a multi-device runtime; returns None on a single device so
+    callers can attach unconditionally.  The executor and the obs/stats
+    exporters discover it via ``db.__dict__['_sharded_serving']``."""
+    existing = db.__dict__.get("_sharded_serving")
+    if existing is not None:
+        return existing
+    if mesh is None:
+        if len(jax.devices()) < 2:
+            return None
+        mesh = make_mesh()
+    sh = ShardedDatabase(db, mesh)
+    db.__dict__["_sharded_serving"] = sh
+    return sh
+
+
+def detach_sharded(db) -> None:
+    db.__dict__.pop("_sharded_serving", None)
+
+
+def active_sharded(db) -> Optional[ShardedDatabase]:
+    return db.__dict__.get("_sharded_serving")

@@ -85,6 +85,14 @@ class ShardedTripleStore:
         # probe (and eagerly by from_columns).
         self.subj_packed_sorted = None
         self._subj_index_src = None
+        # two-tier probe index (see refresh_subj_index): (base, tombs, delta)
+        # sorted u64 packs; the full-rebuild path fills tombs/delta with
+        # tiny all-sentinel arrays so consumers probe uniformly
+        self.subj_index_parts = None
+        self._subj_base_packed = None
+        self._subj_base_end = None
+        self.subj_index_base_builds = 0
+        self.subj_index_delta_builds = 0
 
     @classmethod
     def from_columns(
@@ -114,7 +122,14 @@ class ShardedTripleStore:
         st.refresh_subj_index()
         return st
 
-    def refresh_subj_index(self) -> None:
+    def refresh_subj_index(
+        self,
+        *,
+        base_end: Optional[int] = None,
+        base_valid=None,
+        del_pos=None,
+        base_unchanged: bool = False,
+    ) -> None:
         """(Re)build the pre-sorted (predicate<<32 | subject) probe index
         from the CURRENT subject-hashed shards, fully ON DEVICE — a host
         round-trip here would both cost a transfer and poison all later
@@ -122,15 +137,79 @@ class ShardedTripleStore:
         subsequent dispatches ~3000x).  u64 arrays require the x64 scope;
         consumers (dist_join) run their jitted bodies under it too.
 
+        With no arguments this is the monolithic full repack (every row
+        packed and re-sorted).  Two-tier callers — the serving layer's
+        delta-segment mirrors, whose ``by_subj`` is ``concat(base, delta)``
+        along the row axis — pass the segment geometry instead, and the
+        expensive base sort runs only when the base actually changed:
+
+        - ``base_end``: column index splitting the frozen base region
+          ``[:, :base_end]`` from the delta region ``[:, base_end:]``.
+        - ``base_valid``: validity of the base region BEFORE tombstones
+          (padding only) — the cached base pack must keep tombstoned rows
+          so it survives delete batches; deletions are carried by the
+          tombstone pack and SUBTRACTED at probe time.
+        - ``del_pos``: ``[n, dcap]`` int32 intra-base tombstone positions
+          (sentinel >= base_end for padding).
+        - ``base_unchanged``: the caller vouches the base region is
+          byte-identical to the previous refresh — the cached base pack is
+          reused and only the O(delta) packs rebuild.
+
+        Consumers probe :attr:`subj_index_parts` ``(base, tombs, delta)``
+        — three sorted packs; a key's multiplicity is
+        ``count(base) - count(tombs) + count(delta)``.  The monolithic
+        path presents the same shape with empty tomb/delta packs.
+
         Consumers call :meth:`ensure_subj_index`, which detects stale
         derived state structurally (array identity), so forgetting an
         explicit refresh after a ``by_subj`` write-back cannot produce
-        wrong results — only a lazy rebuild.
+        wrong results — only a lazy (full) rebuild.
         """
         with _enable_x64(True):
-            self.subj_packed_sorted = _pack_sort_device(
-                self.by_subj[0], self.by_subj[1], self.by_subj_valid
-            )
+            if base_end is None:
+                self.subj_packed_sorted = _pack_sort_device(
+                    self.by_subj[0], self.by_subj[1], self.by_subj_valid
+                )
+                empty = _empty_packs(self.n_shards, self.sharding)
+                self.subj_index_parts = (self.subj_packed_sorted,) + empty
+                self._subj_base_packed = None
+                self._subj_base_end = None
+                self.subj_index_base_builds += 1
+            else:
+                reuse = (
+                    base_unchanged
+                    and self._subj_base_packed is not None
+                    and self._subj_base_end == base_end
+                )
+                if not reuse:
+                    bv = (
+                        base_valid
+                        if base_valid is not None
+                        else self.by_subj_valid[:, :base_end]
+                    )
+                    self._subj_base_packed = _pack_sort_device(
+                        self.by_subj[0][:, :base_end],
+                        self.by_subj[1][:, :base_end],
+                        bv,
+                    )
+                    self._subj_base_end = base_end
+                    self.subj_index_base_builds += 1
+                if del_pos is not None:
+                    tombs = _tomb_pack_device(
+                        self.by_subj[0][:, :base_end],
+                        self.by_subj[1][:, :base_end],
+                        del_pos,
+                    )
+                else:
+                    tombs = _empty_packs(self.n_shards, self.sharding)[0]
+                delta = _pack_sort_device(
+                    self.by_subj[0][:, base_end:],
+                    self.by_subj[1][:, base_end:],
+                    self.by_subj_valid[:, base_end:],
+                )
+                self.subj_index_parts = (self._subj_base_packed, tombs, delta)
+                self.subj_packed_sorted = self._subj_base_packed
+                self.subj_index_delta_builds += 1
         # weakrefs keep the identity check sound: if a source array was
         # collected and its address reused, the dead ref can never compare
         # identical to the new object (a bare id() tuple could).
@@ -143,11 +222,13 @@ class ShardedTripleStore:
     def ensure_subj_index(self) -> None:
         """Rebuild the probe index iff ``by_subj`` was reassigned since the
         last build (structural staleness detection — callers need not
-        remember to refresh after a write-back)."""
+        remember to refresh after a write-back).  The lazy rebuild is the
+        monolithic one; two-tier owners refresh explicitly at write-back
+        time, so a current index is never downgraded here."""
         src = self._subj_index_src
         current = (self.by_subj[0], self.by_subj[1], self.by_subj_valid)
         if (
-            self.subj_packed_sorted is None
+            self.subj_index_parts is None
             or src is None
             or any(r() is not a for r, a in zip(src, current))
         ):
@@ -174,3 +255,29 @@ def _pack_sort_device(ss, sp, sv):
         jnp.uint64(0xFFFFFFFFFFFFFFFF),
     )
     return jnp.sort(packed, axis=1)
+
+
+@jax.jit
+def _tomb_pack_device(ss, sp, del_pos):
+    """Sorted (pred<<32|subj) keys of the tombstoned base rows: gather the
+    base columns at the per-shard intra positions (sentinel positions out
+    of range -> all-ones fill) and sort — O(delta) work against the O(base)
+    repack it replaces."""
+    sent = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    inb = del_pos < ss.shape[1]
+    pos = jnp.minimum(del_pos, ss.shape[1] - 1)
+    s = jnp.take_along_axis(ss, pos, axis=1)
+    p = jnp.take_along_axis(sp, pos, axis=1)
+    packed = jnp.where(
+        inb, (p.astype(jnp.uint64) << jnp.uint64(32)) | s.astype(jnp.uint64), sent
+    )
+    return jnp.sort(packed, axis=1)
+
+
+def _empty_packs(n_shards: int, sharding):
+    """A pair of tiny all-sentinel sorted packs (tombs, delta) so monolithic
+    indexes present the same three-part probe surface as two-tier ones."""
+    e = np.full((n_shards, 8), 0xFFFFFFFFFFFFFFFF, dtype=np.uint64)
+    with _enable_x64(True):
+        arr = jax.device_put(e, sharding)
+    return arr, arr

@@ -1026,10 +1026,15 @@ def _plan_cache_entry(db, sparql: str):
         stats["evictions"] += 1
         _PLAN_CACHE_EVICTION.inc()
     version = db.store.base_version
+    # the mesh signature joins the state key: attaching/detaching the
+    # sharded serving layer (or resizing its mesh) must never replay a
+    # plan lowered for the other topology (docs/SHARDING.md)
+    _sh = db.__dict__.get("_sharded_serving")
     state = (
         version,
         db.__dict__.get("_udf_version", 0),
         db.execution_mode,
+        None if _sh is None else _sh.signature,
     )
     slot = tent["by_state"].get(state)
     if slot is None:
@@ -1293,7 +1298,8 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
     groups: Dict[str, List[int]] = {}
     members: List[Optional[tuple]] = [None] * len(queries)
     board = breaker_board(db)
-    if _device_routed(db):
+    sharded = db.__dict__.get("_sharded_serving")
+    if _device_routed(db) or sharded is not None:
         for i, text in enumerate(queries):
             ent, slot = _plan_cache_entry(db, text)
             if slot["lowered"] is False:
@@ -1311,6 +1317,39 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
         if not board.allow(fp):
             continue  # breaker open: members fall to the solo degraded path
         set_baggage("template", fp)
+        if sharded is not None:
+            # mesh-first: the whole template group rides one shard_map
+            # dispatch (parallel/sharded_serving.py); on Unsupported or a
+            # device fault the group degrades to the single-device paths
+            # below, with the breaker counting mesh trips
+            from kolibrie_tpu.parallel.sharded_serving import (
+                Unsupported as _MeshUnsupported,
+            )
+
+            try:
+                with span("executor.sharded", template=fp, batch=len(idxs)):
+                    got = sharded.execute_batch(
+                        fp, [(i, queries[i]) for i in idxs]
+                    )
+            except _MeshUnsupported:
+                pass  # group shape stays single-device: fall through
+            except DeadlineExceeded:
+                board.record_failure(fp)
+                raise
+            except Exception as e:
+                if not is_device_fault(e):
+                    raise
+                board.record_failure(fp)
+            else:
+                board.record_success(fp)
+                stats["batched"] += len(idxs)
+                stats["batch_groups"] += 1
+                _BATCHED_QUERIES.inc(len(idxs))
+                for i in idxs:
+                    results[i] = got[i]
+                continue
+        if not _device_routed(db):
+            continue  # mesh declined and no single-device jit routing
         lowereds, ok = [], True
         for i in idxs:
             ent, slot, q, w = members[i]

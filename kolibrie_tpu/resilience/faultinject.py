@@ -3,10 +3,14 @@
 The serving/query/streaming layers call :func:`fault_point(site)` at the
 places where real hardware and real streams fail: device compile
 (``device.lower``), device dispatch (``device.execute``,
-``device.batch``), window processing (``rsp.window``), and the WAL's
-disk path (``wal.append`` for torn writes and bit flips, ``wal.fsync``
-for partial fsyncs — see :mod:`kolibrie_tpu.durability.wal`).  With no
-plan installed a fault point is a single dict lookup — effectively free.
+``device.batch``), mesh serving dispatch (``shard.dispatch`` — fires
+before the sharded ``shard_map`` call so a tripped mesh degrades the
+group to the single-device path, see
+:mod:`kolibrie_tpu.parallel.sharded_serving`), window processing
+(``rsp.window``), and the WAL's disk path (``wal.append`` for torn
+writes and bit flips, ``wal.fsync`` for partial fsyncs — see
+:mod:`kolibrie_tpu.durability.wal`).  With no plan installed a fault
+point is a single dict lookup — effectively free.
 
 A :class:`FaultPlan` arms sites with rules.  Every rule is
 DETERMINISTIC: rate-based rules draw from a per-site ``random.Random``

@@ -516,14 +516,14 @@ class TestPlanCache:
         states = self._slots(db, self.Q)
         assert len(states) == 2  # device + host slots coexist
         dev_slot = next(
-            s for (v, u, m), s in states.items() if m == "device"
+            s for (v, u, m, _sh), s in states.items() if m == "device"
         )
         lowered_obj = dev_slot["lowered"]
         assert lowered_obj not in (None, False)
         assert execute_query_volcano(self.Q, db) == dev1
         dev_slot2 = next(
             s
-            for (v, u, m), s in self._slots(db, self.Q).items()
+            for (v, u, m, _sh), s in self._slots(db, self.Q).items()
             if m == "device"
         )
         assert dev_slot2["lowered"] is lowered_obj  # flip did not evict

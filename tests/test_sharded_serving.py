@@ -1,0 +1,409 @@
+"""Sharded serving (parallel/sharded_serving.py) on the virtual 8-device
+CPU mesh: mirror/store agreement, batched template groups vs the
+single-device oracle, zero-recompile mutation batches, recovery rebuilds,
+resilience degradation, and the HTTP front door end to end.
+
+Every result-bearing test uses the host volcano executor as the oracle —
+the mesh path must return identical rows (ISSUE 8 acceptance).
+"""
+
+import json
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kolibrie_tpu.parallel.sharded_serving import (
+    attach_sharded,
+    detach_sharded,
+    sharded_compile_stats,
+)
+from kolibrie_tpu.query.executor import (
+    _plan_caches,
+    execute_queries_batched,
+    execute_query_volcano,
+)
+from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benches"))
+import lubm  # noqa: E402
+
+PREFIX = "PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>\n"
+# one template, varied only by the department constant — the serving
+# pattern the parameterized mesh program targets
+TEMPLATE = (
+    PREFIX
+    + "SELECT ?x ?c WHERE {{ ?x ub:worksFor <{dept}> . ?x ub:teacherOf ?c . }}"
+)
+DEPTS_Q = PREFIX + "SELECT DISTINCT ?d WHERE { ?x ub:worksFor ?d . }"
+WORKS_Q = PREFIX + "SELECT ?x ?d WHERE { ?x ub:worksFor ?d . }"
+
+
+def _lubm_db(n_univ=2):
+    db = SparqlDatabase()
+    s, p, o = lubm.generate_fast(n_univ, db.dictionary)
+    db.store.add_batch(s, p, o)
+    db.execution_mode = "host"
+    return db
+
+
+def _template_group(db, k=4):
+    deps = execute_query_volcano(DEPTS_Q, db)
+    assert len(deps) >= k
+    return [TEMPLATE.format(dept=d[0]) for d in deps[:k]]
+
+
+@pytest.fixture(scope="module")
+def sharded_db(mesh8):
+    db = _lubm_db()
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    return db, sh
+
+
+# ------------------------------------------------------------------ mirrors
+
+
+def test_mirror_matches_store(sharded_db):
+    db, sh = sharded_db
+    st = db.store
+    bs, bp, bo = st.base_rows("spo")
+    keep = np.ones(len(bs), dtype=bool)
+    keep[st.delta_del_positions("spo")] = False
+    ds, dp, do = st.delta_rows("spo")
+    expect = set(zip(bs[keep].tolist(), bp[keep].tolist(), bo[keep].tolist()))
+    expect |= set(zip(ds.tolist(), dp.tolist(), do.tolist()))
+    s, p, o = sh.view.gather_host()
+    assert set(zip(s.tolist(), p.tolist(), o.tolist())) == expect
+
+
+def test_refresh_is_idempotent(sharded_db):
+    db, sh = sharded_db
+    rebuilds = sh.stats_counters["base_rebuilds"]
+    assert sh.refresh() is False  # nothing moved: no device traffic
+    assert sh.stats_counters["base_rebuilds"] == rebuilds
+
+
+def test_occupancy_and_signature(sharded_db):
+    db, sh = sharded_db
+    stats = sh.stats()
+    assert stats["shards"] == 8
+    assert len(stats["occupancy"]) == 8
+    assert sum(stats["occupancy"]) == len(db.store)
+    assert stats["imbalance"] >= 1.0
+    assert sh.signature == ("shards", 8, sh.axis)
+
+
+# ------------------------------------------------------- batched execution
+
+
+def test_batched_group_matches_oracle(sharded_db):
+    db, sh = sharded_db
+    texts = _template_group(db, 4)
+    oracle = [execute_query_volcano(t, db) for t in texts]
+    assert all(len(r) > 0 for r in oracle)
+    got = execute_queries_batched(db, texts)
+    assert got == oracle
+    assert sh.stats_counters["batched_queries"] >= 4
+
+
+def test_solo_mesh_execute_matches_oracle(sharded_db):
+    db, sh = sharded_db
+    assert sh.execute(lubm.LUBM_Q2) == execute_query_volcano(lubm.LUBM_Q2, db)
+
+
+def test_plan_cache_state_key_carries_mesh_signature(sharded_db):
+    db, sh = sharded_db
+    execute_queries_batched(db, _template_group(db, 2))
+    _, templates, _ = _plan_caches(db)
+    keys = [k for t in templates.values() for k in t["by_state"]]
+    assert keys and all(k[-1] == sh.signature for k in keys)
+
+
+def test_divergent_members_fall_back_to_oracle(mesh8):
+    # members differing beyond pattern constants must NOT ride the
+    # parameterized program — and must still return oracle rows
+    db = _lubm_db(1)
+    attach_sharded(db, mesh8).refresh()
+    deps = execute_query_volcano(DEPTS_Q, db)
+    texts = [
+        PREFIX + "SELECT ?x ?c WHERE { ?x ub:worksFor <%s> . "
+        "?x ub:teacherOf ?c . FILTER(?x != <%s>) }" % (d[0], d[0])
+        for d in deps[:2]
+    ]
+    oracle = [execute_query_volcano(t, db) for t in texts]
+    assert execute_queries_batched(db, texts) == oracle
+
+
+# ------------------------------------------------ mutation: O(delta), fuzz
+
+
+def test_interleaved_mutation_fuzz_vs_oracle(mesh8):
+    db = _lubm_db(1)
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    texts = _template_group(db, 3)
+    rng = np.random.default_rng(8)
+    d = db.dictionary
+    pred = np.uint32(d.encode("http://fuzz/p"))
+    works = np.uint32(d.encode(
+        "http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor"
+    ))
+    churn = []  # live fuzz triples, each unique (never re-added)
+    uid = 0
+    for rnd in range(6):
+        n_add = int(rng.integers(1, 30))
+        s = np.array(
+            [d.encode(f"http://fuzz/s{uid + k}") for k in range(n_add)],
+            dtype=np.uint32,
+        )
+        o = np.array(
+            [d.encode(f"http://fuzz/o{uid + k}") for k in range(n_add)],
+            dtype=np.uint32,
+        )
+        uid += n_add
+        db.store.add_batch(s, np.full(n_add, pred, dtype=np.uint32), o)
+        churn.extend(zip(s.tolist(), o.tolist()))
+        for _ in range(min(len(churn), int(rng.integers(0, 8)))):
+            ts, to = churn.pop(int(rng.integers(0, len(churn))))
+            db.store.remove(ts, int(pred), to)
+        # also delete a LIVE LUBM edge so the oracle answer itself moves
+        rows = execute_query_volcano(WORKS_Q, db)
+        vx, vd = rows[int(rng.integers(0, len(rows)))]
+        db.store.remove(d.encode(vx), int(works), d.encode(vd))
+        got = execute_queries_batched(db, texts)
+        assert got == [execute_query_volcano(t, db) for t in texts], rnd
+        # the mirror tracks the live store exactly after each round
+        s_, p_, o_ = sh.view.gather_host()
+        assert len(s_) == len(db.store)
+
+
+def test_mutation_batches_cause_zero_recompiles(mesh8):
+    db = _lubm_db(1)
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    texts = _template_group(db, 3)
+    execute_queries_batched(db, texts)  # prime: compile once
+    before = sharded_compile_stats()
+    base_builds = sh.view.subj_index_base_builds
+    d = db.dictionary
+    for r in range(4):
+        s = np.array(
+            [d.encode(f"http://zr/{r}-{k}") for k in range(6)], dtype=np.uint32
+        )
+        p = np.full(6, d.encode("http://zr/p"), dtype=np.uint32)
+        o = np.array(
+            [d.encode(f"http://zr/o{r}-{k}") for k in range(6)],
+            dtype=np.uint32,
+        )
+        db.store.add_batch(s, p, o)
+        execute_queries_batched(db, texts)
+    assert sharded_compile_stats() == before
+    # satellite: the per-shard probe index must NOT full-repack per batch
+    assert sh.view.subj_index_base_builds == base_builds
+    assert sh.view.subj_index_delta_builds >= 4
+
+
+# --------------------------------------------------- durability / recovery
+
+
+def test_recovery_rebuilds_sharded_mirrors(mesh8, tmp_path):
+    from kolibrie_tpu.durability.manager import DurabilityManager
+
+    data = str(tmp_path / "data")
+    m = DurabilityManager(data, fsync_policy="always")
+    m.start()
+    db = SparqlDatabase()
+    db.execution_mode = "host"
+    m.attach("s1", db)
+    db.parse_ntriples(
+        "\n".join(
+            f"<http://r/e{i}> <http://r/p> <http://r/o{i % 7}> ."
+            for i in range(60)
+        )
+    )
+    m.snapshot({"s1": db})
+    # post-snapshot mutations ride the WAL only
+    db.parse_ntriples("<http://r/extra> <http://r/p> <http://r/o1> .")
+    q = "SELECT ?s WHERE { ?s <http://r/p> <http://r/o1> . }"
+    oracle = execute_query_volcano(q, db)
+    m.close()
+
+    m2 = DurabilityManager(data, fsync_policy="always")
+    rebuilt = {}
+
+    def hook(sid, rdb):
+        sh = attach_sharded(rdb, mesh8)
+        sh.refresh()
+        rebuilt[sid] = sh
+
+    m2.on_store_recovered = hook
+    res = m2.recover()
+    m2.close()
+    assert "s1" in rebuilt  # snapshot restore + WAL replay reached the hook
+    rdb = res.stores["s1"]
+    assert len(rdb.store) == len(db.store)
+    s, p, o = rebuilt["s1"].view.gather_host()
+    assert len(s) == len(rdb.store)
+    assert sorted(rebuilt["s1"].execute(q)) == sorted(oracle)
+
+
+def test_checkpoint_restore_then_refresh(mesh8, tmp_path):
+    # restore swaps every base array: refresh must rebuild the mirrors for
+    # the new arrays even when the shape signature looks unchanged
+    db = _lubm_db(1)
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    path = str(tmp_path / "ck.bin")
+    db.checkpoint(path)
+    db2 = SparqlDatabase.from_checkpoint(path)
+    db2.execution_mode = "host"
+    sh2 = attach_sharded(db2, mesh8)
+    sh2.refresh()
+    q = _template_group(db, 1)[0]
+    assert sh2.execute(q) == execute_query_volcano(q, db)
+
+
+# ------------------------------------------------------------- resilience
+
+
+def test_mesh_fault_degrades_to_single_device(mesh8):
+    from kolibrie_tpu.resilience.breaker import breaker_board
+    from kolibrie_tpu.resilience.faultinject import (
+        FaultPlan,
+        InjectedDeviceOOM,
+    )
+
+    db = _lubm_db(1)
+    attach_sharded(db, mesh8).refresh()
+    texts = _template_group(db, 3)
+    oracle = [execute_query_volcano(t, db) for t in texts]
+    plan = FaultPlan(seed=3)
+    plan.add("shard.dispatch", error=InjectedDeviceOOM, rate=1.0)
+    with plan.installed():
+        got = execute_queries_batched(db, texts)
+    assert got == oracle  # degraded single-device path, same rows
+    snap = breaker_board(db).snapshot()
+    assert any(rec["total_failures"] >= 1 for rec in snap.values())
+
+
+def test_mesh_deadline_propagates(mesh8):
+    from kolibrie_tpu.resilience.deadline import Deadline, deadline_scope
+    from kolibrie_tpu.resilience.errors import DeadlineExceeded
+
+    db = _lubm_db(1)
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    with pytest.raises(DeadlineExceeded):
+        with deadline_scope(Deadline(0.0)):
+            sh.execute(_template_group(db, 1)[0])
+
+
+def test_detach_restores_single_device_key(mesh8):
+    db = _lubm_db(1)
+    sh = attach_sharded(db, mesh8)
+    sh.refresh()
+    texts = _template_group(db, 2)
+    execute_queries_batched(db, texts)
+    detach_sharded(db)
+    assert execute_queries_batched(db, texts) == [
+        execute_query_volcano(t, db) for t in texts
+    ]
+    _, templates, _ = _plan_caches(db)
+    keys = [k for t in templates.values() for k in t["by_state"]]
+    assert any(k[-1] is None for k in keys)
+    assert any(k[-1] == sh.signature for k in keys)
+
+
+# ------------------------------------------------------- obs / trace spans
+
+
+def test_dispatch_emits_shard_spans(sharded_db):
+    from kolibrie_tpu.obs.spans import spans_snapshot, trace_scope
+
+    db, sh = sharded_db
+    texts = _template_group(db, 3)
+    with trace_scope("trace-shard") as tid:
+        execute_queries_batched(db, texts)
+    spans = spans_snapshot(tid)
+    names = [s["name"] for s in spans]
+    assert "executor.sharded" in names
+    assert "shard.dispatch" in names
+    kids = [s for s in spans if s["name"] == "shard.partition"]
+    assert len(kids) == 8  # one child per shard, occupancy attached
+    assert all("rows" in k["attrs"] for k in kids)
+
+
+# ----------------------------------------------------------- HTTP serving
+
+
+@pytest.fixture()
+def sharded_server(mesh8, monkeypatch):
+    from kolibrie_tpu.frontends import http_server
+
+    monkeypatch.setattr(http_server, "SHARDED_SERVING", True)
+    httpd = http_server.make_server("127.0.0.1", 0, quiet=True)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_sharded_store_end_to_end(sharded_server):
+    base = sharded_server
+    db = _lubm_db(1)
+    out = _post(
+        base,
+        "/store/load",
+        {"rdf": db.to_ntriples(), "format": "ntriples", "mode": "host"},
+    )
+    sid = out["store_id"]
+    assert out["triples"] == len(db.store)
+    # the LUBM suite through the HTTP path: identical to the oracle
+    for q in (lubm.LUBM_Q2, DEPTS_Q, *_template_group(db, 2)):
+        got = _post(base, "/store/query", {"store_id": sid, "sparql": q})
+        oracle = execute_query_volcano(q, db)
+        assert sorted(map(tuple, got["data"])) == sorted(map(tuple, oracle))
+    # shard-level health is exported in /stats ...
+    with urllib.request.urlopen(base + "/stats", timeout=60) as resp:
+        stats = json.loads(resp.read())
+    sharding = stats["stores"][sid]["sharding"]
+    assert sharding["shards"] == 8
+    assert len(sharding["occupancy"]) == 8
+    assert "last_cap_hit" in sharding
+    # ... and the kolibrie_shard_* series in /metrics
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = resp.read().decode()
+    assert "kolibrie_shard_rows_scanned_total" in metrics
+    assert "kolibrie_store_shards" in metrics
+
+
+# ------------------------------------------------------------------ kolint
+
+
+def test_shard_map_reachable_code_is_kl101_clean():
+    # CI guard (ISSUE 8 satellite): the mesh serving path must stay free
+    # of host syncs inside shard_map-reachable code — one .item() in the
+    # batched body would serialize all eight shards on every dispatch
+    from kolibrie_tpu.analysis import core
+
+    pkg = Path(__file__).resolve().parent.parent / "kolibrie_tpu" / "parallel"
+    res = core.run([str(pkg)], use_baseline=False, rules=["KL101"])
+    assert res.findings == [], [
+        f"{f.path}:{f.line} {f.message}" for f in res.findings
+    ]
