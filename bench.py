@@ -842,6 +842,145 @@ def main():
         sharded_block = {"error": repr(e)}
     note(f"sharded_serving sweep done ({sharded_block})")
 
+    # ---- compile tail: churn cold/warm, specialized vs interp vs disk ----
+    # A stream of FRESH template shapes (the serving regime the compile
+    # tail hurts): per-template first-execution latency (cold) and
+    # second-variant latency (warm) under (a) the specialized
+    # one-compile-per-template path, (b) the plan-bytecode interpreter
+    # (one executable per size class), and — CPU only, needs fresh
+    # processes — (c) a restarted process over a populated persistent
+    # cache, plus cold-start-to-first-result with/without that cache.
+    note("compile_tail sweep")
+    compile_tail = None
+    try:
+        import tempfile
+
+        CHURN_N = 10
+
+        def churn_queries(salt):
+            out = []
+            for i in range(CHURN_N):
+                conds = " && ".join(
+                    [f"?s > {28000 + 13 * i + salt}"]
+                    + [
+                        f"?s != {40000 + 997 * j + i}"
+                        for j in range(i + 1)
+                    ]
+                )
+                out.append(
+                    "PREFIX ds: <https://data.example/ontology#> "
+                    'SELECT ?e ?s WHERE { ?e ds:title "Engineer" . '
+                    f"?e ds:annual_salary ?s . FILTER({conds}) }}"
+                )
+            return out
+
+        def churn_lat(salt):
+            cold, warm = [], []
+            for q in churn_queries(salt):
+                t0 = time.perf_counter()
+                execute_query_volcano(q, db)
+                cold.append((time.perf_counter() - t0) * 1000.0)
+                t0 = time.perf_counter()
+                execute_query_volcano(q, db)
+                warm.append((time.perf_counter() - t0) * 1000.0)
+            cold.sort()
+            warm.sort()
+            return {
+                "cold_ms_p50": round(cold[len(cold) // 2], 3),
+                "cold_ms_p99": round(cold[-1], 3),
+                "warm_ms_p50": round(warm[len(warm) // 2], 3),
+                "warm_ms_p99": round(warm[-1], 3),
+            }
+
+        from kolibrie_tpu.optimizer.plan_interp import override_mode
+
+        c0 = device_compile_stats()
+        with override_mode("off"):
+            spec_lat = churn_lat(0)
+        c1 = device_compile_stats()
+        with override_mode("force"):
+            interp_lat = churn_lat(1)
+        c2 = device_compile_stats()
+        spec_lat["compiles"] = c1["run_plan"] - c0["run_plan"]
+        interp_lat["specialized_compiles"] = c2["run_plan"] - c1["run_plan"]
+        interp_lat["size_class_compiles"] = c2["run_interp"] - c1["run_interp"]
+        compile_tail = {
+            "churn_templates": CHURN_N,
+            "specialized": spec_lat,
+            "interpreter": interp_lat,
+        }
+        if platform != "tpu":
+            # restart legs: child processes sharing one cache directory
+            cc_dir = tempfile.mkdtemp(prefix="kolibrie-bench-cc-")
+            child = (
+                "import json, os, sys, time\n"
+                "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+                f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+                "from kolibrie_tpu.query import compile_cache\n"
+                "from kolibrie_tpu.query.prewarm import replay_manifest\n"
+                "from kolibrie_tpu.query.executor import execute_query_volcano\n"
+                "from kolibrie_tpu.query.sparql_database import SparqlDatabase\n"
+                "mode, root = sys.argv[1], sys.argv[2]\n"
+                "if mode != 'nocache':\n"
+                "    compile_cache.enable(explicit_dir=root)\n"
+                "db = SparqlDatabase()\n"
+                "rows = []\n"
+                "for i in range(400):\n"
+                "    e = f'<https://data.example/e{i}>'\n"
+                "    rows.append(f'{e} <https://data.example/ontology#title> \"Engineer\" .')\n"
+                "    rows.append(f'{e} <https://data.example/ontology#annual_salary> \"{20000 + i * 37}\" .')\n"
+                "db.parse_ntriples('\\n'.join(rows))\n"
+                "db.execution_mode = 'device'\n"
+                "QS = json.loads(sys.argv[3])\n"
+                "if mode == 'warm':\n"
+                "    replay_manifest(db, root=root)\n"
+                "lat = []\n"
+                "for q in QS:\n"
+                "    t0 = time.perf_counter()\n"
+                "    execute_query_volcano(q, db)\n"
+                "    lat.append((time.perf_counter() - t0) * 1000.0)\n"
+                "if mode == 'seed':\n"
+                "    compile_cache.save_manifest(root)\n"
+                "first = lat[0]\n"
+                "lat.sort()\n"
+                "print(json.dumps({'first_ms': round(first, 3),\n"
+                "                  'p50_ms': round(lat[len(lat) // 2], 3),\n"
+                "                  'p99_ms': round(lat[-1], 3)}))\n"
+            )
+            qs_json = json.dumps(churn_queries(2))
+
+            def run_child(mode):
+                env = dict(os.environ)
+                env.pop("KOLIBRIE_PLAN_INTERP", None)
+                env.pop("KOLIBRIE_COMPILE_CACHE_DIR", None)
+                env["JAX_PLATFORMS"] = "cpu"
+                t0 = time.perf_counter()
+                out = subprocess.run(
+                    [sys.executable, "-c", child, mode, cc_dir, qs_json],
+                    capture_output=True, text=True, timeout=300, env=env,
+                )
+                if out.returncode != 0:
+                    raise RuntimeError(out.stderr[-800:])
+                res = json.loads(out.stdout.splitlines()[-1])
+                res["wall_s"] = round(time.perf_counter() - t0, 3)
+                return res
+
+            seed = run_child("seed")  # populates cache + manifest
+            disk = run_child("warm")  # fresh process, cache + manifest hot
+            no_cache = run_child("nocache")  # fresh process, no cache at all
+            compile_tail["restart"] = {
+                "first_process_churn": seed,
+                "restarted_with_cache_churn": disk,
+                "restarted_no_cache_churn": no_cache,
+                "cold_start_to_first_result_ms": {
+                    "with_cache": disk["first_ms"],
+                    "without_cache": no_cache["first_ms"],
+                },
+            }
+    except Exception as e:
+        compile_tail = {"error": repr(e)}
+    note(f"compile_tail sweep done ({compile_tail})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -908,6 +1047,7 @@ def main():
                     "wcoj": wcoj_block,
                     "durability": durability_block,
                     "sharded_serving": sharded_block,
+                    "compile_tail": compile_tail,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

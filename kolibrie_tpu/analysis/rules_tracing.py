@@ -9,6 +9,7 @@ KL101  host-sync call in jit-reachable code
 KL102  Python control flow on a traced value in a jit root
 KL201  jit wrapper constructed per call (no memoization)
 KL202  static argument derived from per-call values
+KL203  static argument that is not fingerprint-stable across processes
 """
 
 from __future__ import annotations
@@ -377,4 +378,83 @@ def _per_call_static_expr(expr: ast.AST) -> str:
                 return "len() of per-call data"
         if isinstance(node, ast.Attribute) and node.attr == "shape":
             return "a .shape read of per-call data"
+    return ""
+
+
+# Attributes whose values are process-local counters/versions: embedding
+# one in a static argument keys the executable on state no other process
+# (or the persistent compilation cache) can reproduce.
+_PROCESS_LOCAL_ATTRS = {"__dict__", "delta_epoch", "base_version"}
+
+
+@rule(
+    "KL203",
+    "static argument at a jit call site that is not fingerprint-stable "
+    "across processes (id()/hash()/object()/raw version counters) — "
+    "it defeats the persistent compilation cache and recompiles per "
+    "process or per mutation",
+)
+def static_arg_not_fingerprint_stable(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    jit_by_name = {}
+    for info in project.functions.values():
+        if info.is_jit_root and info.static_params:
+            jit_by_name.setdefault(info.qualname.split(".")[-1], info)
+    for info in project.functions.values():
+        for node in iter_own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = jit_by_name.get(terminal_name(node.func))
+            if callee is None:
+                continue
+            static = set(callee.static_params)
+            bound = []
+            for i, arg in enumerate(node.args):
+                if i < len(callee.params) and callee.params[i] in static:
+                    bound.append((callee.params[i], arg))
+            for kw in node.keywords:
+                if kw.arg in static:
+                    bound.append((kw.arg, kw.value))
+            for pname, expr in bound:
+                bad = _unstable_static_expr(expr)
+                if bad:
+                    out.append(
+                        Finding(
+                            "KL203",
+                            info.module.rel,
+                            node.lineno,
+                            f"static argument {pname!r} of "
+                            f"{callee.qualname.split('.')[-1]}() is {bad}; "
+                            "key the executable on structural values "
+                            "(shapes, capacity classes, fingerprints) so "
+                            "two processes lowering the same template hash "
+                            "to the same persistent-cache entry",
+                            scope=info.qualname,
+                        )
+                    )
+    return out
+
+
+def _unstable_static_expr(expr: ast.AST) -> str:
+    """Non-empty description when the expression cannot reproduce across
+    processes: object identities, salted hashes, fresh sentinels, and
+    raw store version counters (monotonic per process, not content-
+    derived)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            fn = terminal_name(node.func)
+            if fn == "id":
+                return "id() — an object address, unique to this process"
+            if fn == "hash":
+                return "hash() — salted per process for str/bytes"
+            if fn == "object":
+                return "object() — a fresh sentinel every call"
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _PROCESS_LOCAL_ATTRS
+        ):
+            return (
+                f"a raw .{node.attr} read — a process-local counter/"
+                "identity, not a content fingerprint"
+            )
     return ""

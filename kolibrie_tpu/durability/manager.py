@@ -394,6 +394,14 @@ class DurabilityManager:
         returns (an empty directory recovers to an empty result)."""
         from kolibrie_tpu.query.sparql_database import SparqlDatabase
 
+        # re-attach the persistent compilation cache BEFORE replay: WAL
+        # replay re-runs device dispatches, and every one of them should
+        # load the executable a previous incarnation already compiled
+        # under <data_dir>/compile_cache instead of recompiling
+        from kolibrie_tpu.query import compile_cache
+
+        compile_cache.enable(data_dir=self.data_dir)
+
         t0 = time.perf_counter()
         res = RecoveryResult()
         manifest = None

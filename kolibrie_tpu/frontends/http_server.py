@@ -482,6 +482,14 @@ class _ServerState:
         self.status = "ready"
         self.durability = None
         self.recovery_stats: dict = {}
+        self.prewarmer = None  # set by make_server
+        # the persistent compilation cache must be live BEFORE the first
+        # lowering this process performs — including recovery's own WAL
+        # replay dispatches, which should hit artifacts a previous
+        # incarnation (or a fleet peer) compiled
+        from kolibrie_tpu.query import compile_cache
+
+        compile_cache.enable(data_dir=data_dir)
         if data_dir:
             from kolibrie_tpu.durability import DurabilityManager
 
@@ -847,6 +855,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         "/rsp/checkpoint": "_handle_rsp_checkpoint",
         "/rsp/restore": "_handle_rsp_restore",
         "/debug/profile": "_handle_debug_profile",
+        "/debug/prewarm": "_handle_debug_prewarm",
     }
 
     def do_POST(self):
@@ -1103,6 +1112,31 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         trace_id = (parse_qs(qs).get("trace_id") or [None])[0]
         body = export_jsonl(trace_id)
         self._send(200, body.encode("utf-8"), "application/x-ndjson")
+
+    def _handle_debug_prewarm(self):
+        """``POST /debug/prewarm``: one synchronous warm sweep — the
+        manifest's top-N templates compiled (or disk-loaded) against
+        every registered store, off the normal admission path.  Returns
+        per-template compile wall-ms and the executable's source
+        (``compiled`` = real XLA compile, ``disk`` = persistent-cache
+        hit); operators call this after a deploy to pre-pay the tail."""
+        from urllib.parse import parse_qs
+
+        from kolibrie_tpu.query import compile_cache
+
+        warmer = self.state.prewarmer
+        if warmer is None:
+            raise NotFound("prewarm not configured")
+        qs = parse_qs(self.path.partition("?")[2])
+        top_n = int((qs.get("top_n") or [0])[0]) or None
+        results = warmer.run_once(top_n=top_n)
+        self._send_json(
+            {
+                "warmed": results,
+                "manifest": compile_cache.manifest_path(warmer.root),
+                "compile_cache": compile_cache.stats(),
+            }
+        )
 
     def _handle_debug_profile(self):
         """``POST /debug/profile?seconds=N``: capture a jax.profiler trace
@@ -1369,6 +1403,22 @@ def make_server(
         "BoundHandler", (KolibrieHandler,), {"state": state, "quiet": quiet}
     )
     httpd = ThreadingHTTPServer((host, port), handler)
+
+    def _targets():
+        with state.lock:
+            batchers = dict(state.stores)
+        return [
+            (sid, b.db, b.dispatch_lock) for sid, b in sorted(batchers.items())
+        ]
+
+    from kolibrie_tpu.query.prewarm import PrewarmManager
+
+    state.prewarmer = PrewarmManager(
+        get_targets=_targets,
+        is_idle=lambda: state.admission.inflight == 0,
+        is_ready=lambda: state.status == "ready",
+    )
+    state.prewarmer.start()
     if state.durability is not None:
         if recover_async:
             threading.Thread(
@@ -1393,6 +1443,10 @@ def shutdown_gracefully(httpd, timeout_s: float = 30.0) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline and state.admission.inflight > 0:
         time.sleep(0.05)
+    if state.prewarmer is not None:
+        # stop the warmer before the final snapshot: it persists the
+        # manifest so the NEXT incarnation knows this one's hot set
+        state.prewarmer.stop()
     if state.durability is not None:
         try:
             _snapshot_now(state)

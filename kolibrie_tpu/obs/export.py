@@ -162,10 +162,19 @@ def build_stats(state) -> dict:
             "status": getattr(state, "status", "ready"),
             **durability.stats(),
         }
+    # compile-tail block: persistent-cache hit/miss traffic + warmer
+    # progress — the "is the restart tail actually dead" dashboard
+    from kolibrie_tpu.query import compile_cache
+
+    compile_tail: dict = {"cache": compile_cache.stats()}
+    warmer = getattr(state, "prewarmer", None)
+    if warmer is not None:
+        compile_tail["prewarm"] = warmer.stats()
     return {
         "stores": {sid: store_stats(b) for sid, b in stores.items()},
         "rsp_sessions": len(sessions),
         "resilience": resilience,
+        "compile_tail": compile_tail,
     }
 
 

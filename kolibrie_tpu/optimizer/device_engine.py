@@ -890,7 +890,37 @@ def device_compile_stats() -> Dict[str, int]:
         # kolint: ignore[KL601] jax version probe; -1 is the sentinel the stats endpoint documents for "cache API absent"
         except Exception:
             out[name] = -1
+    from kolibrie_tpu.optimizer.plan_interp import interp_compile_stats
+
+    out["run_interp"] = interp_compile_stats()
     return out
+
+
+def _cc_counters() -> Dict[str, int]:
+    """Persistent-compile-cache hit/miss tallies (zeros when the cache
+    module never activated — the deltas still classify correctly)."""
+    from kolibrie_tpu.query.compile_cache import counters
+
+    return counters()
+
+
+def _classify_source(jit_before: int, cc_before: Dict[str, int]) -> str:
+    """Classify a specialized dispatch after the fact: a jit-cache entry
+    appeared and every persistent-cache lookup hit disk → ``disk``;
+    otherwise (fresh XLA compile, or warm replay) → ``compiled``."""
+    try:
+        grew = jit_before >= 0 and int(_run_plan._cache_size()) > jit_before
+    # kolint: ignore[KL601] same jax cache-API probe as device_compile_stats
+    except Exception:
+        grew = False
+    if not grew:
+        return "compiled"
+    after = _cc_counters()
+    if after["hits"] > cc_before.get("hits", 0) and after[
+        "misses"
+    ] == cc_before.get("misses", 0):
+        return "disk"
+    return "compiled"
 
 
 @partial(jax.jit, static_argnames=("spec", "k", "use_pallas"))
@@ -2529,6 +2559,12 @@ class LoweredPlan:
     def empty_table(self) -> BindingTable:
         return {v: np.empty(0, dtype=np.uint32) for v in self.out_vars}
 
+    # how the last execute() produced its rows: "interp" (plan-bytecode
+    # interpreter), "compiled" (specialized jit, compiled or warm), or
+    # "disk" (specialized jit whose executable loaded from the persistent
+    # compilation cache).  Plan-cache slots surface this as `source`.
+    last_source: Optional[str] = None
+
     def execute(self) -> BindingTable:
         """Run to completion with capacity validation; returns a host table."""
         # deadline check BEFORE the dispatch (don't start device work the
@@ -2539,10 +2575,28 @@ class LoweredPlan:
         if not self.const_ok():
             return self.empty_table()
         tpl = _get_baggage("template", "unknown")
+        # zero-compile cold path: KOLIBRIE_PLAN_INTERP routes eligible
+        # templates through the plan-bytecode interpreter until the
+        # specialized executable exists (docs/COMPILE_CACHE.md); a shape
+        # the interpreter declines falls through to the specialized path
+        from kolibrie_tpu.optimizer import plan_interp
+
+        if plan_interp.should_interp(self):
+            t0 = _time.perf_counter()
+            table = plan_interp.interp_execute(self)
+            if table is not None:
+                self.last_source = "interp"
+                _DISPATCH_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+                check_deadline("device.execute.done")
+                return table
+        jit0 = device_compile_stats().get("run_plan", -1)
+        cc0 = _cc_counters()
         t0 = _time.perf_counter()
         with _obs_span("device.dispatch", template=tpl):
             parts = self.converge(self.run())
         _DISPATCH_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+        plan_interp.mark_compiled(self)
+        self.last_source = _classify_source(jit0, cc0)
         t1 = _time.perf_counter()
         with _obs_span("device.collect"):
             table = self.to_table(*parts)

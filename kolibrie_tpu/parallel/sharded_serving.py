@@ -632,6 +632,25 @@ class ShardedDatabase:
             self.stats_counters["dispatches"] += 1
             return rows
 
+    def warm(self, sparql: str) -> bool:
+        """Pre-compile the mesh program for one template off the request
+        path (the background warmer's entry point).  A solo dispatch
+        lowers and jits the same parameterized shard_map program
+        ``execute_batch`` will run — with the persistent compilation
+        cache enabled the XLA work is a disk load on every process after
+        the first.  Returns False (instead of raising) for templates the
+        distributed lowering declines: the warmer treats that as "this
+        template serves single-device" and moves on."""
+        try:
+            self.execute(sparql)
+        except Unsupported:
+            return False
+        with self.lock:
+            self.stats_counters["prewarmed"] = (
+                self.stats_counters.get("prewarmed", 0) + 1
+            )
+        return True
+
     def execute_batch(
         self, fp: str, items: List[Tuple[int, str]]
     ) -> Dict[int, List[List[str]]]:

@@ -93,6 +93,14 @@ _DEVICE_AUTO_MIN = 100_000
 # --------------------------------------------------------------------------
 
 
+def _interp_mode() -> str:
+    """Current ``KOLIBRIE_PLAN_INTERP`` routing mode (lazy import: the
+    interpreter module pulls in the device engine)."""
+    from kolibrie_tpu.optimizer.plan_interp import plan_interp_mode
+
+    return plan_interp_mode()
+
+
 def _device_routed(db) -> bool:
     """THE routing rule for "does this query run on the device engine":
     explicit ``execution_mode == "device"``, or auto mode over a store big
@@ -989,13 +997,15 @@ def _plan_cache_entry(db, sparql: str):
     ``entry`` carries the parsed ``cq``, ``slot`` has the
     ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode
+    from kolibrie_tpu.query.compile_cache import record_template
     from kolibrie_tpu.query.template import fingerprint_query
 
     parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
-    # the join-strategy mode is part of the template fingerprint; a mode
-    # flip after parse must refingerprint (not replay the old-mode plan)
-    env_sig = wcoj_mode()
+    # the join-strategy and interpreter-routing modes are part of the
+    # template fingerprint; a mode flip after parse must refingerprint
+    # (not replay the old-mode plan)
+    env_sig = (wcoj_mode(), _interp_mode())
     ent = parse.get(sparql)
     if ent is None or ent["prefix_sig"] != prefix_sig or ent["env_sig"] != env_sig:
         ent = {
@@ -1016,6 +1026,9 @@ def _plan_cache_entry(db, sparql: str):
             ent["fp"], ent["params"] = fingerprint_query(ent["cq"])
             _PARSE_LAT.observe(time.perf_counter() - t0)
     fp, params = ent["fp"], ent["params"]
+    # feed the pre-warm manifest: per-template popularity + one
+    # representative query text the warmer can replay after a restart
+    record_template(fp, sparql)
     tent = templates.get(fp)
     if tent is None:
         tent = {"by_state": {}, "hits": 0, "misses": 0}
@@ -1037,6 +1050,25 @@ def _plan_cache_entry(db, sparql: str):
         None if _sh is None else _sh.signature,
     )
     slot = tent["by_state"].get(state)
+    if slot is not None and slot["lowered"] is False:
+        # sticky-failure expiry: a ``False`` sentinel from a TRANSIENT
+        # device fault should not outlive the fault.  The template's
+        # circuit breaker bumps ``close_epoch`` on every open→closed
+        # recovery; when the epoch has advanced past the one captured
+        # with the sentinel, the fault demonstrably healed — clear the
+        # sentinel so the next execution retries device lowering.
+        # Shape-level failures (Unsupported) stay sticky: their host
+        # fallback records success on an always-closed breaker, which
+        # never bumps the epoch.
+        epoch = breaker_board(db).close_epoch(fp)
+        if slot.get("breaker_epoch") is None:
+            slot["breaker_epoch"] = epoch
+        elif slot["breaker_epoch"] != epoch:
+            slot["plan"] = None
+            slot["lowered"] = None
+            slot["ordered_failed"] = False
+            slot["breaker_epoch"] = epoch
+            stats["sentinel_expiries"] = stats.get("sentinel_expiries", 0) + 1
     if slot is None:
         # stale-base-version slots pin device-resident copies of OLD store
         # orders (a LoweredPlan holds full sorted-store copies): drop
@@ -1127,11 +1159,21 @@ def plan_cache_info(db) -> dict:
             1 for s in tent["by_state"].values() if s["lowered"] is False
         )
         sticky += failed
+        # where the template's most recent device dispatch came from:
+        # "interp" (bytecode interpreter), "compiled" (real XLA compile
+        # or warm jit replay), "disk" (persistent-cache hit) — None when
+        # nothing device-lowered has run yet
+        source = None
+        for s in tent["by_state"].values():
+            low = s.get("lowered")
+            if low is not None and low is not False:
+                source = getattr(low, "last_source", None) or source
         per[fp] = {
             "states": len(tent["by_state"]),
             "hits": tent["hits"],
             "misses": tent["misses"],
             "failed_states": failed,
+            "source": source,
         }
     return {
         "parse_entries": len(parse),
@@ -1143,6 +1185,7 @@ def plan_cache_info(db) -> dict:
         "batched": stats["batched"],
         "batch_groups": stats["batch_groups"],
         "sticky_failures": sticky,
+        "sentinel_expiries": stats.get("sentinel_expiries", 0),
         "per_template": per,
         "limits": {
             "parse": _PLAN_CACHE_MAX,
@@ -1316,6 +1359,12 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             continue  # solo dispatch is already optimal for singletons
         if not board.allow(fp):
             continue  # breaker open: members fall to the solo degraded path
+        if _interp_mode() == "force":
+            # forced interpreter routing: the mesh shard_map program and
+            # the stacked-batch jit are exactly the per-template compiles
+            # the mode exists to avoid — members run solo through the
+            # single-device interpreter instead (docs/COMPILE_CACHE.md)
+            continue
         set_baggage("template", fp)
         if sharded is not None:
             # mesh-first: the whole template group rides one shard_map

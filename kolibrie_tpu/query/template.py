@@ -133,11 +133,19 @@ def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
     The join-strategy routing mode (``KOLIBRIE_WCOJ``) is folded into the
     skeleton: strategy selection happens at PLAN time, so a plan cached
     under one mode must never replay after the mode flips — distinct
-    fingerprints give each strategy its own slot (and device executable)."""
+    fingerprints give each strategy its own slot (and device executable).
+    ``KOLIBRIE_PLAN_INTERP`` joins it for the same reason: the interpreter
+    routing decision is sticky per cached slot (its source state, its
+    learned caps), so a mode flip must land in a fresh fingerprint."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode  # lazy: avoids cycle
+    from kolibrie_tpu.optimizer.plan_interp import plan_interp_mode
 
     params: List[Any] = []
-    structure = ("wcoj", wcoj_mode(), _ser(cq, params))
+    structure = (
+        "interp",
+        plan_interp_mode(),
+        ("wcoj", wcoj_mode(), _ser(cq, params)),
+    )
     return structure, tuple(params)
 
 

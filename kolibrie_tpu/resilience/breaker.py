@@ -71,6 +71,13 @@ class CircuitBreaker:
         self.retry_at = 0.0
         self._probe_inflight = False
         self.degraded_served = 0  # requests routed to the host path
+        # bumped on every recovery (non-closed -> closed transition);
+        # lets the plan cache expire a sticky failure sentinel exactly
+        # when the fault that produced it has demonstrably healed.  An
+        # always-closed breaker (the Unsupported case: host fallback
+        # records success without ever tripping) never bumps, so shape
+        # sentinels stay sticky.
+        self.close_epoch = 0
 
     # ------------------------------------------------------------- decisions
 
@@ -93,6 +100,8 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            if self.state != CLOSED:
+                self.close_epoch += 1
             self.failures = 0
             self.consecutive_trips = 0
             self.state = CLOSED
@@ -129,6 +138,7 @@ class CircuitBreaker:
                 "total_failures": self.total_failures,
                 "trips": self.trips,
                 "degraded_served": self.degraded_served,
+                "close_epoch": self.close_epoch,
             }
             if self.state == OPEN:
                 out["retry_in_s"] = round(max(0.0, self.retry_at - self.clock()), 3)
@@ -170,6 +180,13 @@ class BreakerBoard:
 
     def record_failure(self, fp: str) -> None:
         self.get(fp).record_failure()
+
+    def close_epoch(self, fp: str) -> int:
+        """Recovery counter for ``fp`` WITHOUT creating a breaker: a
+        template that never failed reads epoch 0 at no board cost."""
+        with self._lock:
+            br = self._breakers.get(fp)
+        return 0 if br is None else br.close_epoch
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -230,6 +230,65 @@ def test_kl202_good(tmp_path):
     assert res.findings == []
 
 
+# ----------------------- KL203: fingerprint-unstable static arguments
+
+
+BAD_KL203_ID = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("key",))
+def run(x, key):
+    return x
+
+def serve(x, spec):
+    return run(x, key=id(spec))  # process-local address as cache key
+"""
+
+BAD_KL203_VERSION = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("ver",))
+def run(x, ver):
+    return x
+
+def serve(x, store):
+    return run(x, ver=store.base_version)  # per-process counter
+"""
+
+GOOD_KL203 = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("spec", "cap"))
+def run(x, spec, cap):
+    return x
+
+def serve(x, plan_spec, base_cap):
+    # structural values: identical across processes lowering the same
+    # template, so the persistent compilation cache shares entries
+    return run(x, spec=plan_spec, cap=base_cap)
+"""
+
+
+def test_kl203_object_id(tmp_path):
+    res = lint(tmp_path, BAD_KL203_ID)
+    assert rules_fired(res) == ["KL203"]
+    assert "id()" in res.findings[0].message
+
+
+def test_kl203_raw_version_counter(tmp_path):
+    res = lint(tmp_path, BAD_KL203_VERSION)
+    assert rules_fired(res) == ["KL203"]
+    assert "base_version" in res.findings[0].message
+
+
+def test_kl203_structural_static_args_clean(tmp_path):
+    res = lint(tmp_path, GOOD_KL203)
+    assert res.findings == []
+
+
 # ------------------------------------------------ KL301: guarded state
 
 
@@ -710,7 +769,7 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert kolint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("KL101", "KL102", "KL201", "KL202", "KL301", "KL302",
+    for rid in ("KL101", "KL102", "KL201", "KL202", "KL203", "KL301", "KL302",
                 "KL401", "KL501", "KL502", "KL601", "KL701",
                 "KL001", "KL002"):
         assert rid in out
