@@ -1,11 +1,13 @@
 """Distributed shard-local join: Pallas tile kernel vs XLA, inside shard_map.
 
-Measures the KOLIBRIE_PALLAS_DIST route (``dist_join._local_join_u32_pallas``
-— sort-once + merge-join kernel + permutation map-back) against the default
+Measures the Pallas dist route (``dist_join._local_join_u32_pallas`` —
+sort-once + merge-join kernel + permutation map-back) against the default
 XLA searchsorted expansion, through the SAME ``dist_equi_join`` entry the
-distributed fixpoint/query rounds use.  The flag is read at TRACE time and
-the compiled-program caches don't key on it, so each mode runs in its own
-subprocess; the parent computes the ratio.
+distributed fixpoint/query rounds use.  Routing uses the unified
+``KOLIBRIE_PALLAS`` mode (``force`` turns the dist kernels on; the
+deprecated ``KOLIBRIE_PALLAS_DIST`` alias still wins when set).  The flag
+is read at TRACE time and the compiled-program caches don't key on it, so
+each mode runs in its own subprocess; the parent computes the ratio.
 
 On the real chip this is the measurement VERDICT r3 item 3 asks for (flip
 the distributed default to Pallas if it wins); on the CPU mesh the kernel
@@ -32,10 +34,11 @@ GAP_S = 0.1
 
 
 def _child(mode: str) -> None:
+    os.environ.pop("KOLIBRIE_PALLAS_DIST", None)  # deprecated alias
     if mode == "pallas":
-        os.environ["KOLIBRIE_PALLAS_DIST"] = "1"
+        os.environ["KOLIBRIE_PALLAS"] = "force"
     else:
-        os.environ.pop("KOLIBRIE_PALLAS_DIST", None)
+        os.environ["KOLIBRIE_PALLAS"] = "off"
     import jax
 
     if os.environ.get("KOLIBRIE_BENCH_CPU") == "1":

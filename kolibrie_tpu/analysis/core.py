@@ -182,6 +182,7 @@ def run(
         rules_errors,
         rules_locks,
         rules_obs,
+        rules_pallas,
         rules_tracing,
     )
 

@@ -1,0 +1,127 @@
+"""Rule family 8: Pallas kernel discipline.
+
+Every Mosaic kernel in the tree lives in ``kolibrie_tpu/ops/`` behind the
+``_pallas_call`` wrapper (x64 promotion off at trace time, interpret mode
+off-TPU) — that containment is what lets the interpreter fallback, the
+KOLIBRIE_PALLAS routing and the sublane/lane layout rules be audited in
+one place.  A ``pl.pallas_call`` elsewhere escapes all three.
+
+KL801  (a) a ``pallas_call`` call site outside ``kolibrie_tpu/ops/`` —
+       kernels belong in the ops package, launched through its
+       ``_pallas_call`` wrapper;
+       (b) a ``pl.BlockSpec`` whose block-shape tuple has a sublane
+       dimension (second-to-last element, rank >= 2) that is not a
+       multiple of 8 — Mosaic tiles f32/i32 as (8, 128), so a stray
+       sublane size pads or miscompiles on real hardware while the
+       CPU interpreter happily accepts it.  Dimensions that are not
+       integer literals (after resolving module-level constant names)
+       are invisible: conservative, no finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from kolibrie_tpu.analysis.core import Finding, rule
+from kolibrie_tpu.analysis.project import Project
+
+_SUBLANE = 8
+
+
+def _in_ops(rel: str) -> bool:
+    return "/ops/" in rel or rel.startswith("ops/")
+
+
+def _module_int_consts(tree: ast.Module) -> dict:
+    """Module-level ``NAME = <int literal>`` bindings (the ``TILE = 128``
+    idiom) — the only name resolution the shape check performs."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            if isinstance(tgt, ast.Name):
+                try:
+                    v = ast.literal_eval(val)
+                except (ValueError, TypeError, SyntaxError):
+                    continue
+                if isinstance(v, int) and not isinstance(v, bool):
+                    out[tgt.id] = v
+    return out
+
+
+def _dim_value(node: ast.AST, consts: dict) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return None if isinstance(node.value, bool) else node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _is_pallas_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "pallas_call":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "pallas_call"
+
+
+def _block_shape(call: ast.Call) -> Optional[ast.Tuple]:
+    """The BlockSpec block-shape tuple literal, positional or keyword."""
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+            return kw.value
+    return None
+
+
+@rule(
+    "KL801",
+    "Pallas containment: pallas_call outside kolibrie_tpu/ops/, or a "
+    "BlockSpec sublane dimension that is not a multiple of 8",
+)
+def pallas_discipline(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        consts = _module_int_consts(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_pallas_call(node) and not _in_ops(f.rel):
+                out.append(
+                    Finding(
+                        "KL801",
+                        f.rel,
+                        node.lineno,
+                        "pallas_call outside kolibrie_tpu/ops/ — kernels "
+                        "live in the ops package and launch through its "
+                        "_pallas_call wrapper (x64-off trace, interpret "
+                        "fallback, KOLIBRIE_PALLAS routing)",
+                    )
+                )
+                continue
+            fn = node.func
+            is_blockspec = (
+                isinstance(fn, ast.Name) and fn.id == "BlockSpec"
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "BlockSpec")
+            if not is_blockspec:
+                continue
+            shape = _block_shape(node)
+            if shape is None or len(shape.elts) < 2:
+                continue  # 1-D / dynamic shapes: no sublane dimension
+            sub = _dim_value(shape.elts[-2], consts)
+            if sub is not None and sub % _SUBLANE != 0:
+                out.append(
+                    Finding(
+                        "KL801",
+                        f.rel,
+                        node.lineno,
+                        f"BlockSpec sublane dimension {sub} is not a "
+                        "multiple of 8 — Mosaic tiles i32/f32 as "
+                        "(8, 128); this block shape pads or miscompiles "
+                        "on TPU even though the interpreter accepts it",
+                    )
+                )
+    return out

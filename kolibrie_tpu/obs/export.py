@@ -170,11 +170,17 @@ def build_stats(state) -> dict:
     warmer = getattr(state, "prewarmer", None)
     if warmer is not None:
         compile_tail["prewarm"] = warmer.stats()
+    # capacity-advisor block: per-template current caps / high-water mark /
+    # retry counts (process-wide — the advisor spans stores and survives
+    # base-version churn; "is steady state really zero-retry" dashboard)
+    from kolibrie_tpu.query.template import cap_advisor
+
     return {
         "stores": {sid: store_stats(b) for sid, b in stores.items()},
         "rsp_sessions": len(sessions),
         "resilience": resilience,
         "compile_tail": compile_tail,
+        "cap_advisor": cap_advisor.stats(),
     }
 
 

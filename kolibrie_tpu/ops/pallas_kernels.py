@@ -11,6 +11,16 @@ kernels.  This module provides the TPU-native kernel path:
   merge-path partition assigns each 128-wide output tile a provably bounded
   window of left rows, and all per-output row lookups happen inside VMEM as
   one-hot masked reductions on the VPU.
+- :func:`lex_probe_select` / :func:`lex_probe_validate` — the WCOJ
+  level's per-slot lex-probe expansion fused on the VPU: base/delta
+  merge-by-rank value select, first-of-run dedup, smallest-accessor
+  choice, tombstone-aware live-existence and the base-representative
+  tie-break run as int32 boolean algebra in VMEM instead of a dozen
+  separate XLA ops round-tripping every per-slot intermediate through
+  HBM.  The lex ``searchsorted`` range computation itself stays an XLA
+  pre-pass (:func:`kolibrie_tpu.ops.wcoj.lex_range` — Mosaic has no
+  vector gather, so a binary search over HBM-resident columns cannot
+  live in the kernel); row oracle: ``ops/wcoj.py::host_lex_probe``.
 - :func:`filter_mask` — fused pattern/constant compare over dictionary-ID
   columns (the VPU equivalent of the reference's SSE2/NEON
   ``apply_filters_simd``, ``kolibrie/src/sparql_database.rs:1497-1785``).
@@ -46,7 +56,7 @@ VMEM cliff.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -97,20 +107,67 @@ def _pallas_call(*args, **kwargs):
     return launch
 
 
-def pallas_join_enabled() -> bool:
-    """Should the engine route eligible joins through the Pallas kernel?
+_PALLAS_MODES = ("off", "auto", "force")
 
-    Default: only on real TPU (interpreted Pallas is far slower than the
-    XLA formulation on CPU, so the test suite keeps the XLA path unless it
-    opts in).  ``KOLIBRIE_PALLAS_JOIN=1`` forces the kernel path anywhere
-    (tests exercise it in interpret mode); ``=0`` forces it off on TPU.
+
+def pallas_mode() -> str:
+    """The engine-wide Pallas routing mode: ``off`` | ``auto`` | ``force``.
+
+    ``KOLIBRIE_PALLAS`` is THE switch for every Pallas kernel path (the
+    merge-join tile kernel, the WCOJ ``lex_probe_*`` kernels, the
+    distributed shard-local join):
+
+    - ``off`` (also ``0``/``false``): XLA formulations everywhere;
+    - ``auto`` (default): kernels on real TPU, XLA off-TPU (interpreted
+      Pallas is far slower than XLA on CPU, so the test suite keeps the
+      XLA path unless it opts in);
+    - ``force`` (also ``1``): kernels everywhere — off-TPU they run under
+      the Pallas interpreter, which is how the CPU tier-1 suite exercises
+      the exact kernel code paths.
+
+    The mode participates in the template fingerprint and the executor's
+    ``env_sig`` exactly like ``KOLIBRIE_WCOJ`` / ``KOLIBRIE_PLAN_INTERP``:
+    a mode flip lands in a fresh plan slot, never a stale replay.
+
+    DEPRECATED: the former per-subsystem ``KOLIBRIE_PALLAS_JOIN`` (0/1)
+    and ``KOLIBRIE_PALLAS_DIST`` flags are honored as shims when
+    ``KOLIBRIE_PALLAS`` is unset — ``_JOIN=1`` maps to ``force``,
+    ``_JOIN=0`` to ``off`` — and will be removed; set ``KOLIBRIE_PALLAS``
+    instead.  An unrecognized value falls back to ``auto``.
     """
     import os
 
-    env = os.environ.get("KOLIBRIE_PALLAS_JOIN")
+    env = os.environ.get("KOLIBRIE_PALLAS")
     if env is not None:
-        return env != "0"
+        v = env.strip().lower()
+        if v in _PALLAS_MODES:
+            return v
+        if v in ("0", "false"):
+            return "off"
+        if v in ("1", "true"):
+            return "force"
+        return "auto"
+    legacy = os.environ.get("KOLIBRIE_PALLAS_JOIN")
+    if legacy is not None:  # deprecated shim (see docstring)
+        return "force" if legacy != "0" else "off"
+    return "auto"
+
+
+def pallas_enabled() -> bool:
+    """Resolve :func:`pallas_mode` against the backend: should eligible
+    operators route through the Pallas kernels right now?"""
+    mode = pallas_mode()
+    if mode == "force":
+        return True
+    if mode == "off":
+        return False
     return jax.default_backend() == "tpu"
+
+
+def pallas_join_enabled() -> bool:
+    """DEPRECATED alias of :func:`pallas_enabled` (pre-unification name;
+    kept for external callers of the old per-subsystem switch)."""
+    return pallas_enabled()
 
 
 # ---------------------------------------------------------------------------
@@ -634,6 +691,187 @@ def _xla_merge_join(lkey, lval, rkey, rval, cap):
         valid,
         total,
     )
+
+
+# ---------------------------------------------------------------------------
+# fused WCOJ lex-probe expansion
+# ---------------------------------------------------------------------------
+#
+# One WCOJ level expands ``cap`` candidate slots from the chosen accessor's
+# base+delta ranges and validates each against every accessor.  The range
+# computation (lexicographic binary search) and the per-slot gathers must
+# stay XLA — Mosaic has no vector gather — but everything elementwise
+# BETWEEN the gathers used to be ~15 separate XLA ops per accessor, each
+# round-tripping a cap-sized vector through HBM.  Two kernels fuse them:
+#
+#   lex_probe_select   (gathers →) merge-by-rank value, first-of-run
+#                      dedup, accessor choice → val / ok / is_base
+#   lex_probe_validate (existence ranges →) tombstone-adjusted liveness,
+#                      key-sentinel kill, base-representative tie-break
+#
+# split at the existence probe, which needs ``val`` back in XLA.  All
+# comparisons are integer (equality on u32 bit patterns carried in i32;
+# ordered compares only on small non-negative counts), so kernel outputs
+# are bit-identical to the XLA formulation — the engine asserts this on
+# the full WCOJ test surface under KOLIBRIE_PALLAS=force.
+
+
+def _probe_grid(p: int) -> Tuple[int, int, int]:
+    """Elementwise launch geometry for ``p`` slots: ``(n_chunks,
+    chunk_rows, rows)`` with ``chunk_rows`` a multiple of the sublane
+    granule ``G`` and small caps served by a single sub-``_CHUNK_ROWS``
+    launch instead of a full 32K-element block."""
+    rows = max(1, -(-p // TILE))
+    rows = -(-rows // G) * G
+    if rows <= _CHUNK_ROWS:
+        return 1, rows, rows
+    n_chunks = -(-rows // _CHUNK_ROWS)
+    return n_chunks, _CHUNK_ROWS, n_chunks * _CHUNK_ROWS
+
+
+def _probe2d(x: jnp.ndarray, rows: int) -> jnp.ndarray:
+    """Pad a ``(p,)`` vector to ``rows * TILE`` and reshape to the
+    ``(rows, TILE)`` block layout, carrying u32/bool bit patterns as
+    bitcast i32 (the kernels run pure integer algebra)."""
+    p = x.shape[0]
+    x = lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32)
+    x = jnp.concatenate([x, jnp.zeros(rows * TILE - p, jnp.int32)])
+    return x.reshape(rows, TILE)
+
+
+@lru_cache(maxsize=None)
+def _lex_probe_select_kernel(a_count: int):
+    """Kernel factory closed over the STATIC accessor count: inputs are
+    ``kk, ch, in_range`` then ``a_count`` groups of ``(nb, bval, dval,
+    bprev, dprev)``; outputs ``val, ok, is_base`` (i32).  Int32 masks and
+    0/1 arithmetic select throughout — Mosaic has no i1-vector select,
+    and exactly one accessor matches ``ch`` so masked sums ARE selects."""
+
+    def kernel(*refs):
+        kk = refs[0][...]
+        ch = refs[1][...]
+        inr = refs[2][...]
+        val = kk * 0
+        first = kk * 0
+        isb_sel = kk * 0
+        for a in range(a_count):
+            base = 3 + 5 * a
+            nb = refs[base][...]
+            bval = refs[base + 1][...]
+            dval = refs[base + 2][...]
+            bprev = refs[base + 3][...]
+            dprev = refs[base + 4][...]
+            isb = (kk < nb).astype(jnp.int32)
+            first_a = isb * ((kk == 0) | (bprev != bval)).astype(
+                jnp.int32
+            ) + (1 - isb) * ((kk == nb) | (dprev != dval)).astype(jnp.int32)
+            val_a = isb * bval + (1 - isb) * dval
+            pick = (ch == a).astype(jnp.int32)
+            val += pick * val_a
+            first += pick * first_a
+            isb_sel += pick * isb
+        # SENTINEL (0xFFFFFFFF) bitcast i32 is -1
+        ok = ((inr != 0) & (val != -1) & (first != 0)).astype(jnp.int32)
+        refs[3 + 5 * a_count][...] = val
+        refs[3 + 5 * a_count + 1][...] = ok
+        refs[3 + 5 * a_count + 2][...] = isb_sel
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _lex_probe_validate_kernel(a_count: int):
+    """Kernel factory for the validation half: inputs ``ok, is_base, ch``
+    then ``a_count`` groups of ``(fl, fh, tl, th, dl2, dh2, sent)``;
+    output the final validity mask (i32)."""
+
+    def kernel(*refs):
+        ok = refs[0][...]
+        isb = refs[1][...]
+        ch = refs[2][...]
+        v = ok != 0
+        braw = ch * 0
+        for a in range(a_count):
+            base = 3 + 7 * a
+            fl = refs[base][...]
+            fh = refs[base + 1][...]
+            tl = refs[base + 2][...]
+            th = refs[base + 3][...]
+            dl2 = refs[base + 4][...]
+            dh2 = refs[base + 5][...]
+            sent = refs[base + 6][...]
+            # live copies = raw base range minus tombstoned + delta range
+            blive = (fh - fl) - (th - tl)
+            live = (blive + (dh2 - dl2)) > 0
+            v &= live & (sent == 0)
+            braw += (ch == a).astype(jnp.int32) * ((fh - fl) > 0).astype(
+                jnp.int32
+            )
+        # a delta-enumerated value whose base also has raw copies defers
+        # to the base slot as the unique representative
+        v &= (isb != 0) | (braw == 0)
+        refs[3 + 7 * a_count][...] = v.astype(jnp.int32)
+
+    return kernel
+
+
+def _lex_probe_call(kernel, ops, p: int, n_out: int):
+    """Shared elementwise launcher: pad/bitcast the slot vectors, launch
+    over the :func:`_probe_grid` geometry, slice outputs back to ``p``."""
+    n_chunks, chunk_rows, rows = _probe_grid(p)
+    ops2d = [_probe2d(o, rows) for o in ops]
+    block = pl.BlockSpec((chunk_rows, TILE), lambda i: (i, 0))
+    vma = getattr(_typeof(ops[0]), "vma", None)
+    kwargs = {"vma": vma} if vma else {}
+    out_shape = [
+        jax.ShapeDtypeStruct((rows, TILE), jnp.int32, **kwargs)
+        for _ in range(n_out)
+    ]
+    outs = _pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[block] * len(ops2d),
+        out_specs=[block] * n_out,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(*ops2d)
+    return tuple(o.reshape(-1)[:p] for o in outs)
+
+
+def lex_probe_select(kk, ch, in_range, accessors):
+    """Fused per-slot candidate materialization for one WCOJ level.
+
+    ``kk``/``ch`` int32/int slot vectors (rank within the chosen range,
+    chosen accessor), ``in_range`` bool; ``accessors`` a sequence of
+    ``(nb, bval, dval, bprev, dprev)`` tuples — the XLA-gathered range
+    width and value/predecessor columns of each accessor at every slot.
+    Returns ``(val u32, ok bool, is_base bool)``: the merged candidate
+    value, the in-range ∧ non-sentinel ∧ first-of-run mask, and whether
+    the chosen slot came from the base segment.  Traced inline in the
+    jitted plan body (launch through :func:`_pallas_call`)."""
+    ops = [kk, ch, in_range]
+    for t in accessors:
+        ops.extend(t)
+    val, ok, isb = _lex_probe_call(
+        _lex_probe_select_kernel(len(accessors)), ops, kk.shape[0], 3
+    )
+    val = lax.bitcast_convert_type(val, jnp.uint32)
+    return val, ok != 0, isb != 0
+
+
+def lex_probe_validate(ok, is_base, ch, accessors):
+    """Fused per-slot validation for one WCOJ level: existence-range
+    liveness (tombstone-adjusted), key-sentinel kill and the
+    base-representative tie-break.  ``accessors`` is a sequence of
+    ``(fl, fh, tl, th, dl2, dh2, sent)`` tuples from the XLA existence
+    pre-pass.  Returns the final bool validity mask."""
+    ops = [ok, is_base, ch]
+    for t in accessors:
+        ops.extend(t)
+    (v,) = _lex_probe_call(
+        _lex_probe_validate_kernel(len(accessors)), ops, ok.shape[0], 1
+    )
+    return v != 0
 
 
 # ---------------------------------------------------------------------------

@@ -21,9 +21,17 @@ This module holds the shared primitives:
 
 - :func:`lex_searchsorted` — batched lexicographic binary search over up
   to three sorted u32 columns (device, traced inline by the plan body);
+- :func:`lex_range` — BOTH insertion points of each probe tuple in one
+  fixed-trip loop (half the gathers and a quarter of the loop overhead of
+  four separate ``lex_searchsorted`` calls; bit-identical results);
 - :func:`host_lex_range` — the numpy twin returning ``[lo, hi)`` ranges,
   exact for 3-key probes via a dense-rank packing (u64 cannot hold three
-  u32 keys directly).
+  u32 keys directly);
+- :func:`host_lex_probe` — the numpy row oracle for one WCOJ level's
+  fused probe expansion (range → merge-by-rank → first-of-run dedup →
+  tombstone-aware existence), mirroring the device math slot for slot.
+  The Pallas ``lex_probe_*`` kernels (:mod:`kolibrie_tpu.ops.
+  pallas_kernels`) and the XLA formulation are both fuzzed against it.
 
 The level evaluation itself lives in the device plan interpreter
 (``optimizer/device_engine.py`` ``WcojSpec``) because it threads the
@@ -36,7 +44,12 @@ from typing import Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["lex_searchsorted", "host_lex_range"]
+__all__ = [
+    "lex_searchsorted",
+    "lex_range",
+    "host_lex_range",
+    "host_lex_probe",
+]
 
 # never a real dictionary ID (IDs use bits 0..30 + bit 31 for quoted;
 # dictionary.rs:36-40) — doubles as the device padding fill, so probes for
@@ -88,6 +101,64 @@ def lex_searchsorted(cols, keys, side: str = "left"):
     return lo
 
 
+def lex_range(cols, keys):
+    """Both lexicographic insertion points of each probe tuple: returns
+    ``(lo, hi)`` int32 arrays, bit-identical to
+    ``(lex_searchsorted(cols, keys, "left"),
+    lex_searchsorted(cols, keys, "right"))``.
+
+    The two binary searches share ONE ``fori_loop``: each carries its own
+    ``[lo, hi]`` interval (the searches diverge, so the midpoints differ),
+    but the column gathers per trip drop from four (two calls × left +
+    right of the WCOJ probe pair) to two, and the loop overhead from four
+    ``fori_loop`` launches per segment pair to one.  Like
+    :func:`lex_searchsorted` it is deliberately not jitted — it is traced
+    inline inside the jitted plan body.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = int(cols[0].shape[0])
+    p = keys[0].shape[0]
+    if n == 0:
+        z = jnp.zeros(p, dtype=jnp.int32)
+        return z, z
+
+    def probe(mid):
+        # (lt, eq) of the column tuple at ``mid`` vs the probe tuples
+        lt = jnp.zeros(p, dtype=bool)
+        eq = jnp.ones(p, dtype=bool)
+        for c, k in zip(cols, keys):
+            v = c[mid]
+            lt = lt | (eq & (v < k))
+            eq = eq & (v == k)
+        return lt, eq
+
+    def body(_i, state):
+        llo, lhi, rlo, rhi = state
+        # left-side search: descend right while strictly less
+        lact = llo < lhi
+        lmid = jnp.clip((llo + lhi) >> 1, 0, n - 1)
+        lt, _eq = probe(lmid)
+        llo = jnp.where(lact & lt, lmid + 1, llo)
+        lhi = jnp.where(lact & ~lt, lmid, lhi)
+        # right-side search: descend right while less-or-equal
+        ract = rlo < rhi
+        rmid = jnp.clip((rlo + rhi) >> 1, 0, n - 1)
+        rlt, req = probe(rmid)
+        go = rlt | req
+        rlo = jnp.where(ract & go, rmid + 1, rlo)
+        rhi = jnp.where(ract & ~go, rmid, rhi)
+        return llo, lhi, rlo, rhi
+
+    z = jnp.zeros(p, dtype=jnp.int32)
+    f = jnp.full(p, n, dtype=jnp.int32)
+    lo, _lh, hi, _rh = lax.fori_loop(
+        0, n.bit_length() + 1, body, (z, f, z.copy(), f.copy())
+    )
+    return lo, hi
+
+
 def _pack2(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return (a.astype(np.uint64) << np.uint64(32)) | b.astype(np.uint64)
 
@@ -137,3 +208,119 @@ def host_lex_range(
     lo = np.searchsorted(packed, kp, side="left")
     hi = np.searchsorted(packed, kp, side="right")
     return lo.astype(np.int64), hi.astype(np.int64)
+
+
+def host_lex_probe(accessors, wvalid: np.ndarray, cap: int) -> dict:
+    """Numpy row oracle for ONE WCOJ level's fused probe expansion.
+
+    Mirrors the device math of ``WcojSpec`` evaluation
+    (``optimizer/device_engine.py``) slot for slot — range probe,
+    smallest-accessor choice, capacity expansion, base/delta
+    merge-by-rank, first-of-run dedup, tombstone-aware live-existence
+    probes and the base-representative tie-break — so both the XLA
+    formulation and the Pallas ``lex_probe_*`` kernels can be fuzzed
+    against it.
+
+    ``accessors``: sequence of dicts with keys
+
+    - ``bkeys`` / ``dkeys``: tuple of sorted base / delta key columns
+      (the accessor's bound prefix in perm order; ``()`` when unbound);
+    - ``bval`` / ``dval``: the candidate value column of each segment
+      (sentinel-padded, never empty — as ``device_segment`` guarantees);
+    - ``del_pos``: sorted u32 base-row tombstone positions
+      (sentinel-padded);
+    - ``keys``: tuple of per-probe key arrays, shape ``(pcap,)`` each
+      (``()`` for an unbound accessor).
+
+    ``wvalid``: the level's incoming validity mask, shape ``(pcap,)``.
+    Returns a dict with ``val``, ``valid``, ``row`` (the source slot of
+    each output), ``choice`` and ``total`` (raw candidate count — the
+    convergence protocol's capacity signal).
+    """
+    SENT = np.uint32(0xFFFFFFFF)
+    wvalid = np.asarray(wvalid, dtype=bool)
+    pcap = wvalid.shape[0]
+    probes = []
+    for acc in accessors:
+        keys = tuple(np.asarray(k, dtype=np.uint32) for k in acc["keys"])
+        sent = np.zeros(pcap, dtype=bool)
+        for k in keys:
+            sent |= k == SENT
+        if keys:
+            bl, bh = host_lex_range(acc["bkeys"], keys)
+            dl, dh = host_lex_range(acc["dkeys"], keys)
+        else:
+            bl = np.zeros(pcap, dtype=np.int64)
+            dl = np.zeros(pcap, dtype=np.int64)
+            nb0 = np.searchsorted(
+                np.asarray(acc["bval"], np.uint32), SENT, side="left"
+            )
+            nd0 = np.searchsorted(
+                np.asarray(acc["dval"], np.uint32), SENT, side="left"
+            )
+            bh = np.full(pcap, nb0, dtype=np.int64)
+            dh = np.full(pcap, nd0, dtype=np.int64)
+        probes.append((keys, sent, bl, bh, dl, dh))
+    cntm = np.stack(
+        [
+            np.where(sent, 0, (bh - bl) + (dh - dl))
+            for (_k, sent, bl, bh, dl, dh) in probes
+        ]
+    )
+    choice = np.argmin(cntm, axis=0)
+    cnt = np.where(wvalid, cntm.min(axis=0), 0)
+    total = int(cnt.sum())
+    cum = np.cumsum(cnt)
+    slot = np.arange(cap, dtype=np.int64)
+    row = np.searchsorted(cum, slot, side="right")
+    row_c = np.clip(row, 0, pcap - 1)
+    kk = slot - (cum[row_c] - cnt[row_c])
+    in_range = slot < total
+    vals_l, first_l, isb_l = [], [], []
+    for acc, (keys, sent, bl, bh, dl, dh) in zip(accessors, probes):
+        bv = np.asarray(acc["bval"], dtype=np.uint32)
+        dv = np.asarray(acc["dval"], dtype=np.uint32)
+        nb = bh[row_c] - bl[row_c]
+        isb = kk < nb
+        bidx = np.clip(bl[row_c] + kk, 0, bv.shape[0] - 1)
+        didx = np.clip(dl[row_c] + (kk - nb), 0, dv.shape[0] - 1)
+        bval, dval = bv[bidx], dv[didx]
+        bprev = bv[np.clip(bidx - 1, 0, bv.shape[0] - 1)]
+        dprev = dv[np.clip(didx - 1, 0, dv.shape[0] - 1)]
+        vals_l.append(np.where(isb, bval, dval))
+        first_l.append(
+            np.where(
+                isb,
+                (kk == 0) | (bprev != bval),
+                (kk == nb) | (dprev != dval),
+            )
+        )
+        isb_l.append(isb)
+    ch = choice[row_c]
+    val = np.stack(vals_l)[ch, slot]
+    first = np.stack(first_l)[ch, slot]
+    is_base = np.stack(isb_l)[ch, slot]
+    new_valid = in_range & (val != SENT) & first
+    braw_l = []
+    for acc, (keys, sent, *_r) in zip(accessors, probes):
+        fkeys = tuple(k[row_c] for k in keys) + (val,)
+        bsf = tuple(acc["bkeys"]) + (np.asarray(acc["bval"], np.uint32),)
+        dsf = tuple(acc["dkeys"]) + (np.asarray(acc["dval"], np.uint32),)
+        fl, fh = host_lex_range(bsf, fkeys)
+        dl2, dh2 = host_lex_range(dsf, fkeys)
+        del_pos = np.asarray(acc["del_pos"], dtype=np.uint32)
+        tl = np.searchsorted(del_pos, fl.astype(np.uint32))
+        th = np.searchsorted(del_pos, fh.astype(np.uint32))
+        blive = (fh - fl) - (th - tl)
+        live = (blive + (dh2 - dl2)) > 0
+        new_valid = new_valid & live & ~sent[row_c]
+        braw_l.append((fh - fl) > 0)
+    braw = np.stack(braw_l)[ch, slot]
+    new_valid = new_valid & (is_base | ~braw)
+    return {
+        "val": np.where(new_valid, val, 0).astype(np.uint32),
+        "valid": new_valid,
+        "row": row_c,
+        "choice": ch,
+        "total": total,
+    }

@@ -592,7 +592,7 @@ def _plan_body(
             elif node.rsorted:
                 # same join, pure-XLA formulation (searchsorted + cumsum
                 # expansion) — used off-TPU where interpreted Pallas would
-                # be slow, and overridable via KOLIBRIE_PALLAS_JOIN
+                # be slow, and overridable via KOLIBRIE_PALLAS
                 lkey = _pack_key([lcols[v] for v in node.key_vars], lvalid, _LPAD)
                 rkey = _pack_key([rcols[v] for v in node.key_vars], rvalid, _RPAD)
                 li, ri, valid, total = join_indices_presorted(
@@ -708,7 +708,7 @@ def _plan_body(
             # raw (possibly all-tombstoned) copies — the base slot is the
             # unique representative, made live by the delta via the
             # existence probe.
-            from kolibrie_tpu.ops.wcoj import lex_searchsorted
+            from kolibrie_tpu.ops.wcoj import lex_range
 
             SENT = jnp.uint32(0xFFFFFFFF)
             wcols: Dict = {}
@@ -731,10 +731,11 @@ def _plan_body(
                         kt = tuple(keys)
                         bsort = tuple(bcols[p] for p in a.key_pos)
                         dsort = tuple(dcols[p] for p in a.key_pos)
-                        bl = lex_searchsorted(bsort, kt, "left")
-                        bh = lex_searchsorted(bsort, kt, "right")
-                        dl = lex_searchsorted(dsort, kt, "left")
-                        dh = lex_searchsorted(dsort, kt, "right")
+                        # fused lo+hi search: bit-identical to the former
+                        # left/right lex_searchsorted pairs, half the
+                        # gathers (shared by both the XLA and Pallas paths)
+                        bl, bh = lex_range(bsort, kt)
+                        dl, dh = lex_range(dsort, kt)
                     else:
                         # unbound accessor: the whole live prefix (padding
                         # is all-sentinel and sorts last; the order was
@@ -769,33 +770,57 @@ def _plan_body(
                 row_c = jnp.clip(row, 0, pcap - 1)
                 kk = slot - (cum[row_c] - cnt[row_c])
                 in_range = slot.astype(jnp.int64) < total
-                vals_l, first_l, isb_l = [], [], []
+                ch = choice[row_c]
+                # per-accessor slot operands (XLA gathers — shared by both
+                # formulations below)
+                sel = []
                 for a, (bcols, dcols, _dp), (keys, sent, bl, bh, dl, dh) in zip(
                     lv.accessors, segs, probes
                 ):
                     bv, dv = bcols[a.val_pos], dcols[a.val_pos]
                     nb = bh[row_c] - bl[row_c]
-                    isb = kk < nb
                     bidx = jnp.clip(bl[row_c] + kk, 0, bv.shape[0] - 1)
                     didx = jnp.clip(dl[row_c] + (kk - nb), 0, dv.shape[0] - 1)
                     bval, dval = bv[bidx], dv[didx]
                     bprev = bv[jnp.clip(bidx - 1, 0, bv.shape[0] - 1)]
                     dprev = dv[jnp.clip(didx - 1, 0, dv.shape[0] - 1)]
-                    vals_l.append(jnp.where(isb, bval, dval))
-                    first_l.append(
-                        jnp.where(
-                            isb,
-                            (kk == 0) | (bprev != bval),
-                            (kk == nb) | (dprev != dval),
-                        )
+                    sel.append((nb, bval, dval, bprev, dprev))
+                if use_pallas:
+                    # fused VPU expansion: merge-by-rank select, dedup and
+                    # accessor choice in one VMEM-resident kernel (bit-
+                    # identical to the XLA branch — see ops/pallas_kernels)
+                    from kolibrie_tpu.ops.pallas_kernels import (
+                        lex_probe_select,
+                        lex_probe_validate,
                     )
-                    isb_l.append(isb)
-                ch = choice[row_c]
-                val = jnp.stack(vals_l)[ch, slot]
-                first = jnp.stack(first_l)[ch, slot]
-                is_base = jnp.stack(isb_l)[ch, slot]
-                new_valid = in_range & (val != SENT) & first
-                braw_l = []
+
+                    val, new_valid, is_base = lex_probe_select(
+                        kk.astype(jnp.int32),
+                        ch.astype(jnp.int32),
+                        in_range,
+                        [
+                            (nb.astype(jnp.int32), bval, dval, bprev, dprev)
+                            for nb, bval, dval, bprev, dprev in sel
+                        ],
+                    )
+                else:
+                    vals_l, first_l, isb_l = [], [], []
+                    for nb, bval, dval, bprev, dprev in sel:
+                        isb = kk < nb
+                        vals_l.append(jnp.where(isb, bval, dval))
+                        first_l.append(
+                            jnp.where(
+                                isb,
+                                (kk == 0) | (bprev != bval),
+                                (kk == nb) | (dprev != dval),
+                            )
+                        )
+                        isb_l.append(isb)
+                    val = jnp.stack(vals_l)[ch, slot]
+                    first = jnp.stack(first_l)[ch, slot]
+                    is_base = jnp.stack(isb_l)[ch, slot]
+                    new_valid = in_range & (val != SENT) & first
+                ex = []
                 for a, (bcols, dcols, del_pos), (keys, sent, *_r) in zip(
                     lv.accessors, segs, probes
                 ):
@@ -806,20 +831,40 @@ def _plan_body(
                     dsf = tuple(dcols[p] for p in a.key_pos) + (
                         dcols[a.val_pos],
                     )
-                    fl = lex_searchsorted(bsf, fkeys, "left")
-                    fh = lex_searchsorted(bsf, fkeys, "right")
-                    dl2 = lex_searchsorted(dsf, fkeys, "left")
-                    dh2 = lex_searchsorted(dsf, fkeys, "right")
+                    fl, fh = lex_range(bsf, fkeys)
+                    dl2, dh2 = lex_range(dsf, fkeys)
                     # tombstoned copies inside [fl, fh): del_pos holds
                     # sorted base-row positions (sentinel-padded)
                     tl = jnp.searchsorted(del_pos, fl.astype(jnp.uint32))
                     th = jnp.searchsorted(del_pos, fh.astype(jnp.uint32))
-                    blive = (fh - fl) - (th - tl).astype(jnp.int32)
-                    live = (blive + (dh2 - dl2)) > 0
-                    new_valid = new_valid & live & ~sent[row_c]
-                    braw_l.append((fh - fl) > 0)
-                braw = jnp.stack(braw_l)[ch, slot]
-                new_valid = new_valid & (is_base | ~braw)
+                    ex.append((fl, fh, tl, th, dl2, dh2, sent[row_c]))
+                if use_pallas:
+                    new_valid = lex_probe_validate(
+                        new_valid,
+                        is_base,
+                        ch.astype(jnp.int32),
+                        [
+                            (
+                                fl,
+                                fh,
+                                tl.astype(jnp.int32),
+                                th.astype(jnp.int32),
+                                dl2,
+                                dh2,
+                                sent_r,
+                            )
+                            for fl, fh, tl, th, dl2, dh2, sent_r in ex
+                        ],
+                    )
+                else:
+                    braw_l = []
+                    for fl, fh, tl, th, dl2, dh2, sent_r in ex:
+                        blive = (fh - fl) - (th - tl).astype(jnp.int32)
+                        live = (blive + (dh2 - dl2)) > 0
+                        new_valid = new_valid & live & ~sent_r
+                        braw_l.append((fh - fl) > 0)
+                    braw = jnp.stack(braw_l)[ch, slot]
+                    new_valid = new_valid & (is_base | ~braw)
                 wcols = {
                     v: jnp.where(new_valid, c[row_c], 0)
                     for v, c in wcols.items()
@@ -1920,6 +1965,19 @@ class LoweredPlan:
             return self._node_cap(node, scan_caps, caps)
 
         walk(self.root)
+        # db-cache miss (fresh db, or the cap_key moved because store
+        # growth changed a scan cap bucket): seed from the process-wide
+        # advisor's high-water mark for this template, so steady state
+        # skips the heuristic→double→retry ladder entirely.  The baggage
+        # fingerprint is "unknown" for direct engine construction (tests,
+        # EXPLAIN) — skipped, so unrelated callers never cross-pollinate.
+        from kolibrie_tpu.query.template import cap_advisor
+
+        fp = _get_baggage("template", "unknown")
+        if fp != "unknown":
+            advised = cap_advisor.advise("device", fp)
+            if advised is not None and len(advised) == len(caps):
+                caps = [max(c, a) for c, a in zip(caps, advised)]
         return caps
 
     def build(self, tag: int = 0) -> Tuple[PlanSpec, tuple]:
@@ -2350,20 +2408,20 @@ class LoweredPlan:
 
     def run(self, tag: int = 0):
         """One dispatch (no readback).  Returns (out_cols, valid, counts)."""
-        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+        from kolibrie_tpu.ops.pallas_kernels import pallas_enabled
 
         spec, args = self.build(tag)
         with _enable_x64(True):
-            return _run_plan(spec, pallas_join_enabled(), *args)
+            return _run_plan(spec, pallas_enabled(), *args)
 
     def run_k(self, k: int, tag: int = 0):
         """``k`` plan executions amortized into one dispatch (see
         :func:`_run_plan_k`); returns (checksums, row counts), no readback."""
-        from kolibrie_tpu.ops.pallas_kernels import pallas_join_enabled
+        from kolibrie_tpu.ops.pallas_kernels import pallas_enabled
 
         spec, args = self.build(tag)
         with _enable_x64(True):
-            return _run_plan_k(spec, k, pallas_join_enabled(), *args)
+            return _run_plan_k(spec, k, pallas_enabled(), *args)
 
     def _store_caps(self) -> None:
         """Publish join capacities to the per-db template cache.  Merge is
@@ -2382,7 +2440,17 @@ class LoweredPlan:
         """Validate join counts against the capacities ``out`` ran with;
         re-run with doubled capacities until everything fits (the one
         overflow protocol shared by every consumer).  Returns
-        ``(out_cols, valid)`` — readback of the counts happens here."""
+        ``(out_cols, valid)`` — readback of the counts happens here.
+
+        Every overflow retry and every converged capacity vector is fed to
+        the process-wide :class:`kolibrie_tpu.query.template.CapAdvisor`
+        under the current template fingerprint, so future engines for the
+        same template — on a fresh db, after a ``cap_key`` change from
+        store growth, or post-restart-within-process — start from the
+        high-water mark instead of re-walking the doubling ladder."""
+        from kolibrie_tpu.query.template import cap_advisor
+
+        fp = _get_baggage("template", "unknown")
         for _attempt in range(max_attempts):
             out_cols, valid, counts = out
             counts_h = [int(c) for c in counts]
@@ -2392,7 +2460,18 @@ class LoweredPlan:
             if not overflow:
                 self._store_caps()
                 self._emit_wcoj_obs(counts_h)
+                if fp != "unknown":
+                    cap_advisor.observe(
+                        "device",
+                        fp,
+                        tuple(self._join_caps),
+                        base_version=getattr(
+                            self.db.store, "base_version", None
+                        ),
+                    )
                 return out_cols, valid
+            if fp != "unknown":
+                cap_advisor.observe_retry("device", fp)
             for i in overflow:
                 self._join_caps[i] = _round_cap(2 * counts_h[i])
             self._store_caps()

@@ -46,15 +46,25 @@ def shard_of_dev(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
 
 
 def dist_pallas_enabled() -> bool:
-    """Opt-in (KOLIBRIE_PALLAS_DIST=1): route the distributed rounds'
-    shard-local joins through the Pallas tile kernel.  EXPERIMENTAL —
-    read at TRACE time, so it must be set before the first round program
-    of a process is built (the compiled-program caches do not key on it);
-    default off everywhere until shard_map+Pallas composition is
-    validated on real hardware (see COVERAGE.md "remaining gaps")."""
+    """Route the distributed rounds' shard-local joins through the Pallas
+    tile kernel.  Governed by the unified ``KOLIBRIE_PALLAS`` mode:
+    ``force`` turns it on, ``off``/``auto`` keep it off — this path keeps
+    its historical default-off even under ``auto`` on TPU until
+    shard_map+Pallas composition is validated on real hardware (see
+    COVERAGE.md "remaining gaps").  EXPERIMENTAL — read at TRACE time, so
+    the mode must be set before the first round program of a process is
+    built (the compiled-program caches do not key on it).
+
+    DEPRECATED shim: ``KOLIBRIE_PALLAS_DIST=1``/``0`` still wins when
+    set, for callers of the pre-unification flag."""
     import os
 
-    return os.environ.get("KOLIBRIE_PALLAS_DIST") == "1"
+    legacy = os.environ.get("KOLIBRIE_PALLAS_DIST")
+    if legacy is not None:
+        return legacy == "1"
+    from kolibrie_tpu.ops.pallas_kernels import pallas_mode
+
+    return pallas_mode() == "force"
 
 
 def _dist_check_vma() -> bool:

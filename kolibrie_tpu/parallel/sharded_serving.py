@@ -667,10 +667,21 @@ class ShardedDatabase:
         )
         from kolibrie_tpu.reasoner.device_fixpoint import LoweredPremise
 
+        from kolibrie_tpu.query.template import cap_advisor
+
         with self.lock:
             self.refresh()
             check_deadline("shard.dispatch")
             caps = self._pinned_caps(fp)
+            if caps is None:
+                # base-version bump dropped the pinned caps (mutation
+                # workloads do this constantly) — start from the advisor's
+                # process-wide high-water mark instead of the static
+                # defaults, so steady state re-dispatches without a single
+                # doubled-cap retry
+                advised = cap_advisor.advise("sharded", fp)
+                if advised is not None and len(advised) == 2:
+                    caps = (int(advised[0]), int(advised[1]))
             kw = (
                 {"join_cap": caps[0], "bucket_cap": caps[1]}
                 if caps
@@ -806,6 +817,7 @@ class ShardedDatabase:
                     self.stats_counters["cap_hits"] += 1
                     self.stats_counters["last_cap_hit"] = time.time()
                     _SHARD_CAP_HITS.inc()
+                    cap_advisor.observe_retry("sharded", fp)
                 else:
                     raise RuntimeError(
                         "sharded batch capacities failed to converge"
@@ -823,6 +835,9 @@ class ShardedDatabase:
             _SHARD_DISPATCH_LAT.observe(time.perf_counter() - t0)
             bv = self._sig[0]
             self._caps[(fp, bv)] = (join_cap, bucket_cap)
+            cap_advisor.observe(
+                "sharded", fp, (join_cap, bucket_cap), base_version=bv
+            )
             occ_total = int(self._subj.occupancy().sum())
             n_scans = 1 + len(exemplar.steps)
             _SHARD_ROWS.inc(occ_total * n_scans * b)

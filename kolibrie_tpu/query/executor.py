@@ -997,15 +997,16 @@ def _plan_cache_entry(db, sparql: str):
     ``entry`` carries the parsed ``cq``, ``slot`` has the
     ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode
+    from kolibrie_tpu.ops.pallas_kernels import pallas_mode
     from kolibrie_tpu.query.compile_cache import record_template
     from kolibrie_tpu.query.template import fingerprint_query
 
     parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
-    # the join-strategy and interpreter-routing modes are part of the
-    # template fingerprint; a mode flip after parse must refingerprint
-    # (not replay the old-mode plan)
-    env_sig = (wcoj_mode(), _interp_mode())
+    # the join-strategy, interpreter-routing and Pallas kernel modes are
+    # part of the template fingerprint; a mode flip after parse must
+    # refingerprint (not replay the old-mode plan)
+    env_sig = (wcoj_mode(), _interp_mode(), pallas_mode())
     ent = parse.get(sparql)
     if ent is None or ent["prefix_sig"] != prefix_sig or ent["env_sig"] != env_sig:
         ent = {

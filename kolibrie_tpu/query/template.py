@@ -29,8 +29,11 @@ from __future__ import annotations
 import dataclasses
 import enum
 import hashlib
-from typing import Any, List, Tuple
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
+from kolibrie_tpu.obs import metrics
 from kolibrie_tpu.query.ast import (
     CombinedQuery,
     IriRef,
@@ -41,7 +44,13 @@ from kolibrie_tpu.query.ast import (
     ValuesClause,
 )
 
-__all__ = ["fingerprint_query", "template_key"]
+__all__ = [
+    "fingerprint_query",
+    "template_key",
+    "CapAdvisor",
+    "cap_advisor",
+    "cap_advisor_enabled",
+]
 
 
 def _as_number(text: str) -> bool:
@@ -136,15 +145,20 @@ def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
     fingerprints give each strategy its own slot (and device executable).
     ``KOLIBRIE_PLAN_INTERP`` joins it for the same reason: the interpreter
     routing decision is sticky per cached slot (its source state, its
-    learned caps), so a mode flip must land in a fresh fingerprint."""
+    learned caps), so a mode flip must land in a fresh fingerprint.
+    ``KOLIBRIE_PALLAS`` is the third member: the kernel-vs-XLA routing is
+    a static argument of the compiled plan body, and the cap advisor keys
+    its high-water marks on the fingerprint — a mode flip must replan AND
+    re-learn in a fresh slot, never replay a stale one."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode  # lazy: avoids cycle
     from kolibrie_tpu.optimizer.plan_interp import plan_interp_mode
+    from kolibrie_tpu.ops.pallas_kernels import pallas_mode
 
     params: List[Any] = []
     structure = (
         "interp",
         plan_interp_mode(),
-        ("wcoj", wcoj_mode(), _ser(cq, params)),
+        ("pallas", pallas_mode(), ("wcoj", wcoj_mode(), _ser(cq, params))),
     )
     return structure, tuple(params)
 
@@ -155,3 +169,145 @@ def fingerprint_query(cq: CombinedQuery) -> Tuple[str, Tuple[Any, ...]]:
     structure, params = template_key(cq)
     digest = hashlib.sha1(repr(structure).encode("utf-8")).hexdigest()
     return digest, params
+
+
+# ---------------------------------------------------------------------------
+# capacity advisor
+# ---------------------------------------------------------------------------
+
+_CAP_RETRIES = metrics.counter(
+    "kolibrie_cap_retries_total",
+    "doubled-capacity retried dispatches (overflow → re-run); the cap "
+    "advisor exists to hold this at zero in steady state",
+    labels=("engine",),
+)
+# pre-create both engine series so a zero-retry steady state is visible
+# in /metrics as an explicit 0, not an absent family
+_CAP_RETRIES.labels("device")
+_CAP_RETRIES.labels("sharded")
+
+
+def cap_advisor_enabled() -> bool:
+    """``KOLIBRIE_CAP_ADVISOR=off`` (or ``0``) disables advice — retries
+    fall back to the pre-advisor heuristics.  Observation continues either
+    way, so flipping the flag on after a warm-up period works."""
+    return os.environ.get("KOLIBRIE_CAP_ADVISOR", "").strip().lower() not in (
+        "off",
+        "0",
+        "false",
+    )
+
+
+class CapAdvisor:
+    """Process-wide per-``(engine, template-fingerprint)`` capacity
+    advisor: the feedback loop between the overflow-retry protocols and
+    initial capacity choice.
+
+    The engines' own capacity caches are deliberately narrow — the device
+    engine's ``_device_cap_cache`` lives on one db object and its
+    ``cap_key`` embeds scan-cap buckets that MOVE when store growth
+    crosses a power-of-two key-group boundary, and the sharded server
+    pins caps per ``(fingerprint, base_version)``, dropping them on every
+    mutation.  Each of those invalidations used to restart the
+    double-and-retry ladder from the static defaults.  This advisor keys
+    only on the template fingerprint (which already folds the
+    WCOJ/interp/Pallas routing modes), merges observations as a monotonic
+    elementwise maximum, and survives db churn and base-version bumps —
+    so a warm process re-dispatches at the high-water mark and retries
+    stay at zero.
+
+    ``caps`` tuples are engine-opaque: the device engine stores its
+    per-join capacity vector, the sharded server ``(join_cap,
+    bucket_cap)``.  Entries whose tuple length changes (a replan under a
+    different mode lands on a different fingerprint, so this is
+    defensive) are replaced rather than merged.  Thread-safe; bounded by
+    the upstream plan-template caches (~64 fingerprints per engine).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    def advise(self, engine: str, fp: str) -> Optional[Tuple[int, ...]]:
+        """High-water-mark caps for a template, or ``None`` when cold or
+        disabled (callers keep their heuristic defaults)."""
+        if not cap_advisor_enabled():
+            return None
+        with self._lock:
+            rec = self._entries.get((engine, fp))
+            return None if rec is None else rec["caps"]
+
+    def observe(
+        self,
+        engine: str,
+        fp: str,
+        caps: Tuple[int, ...],
+        base_version: Optional[int] = None,
+    ) -> None:
+        """Record a successfully converged capacity vector (monotonic
+        elementwise max merge)."""
+        caps = tuple(int(c) for c in caps)
+        with self._lock:
+            rec = self._entries.get((engine, fp))
+            if rec is None:
+                rec = {"caps": caps, "retries": 0, "base_version": None}
+                self._entries[(engine, fp)] = rec
+            elif len(rec["caps"]) == len(caps):
+                rec["caps"] = tuple(
+                    max(a, b) for a, b in zip(rec["caps"], caps)
+                )
+            else:
+                rec["caps"] = caps
+            if base_version is not None:
+                rec["base_version"] = int(base_version)
+
+    def observe_retry(self, engine: str, fp: str, n: int = 1) -> None:
+        """Count an overflow-driven doubled-cap re-dispatch (the waste the
+        advisor is eliminating)."""
+        _CAP_RETRIES.labels(engine).inc(n)
+        with self._lock:
+            rec = self._entries.setdefault(
+                (engine, fp),
+                {"caps": (), "retries": 0, "base_version": None},
+            )
+            rec["retries"] += n
+
+    def retries(self, engine: Optional[str] = None) -> int:
+        """Total observed retries (optionally for one engine) — the
+        steady-state-zero signal the chaos suite asserts on."""
+        with self._lock:
+            return sum(
+                rec["retries"]
+                for (eng, _fp), rec in self._entries.items()
+                if engine is None or eng == engine
+            )
+
+    def stats(self) -> dict:
+        """The ``/stats`` block: per-template current caps, high-water
+        mark and retry counts (bounded by the plan-template caches, so
+        per-template detail belongs here, not in /metrics labels)."""
+        with self._lock:
+            return {
+                "enabled": cap_advisor_enabled(),
+                "templates": {
+                    f"{eng}:{fp}": {
+                        "caps": list(rec["caps"]),
+                        "hwm": max(rec["caps"]) if rec["caps"] else 0,
+                        "retries": rec["retries"],
+                        "base_version": rec["base_version"],
+                    }
+                    for (eng, fp), rec in self._entries.items()
+                },
+                "retries_total": sum(
+                    rec["retries"] for rec in self._entries.values()
+                ),
+            }
+
+    def reset(self) -> None:
+        """Drop all learned state (test isolation)."""
+        with self._lock:
+            self._entries.clear()
+
+
+#: the process-wide singleton every engine feeds and consults
+cap_advisor = CapAdvisor()

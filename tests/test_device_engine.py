@@ -391,7 +391,12 @@ def test_device_aggregation_infinite_literal():
 def test_pallas_join_path_agreement(monkeypatch):
     """Forced Pallas merge-join tile kernel (interpret mode off-TPU) must
     agree with the host engine AND with the XLA join formulation on the
-    identical plan — the engine's production join on real TPU hardware."""
+    identical plan — the engine's production join on real TPU hardware.
+
+    Deliberately drives the DEPRECATED ``KOLIBRIE_PALLAS_JOIN`` alias
+    end-to-end (1 → force, 0 → off) so the backward-compat shim keeps
+    working; everything else uses the unified ``KOLIBRIE_PALLAS``."""
+    monkeypatch.delenv("KOLIBRIE_PALLAS", raising=False)
     monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
     db = employee_db(200)
     q = PREFIXES + """
@@ -465,7 +470,7 @@ def test_pallas_join_two_var_key_agreement(monkeypatch):
     host engine and the XLA formulation.  The data makes the triangle
     genuinely match (same-org knows edges) AND contain non-matches
     (cross-org edges) so the agreement is non-vacuous both ways."""
-    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    monkeypatch.setenv("KOLIBRIE_PALLAS", "force")
     db = SparqlDatabase()
     lines = []
     for i in range(150):
@@ -485,7 +490,7 @@ def test_pallas_join_two_var_key_agreement(monkeypatch):
     dev, host = run_both(db, q)
     assert len(dev) == 141  # 150 same-org edges minus 9 org-crossing wraps
     assert sorted(dev) == sorted(host)
-    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "0")
+    monkeypatch.setenv("KOLIBRIE_PALLAS", "off")
     assert sorted(execute_query_volcano(q, db)) == sorted(dev)
 
 
@@ -608,7 +613,7 @@ def test_three_var_join_key_agreement():
 
 
 def test_three_var_join_pallas_agreement(monkeypatch):
-    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    monkeypatch.setenv("KOLIBRIE_PALLAS", "force")
     db = SparqlDatabase()
     lines = []
     for i in range(12):

@@ -304,7 +304,7 @@ def test_fixpoint_pallas_join_route(monkeypatch):
     """Forced Pallas premise joins (dense-rank + tile kernel, interpret
     mode off-TPU) must reach the same closure as the XLA formulation and
     the host reasoner."""
-    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    monkeypatch.setenv("KOLIBRIE_PALLAS", "force")
     from kolibrie_tpu.reasoner.device_fixpoint import DeviceFixpoint
     from kolibrie_tpu.reasoner.reasoner import Reasoner
 
@@ -413,7 +413,7 @@ def test_three_shared_var_premise_join_agreement():
 
 
 def test_three_shared_var_pallas_agreement(monkeypatch):
-    monkeypatch.setenv("KOLIBRIE_PALLAS_JOIN", "1")
+    monkeypatch.setenv("KOLIBRIE_PALLAS", "force")
 
     def build():
         r = Reasoner()

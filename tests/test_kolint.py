@@ -644,6 +644,90 @@ def test_kl701_suppression_with_reason(tmp_path):
     assert res.suppressed[0].rule == "KL701"
 
 
+# --------------------------------------------- KL801: Pallas containment
+
+
+BAD_KL801_CALL = """
+import jax.experimental.pallas as pl
+
+def launch(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+"""
+
+BAD_KL801_SHAPE = """
+import jax.experimental.pallas as pl
+
+ROWS = 12  # not a multiple of 8
+
+def make_spec():
+    return pl.BlockSpec((ROWS, 128), lambda i: (i, 0))
+
+def make_spec_literal():
+    return pl.BlockSpec((4, 128), lambda i: (i, 0))
+"""
+
+GOOD_KL801_SHAPE = """
+import jax.experimental.pallas as pl
+
+G = 8
+TILE = 128
+
+def make_specs(chunk_rows):
+    return [
+        pl.BlockSpec((G, TILE), lambda g: (g, 0)),
+        pl.BlockSpec((256, TILE), lambda i: (i, 0)),
+        pl.BlockSpec((1, 2048, 5), lambda a: (a, 0, 0)),  # sublane 2048
+        pl.BlockSpec((chunk_rows, TILE), lambda i: (i, 0)),  # dynamic
+        pl.BlockSpec((TILE,), lambda i: (i,)),  # 1-D: no sublane dim
+    ]
+"""
+
+
+def test_kl801_call_outside_ops(tmp_path):
+    res = lint(tmp_path, BAD_KL801_CALL)
+    assert rules_fired(res) == ["KL801"]
+    assert "outside kolibrie_tpu/ops/" in res.findings[0].message
+
+
+def test_kl801_call_inside_ops_is_sanctioned(tmp_path):
+    sub = tmp_path / "ops"
+    sub.mkdir()
+    p = sub / "kernels.py"
+    p.write_text(BAD_KL801_CALL)
+    res = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert res.findings == []
+
+
+def test_kl801_bad_sublane_shapes(tmp_path):
+    # fires for a constant-name sublane (ROWS=12) AND a literal (4);
+    # fires regardless of which package the BlockSpec sits in
+    sub = tmp_path / "ops"
+    sub.mkdir()
+    p = sub / "kernels.py"
+    p.write_text(BAD_KL801_SHAPE)
+    res = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert [f.rule for f in res.findings] == ["KL801", "KL801"]
+    assert "sublane dimension 12" in res.findings[0].message
+    assert "sublane dimension 4" in res.findings[1].message
+
+
+def test_kl801_good_shapes(tmp_path):
+    res = lint(tmp_path, GOOD_KL801_SHAPE)
+    assert res.findings == []
+
+
+def test_kl801_suppression_with_reason(tmp_path):
+    src = BAD_KL801_CALL.replace(
+        "    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)",
+        "    # kolint: ignore[KL801] fixture: scratch prototype kernel\n"
+        "    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)",
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].rule == "KL801"
+
+
 # ------------------------------------------------ suppression mechanics
 
 
