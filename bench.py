@@ -367,6 +367,28 @@ def main():
             "budget_pct": 3.0,
             "within_budget": overhead_pct < 3.0,
         }
+        # timeline ring: cost of one registry snapshot (the /debug/timeline
+        # sampler pays this every interval — must stay sub-ms territory)
+        from kolibrie_tpu.obs import timeseries as obs_ts
+
+        ring = obs_ts.TimeSeriesRing(capacity=8)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            ring.record()
+        obs_block["timeline_snapshot_ms"] = round(
+            (time.perf_counter() - t0) / 5 * 1000.0, 3
+        )
+        # EXPLAIN ANALYZE: per-query cost of running under a capture
+        # (stats fetch piggybacks the dispatch; this is the debug-path
+        # price, not a hot-path tax)
+        from kolibrie_tpu.obs import analyze as obs_analyze
+
+        t0 = time.perf_counter()
+        with obs_analyze.capture():
+            execute_query_volcano(TPL_QUERY % 30000, db)
+        obs_block["analyze_query_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 3
+        )
     except Exception as e:  # noqa: BLE001 — bench must survive its probes
         obs_block = {"error": repr(e)}
     note(f"observability sweep done ({obs_block})")

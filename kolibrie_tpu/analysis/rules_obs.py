@@ -123,3 +123,124 @@ def span_without_scope(project: Project) -> List[Finding]:
                     )
                 )
     return out
+
+
+# ------------------------------------------------------------------ KL503
+
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_METRIC_METHODS = {"inc", "dec", "set", "observe", "labels"}
+
+
+def _metric_family_names(f) -> set:
+    """Module-level names bound to obs.metrics family constructors:
+    ``_LAT = metrics.histogram(…)`` / ``_REQS = counter(…)`` (under any
+    import alias of the metrics module or its constructors)."""
+    if f.tree is None:
+        return set()
+    metric_mods = {
+        alias
+        for alias, mod in f.module_aliases.items()
+        if mod.endswith("obs.metrics")
+    } | {
+        alias
+        for alias, (mod, name) in f.imports.items()
+        if name == "metrics" and "obs" in mod
+    }
+    fams = set()
+    for node in f.tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        fn = node.value.func
+        ok = False
+        if isinstance(fn, ast.Name):
+            mod, orig = f.imports.get(fn.id, (None, None))
+            ok = orig in _METRIC_CTORS and "metrics" in (mod or "")
+        elif isinstance(fn, ast.Attribute) and isinstance(
+            fn.value, ast.Name
+        ):
+            ok = fn.attr in _METRIC_CTORS and fn.value.id in metric_mods
+        if ok:
+            fams.update(
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            )
+    return fams
+
+
+def _chain_base_name(expr: ast.AST):
+    """``FAM.labels(x).inc`` → "FAM": peel attribute/call chains down to
+    the root name."""
+    while True:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Name):
+            return expr.id
+        else:
+            return None
+
+
+@rule(
+    "KL503",
+    "obs.metrics / obs.spans call inside jit-reachable code — it fires "
+    "once at TRACE time, then never again for the cached executable",
+)
+def obs_call_in_jit(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    seen_files = {}
+    for info in project.functions.values():
+        if not info.jit_reachable:
+            continue
+        f = info.module
+        if f.tree is None:
+            continue
+        if f.rel not in seen_files:
+            span_aliases = {
+                alias
+                for alias, (mod, name) in f.imports.items()
+                if name == "span" and "spans" in mod
+            }
+            seen_files[f.rel] = (span_aliases, _metric_family_names(f))
+        span_aliases, fams = seen_files[f.rel]
+        for node in iter_own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in span_aliases
+            ):
+                out.append(
+                    Finding(
+                        "KL503",
+                        f.rel,
+                        node.lineno,
+                        f"{node.func.id}(…) opens a span inside "
+                        "jit-reachable code: it times the TRACE, not the "
+                        "dispatch, and vanishes once the executable "
+                        "caches — span outside the jit boundary",
+                        scope=info.qualname,
+                    )
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and _chain_base_name(node.func.value) in fams
+            ):
+                out.append(
+                    Finding(
+                        "KL503",
+                        f.rel,
+                        node.lineno,
+                        f".{node.func.attr}() on metric family "
+                        f"{_chain_base_name(node.func.value)!r} inside "
+                        "jit-reachable code counts traces, not calls — "
+                        "record the value outside the jit boundary (the "
+                        "stats-vector pattern, optimizer/device_engine)",
+                        scope=info.qualname,
+                    )
+                )
+    return out

@@ -805,7 +805,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         path, _, qs = self.path.partition("?")
         known = ("/", "/playground", "/stats", "/metrics", "/healthz",
-                 "/debug/traces")
+                 "/debug/traces", "/debug/timeline")
         if path.startswith("/rsp/events/"):
             self._route_label = "/rsp/events"
         elif path.startswith("/rsp/results/"):
@@ -828,6 +828,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             "/metrics": lambda: self._handle_metrics(),
             "/healthz": lambda: self._handle_healthz(),
             "/debug/traces": lambda: self._handle_debug_traces(qs),
+            "/debug/timeline": lambda: self._handle_debug_timeline(qs),
         }
         if path.startswith("/rsp/results/"):
             sid = path[len("/rsp/results/"):]
@@ -856,6 +857,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         "/rsp/restore": "_handle_rsp_restore",
         "/debug/profile": "_handle_debug_profile",
         "/debug/prewarm": "_handle_debug_prewarm",
+        "/debug/explain": "_handle_debug_explain",
     }
 
     def do_POST(self):
@@ -1035,31 +1037,59 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         """Query a persistent store through the template batcher:
         {"store_id", "sparql"} → {"data", "execution_time_ms"}.  In-flight
         identical queries are answered by one execution; same-template
-        variants within the batching window share one device dispatch."""
+        variants within the batching window share one device dispatch.
+
+        ``?explain=analyze`` is the one-off debug variant: the query runs
+        SOLO under the dispatch lock with an analyze capture active, and
+        the response gains an ``"explain"`` key carrying the raw
+        per-operator records (device / interp / sharded)."""
+        from urllib.parse import parse_qs
+
         req = self._read_json()
         if not req.get("sparql"):
             raise BadRequest("No query provided")
+        explain = (
+            parse_qs(self.path.partition("?")[2]).get("explain") or [""]
+        )[0]
+        if explain not in ("", "analyze"):
+            raise BadRequest(f"unknown explain mode: {explain!r}")
         state = self.state
         with state.lock:
             batcher = state.stores.get(str(req.get("store_id") or ""))
         if batcher is None:
             raise NotFound("store not found")
         start = time.perf_counter()
+        analysis = None
         with state.admission.admitted_scope(), deadline_scope(
             self._request_deadline(req)
         ):
             try:
-                rows = batcher.submit(strip_hash_comments(req["sparql"]))
+                text = strip_hash_comments(req["sparql"])
+                if explain == "analyze":
+                    # the batch leader may be ANOTHER thread, and the
+                    # analyze capture is thread-local — run solo so the
+                    # records land here
+                    from kolibrie_tpu.obs import analyze as obs_analyze
+                    from kolibrie_tpu.query.executor import (
+                        execute_queries_batched,
+                    )
+
+                    with batcher.dispatch_lock, obs_analyze.capture() as c:
+                        rows = execute_queries_batched(batcher.db, [text])[0]
+                    analysis = c.records
+                else:
+                    rows = batcher.submit(text)
             except KolibrieError:
                 raise
             except Exception as e:
                 raise QueryError(f"Query failed: {e}") from e
-        self._send_json(
-            {
-                "data": rows,
-                "execution_time_ms": (time.perf_counter() - start) * 1000.0,
-            }
-        )
+        body = {
+            "data": rows,
+            "execution_time_ms": (time.perf_counter() - start) * 1000.0,
+        }
+        if analysis is not None:
+            body["explain"] = analysis
+        self._send_json(body)
 
     def _handle_stats(self):
         """Serving metrics per store: request/dedup/batch counters, per-
@@ -1113,6 +1143,67 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         body = export_jsonl(trace_id)
         self._send(200, body.encode("utf-8"), "application/x-ndjson")
 
+    def _handle_debug_timeline(self, qs: str):
+        """``GET /debug/timeline``: the metrics time-series ring rendered
+        as per-metric series — counter deltas, gauge samples, histogram
+        count/sum deltas + interpolated quantiles.  ``?metric=`` narrows
+        to one family, ``?n=`` to the trailing N samples."""
+        from urllib.parse import parse_qs
+
+        from kolibrie_tpu.obs import timeseries
+
+        p = parse_qs(qs)
+        metric = (p.get("metric") or [None])[0]
+        try:
+            n = int((p.get("n") or ["0"])[0]) or None
+        except ValueError:
+            raise BadRequest("invalid n")
+        ring = timeseries.default_ring()
+        body = ring.series(metric=metric, n=n)
+        body["interval_s"] = timeseries.DEFAULT_INTERVAL_S
+        body["capacity"] = ring.capacity
+        self._send_json(body)
+
+    def _handle_debug_explain(self):
+        """``POST /debug/explain``: EXPLAIN ANALYZE against a registered
+        store ({"store_id", "sparql"}) or an inline dataset ({"sparql",
+        "rdf"?, "format"?}) — the plan tree with per-operator actuals,
+        occupancy and per-stage device time, as rendered by
+        :meth:`QueryEngine.explain_device(analyze=True)`."""
+        import contextlib
+
+        from kolibrie_tpu.query.engine import QueryEngine
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        req = self._read_json()
+        if not req.get("sparql"):
+            raise BadRequest("No query provided")
+        store_id = str(req.get("store_id") or "")
+        if store_id:
+            with self.state.lock:
+                batcher = self.state.stores.get(store_id)
+            if batcher is None:
+                raise NotFound("store not found")
+            db, lock = batcher.db, batcher.dispatch_lock
+        else:
+            db, lock = SparqlDatabase(), contextlib.nullcontext()
+            try:
+                _load_rdf_into(
+                    db, req.get("rdf") or "", req.get("format", "rdfxml")
+                )
+            except Exception as e:
+                raise BadRequest(f"RDF parse error: {e}") from e
+        with deadline_scope(self._request_deadline(req)), lock:
+            try:
+                plan = QueryEngine(db).explain_device(
+                    strip_hash_comments(req["sparql"]), analyze=True
+                )
+            except KolibrieError:
+                raise
+            except Exception as e:
+                raise QueryError(f"Explain failed: {e}") from e
+        self._send_json({"plan": plan})
+
     def _handle_debug_prewarm(self):
         """``POST /debug/prewarm``: one synchronous warm sweep — the
         manifest's top-N templates compiled (or disk-loaded) against
@@ -1141,7 +1232,9 @@ class KolibrieHandler(BaseHTTPRequestHandler):
     def _handle_debug_profile(self):
         """``POST /debug/profile?seconds=N``: capture a jax.profiler trace
         for N wall seconds.  No-ops (``profiled: false``) on CPU backends
-        so CI never pays for — or breaks on — the profiler."""
+        so CI never pays for — or breaks on — the profiler; set
+        ``KOLIBRIE_PROFILE_FORCE=1`` to capture anyway (the CPU trace is
+        real and viewable, just not what the gate protects against)."""
         from urllib.parse import parse_qs
 
         import jax
@@ -1154,13 +1247,15 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         if not 0 < seconds <= 30:
             raise BadRequest("seconds must be in (0, 30]")
         backend = jax.default_backend()
-        if backend not in ("tpu", "gpu"):
+        forced = os.environ.get("KOLIBRIE_PROFILE_FORCE", "") == "1"
+        if backend not in ("tpu", "gpu") and not forced:
             self._send_json(
                 {
                     "profiled": False,
                     "backend": backend,
                     "reason": "profiler capture is gated to accelerator "
-                    "backends (CPU CI no-op)",
+                    "backends (CPU CI no-op); KOLIBRIE_PROFILE_FORCE=1 "
+                    "overrides",
                 }
             )
             return
@@ -1174,8 +1269,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             time.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
+        n_files = sum(len(fs) for _, _, fs in os.walk(out_dir))
         self._send_json(
-            {"profiled": True, "backend": backend, "trace_dir": out_dir,
+            {"profiled": True, "backend": backend, "forced": forced,
+             "trace_dir": out_dir, "trace_files": n_files,
              "seconds": seconds}
         )
 
@@ -1386,6 +1483,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             session.unsubscribe(q)
 
 
+_TIMELINE_SAMPLER = None  # guarded by: _TIMELINE_LOCK
+_TIMELINE_LOCK = threading.Lock()
+
+
 def make_server(
     host: str = "127.0.0.1",
     port: int = 7878,
@@ -1419,6 +1520,16 @@ def make_server(
         is_ready=lambda: state.status == "ready",
     )
     state.prewarmer.start()
+    # /debug/timeline's data source: sample the metrics registry into the
+    # default ring for the life of the process (daemon thread, started
+    # once — test suites build many servers and must not stack samplers)
+    from kolibrie_tpu.obs import timeseries
+
+    global _TIMELINE_SAMPLER
+    with _TIMELINE_LOCK:
+        if _TIMELINE_SAMPLER is None:
+            _TIMELINE_SAMPLER = timeseries.Sampler(timeseries.default_ring())
+            _TIMELINE_SAMPLER.start()
     if state.durability is not None:
         if recover_async:
             threading.Thread(

@@ -249,6 +249,32 @@ class Registry:
         with self._lock:
             return [self._families[k] for k in sorted(self._families)]
 
+    def snapshot(self) -> Dict[str, dict]:
+        """Point-in-time value dump for the timeline ring.
+
+        Returns ``{name: {"kind", "label_names", "children": {labels:
+        value}}}`` where a counter/gauge value is a float and a
+        histogram value is ``{"count", "sum", "cumulative"}`` (the
+        ``cumulative()`` (le, count) pairs).  Collectors are NOT run
+        here — the ring samples raw state; scrape-time refresh belongs
+        to the exporter.
+        """
+        out: Dict[str, dict] = {}
+        for fam in self.families():
+            kids: Dict[Tuple[str, ...], object] = {}
+            for key, child in fam.children():
+                if fam.kind == "histogram":
+                    with child._lock:
+                        cnt, tot = child.count, child.sum
+                    kids[key] = {"count": cnt, "sum": tot,
+                                 "cumulative": child.cumulative()}
+                else:
+                    kids[key] = child.value
+            out[fam.name] = {"kind": fam.kind,
+                             "label_names": fam.label_names,
+                             "children": kids}
+        return out
+
     def get(self, name: str) -> Optional[Family]:
         with self._lock:
             return self._families.get(name)

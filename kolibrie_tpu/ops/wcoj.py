@@ -301,6 +301,7 @@ def host_lex_probe(accessors, wvalid: np.ndarray, cap: int) -> dict:
     first = np.stack(first_l)[ch, slot]
     is_base = np.stack(isb_l)[ch, slot]
     new_valid = in_range & (val != SENT) & first
+    n_dedup = int(new_valid.sum())  # pre-liveness: the :dedup stats stage
     braw_l = []
     for acc, (keys, sent, *_r) in zip(accessors, probes):
         fkeys = tuple(k[row_c] for k in keys) + (val,)
@@ -323,4 +324,6 @@ def host_lex_probe(accessors, wvalid: np.ndarray, cap: int) -> dict:
         "row": row_c,
         "choice": ch,
         "total": total,
+        "dedup": n_dedup,
+        "live": int(new_valid.sum()),
     }

@@ -80,6 +80,7 @@ __all__ = [
 
 import time as _time
 
+from kolibrie_tpu.obs import analyze as _analyze
 from kolibrie_tpu.obs import metrics as _obs_metrics
 from kolibrie_tpu.obs.spans import get_baggage as _get_baggage
 from kolibrie_tpu.obs.spans import span as _obs_span
@@ -368,6 +369,21 @@ class PlanSpec:
 # ---------------------------------------------------------------------------
 
 
+# Device→host readback audit: every place the engine forces a transfer
+# calls _note_fetch, so the analyze regression test can pin the exact
+# per-execute fetch count and assert instrumentation adds none on the
+# hot path (and exactly one under an active analyze capture).
+_FETCHES: Dict[str, int] = {}
+
+
+def _note_fetch(site: str) -> None:
+    _FETCHES[site] = _FETCHES.get(site, 0) + 1
+
+
+def fetch_counters() -> Dict[str, int]:
+    return dict(_FETCHES)
+
+
 def _pack_key(cols: List, valid, pad_sentinel):
     import jax.numpy as jnp
 
@@ -399,6 +415,16 @@ def _plan_body(
 
     uparams, fparams = params
     counts: List = []
+    # EXPLAIN ANALYZE operator stats: key -> device scalar, computed from
+    # sums the operators already materialize, so the vector rides the
+    # result transfer for free.  Keys are stable across the device walk,
+    # the numpy twin in host_execute, and the describe() renderer:
+    # indexed nodes use their plan index (scan3, join0, optional1,
+    # values0, wcoj2:cand/:dedup/:live); index-less nodes (filter, anti,
+    # union, quoted) use a PRE-ORDER occurrence counter assigned at node
+    # entry, before children are walked — all three walks must agree.
+    stats: Dict = {}
+    seq = {"filter": 0, "anti": 0, "union": 0, "quoted": 0}
 
     def eval_expr(expr, cols, valid):
         if isinstance(expr, MaskRef):
@@ -542,10 +568,14 @@ def _plan_body(
             for a, b in node.eq_pairs:
                 valid = valid & (raw[a] == raw[b])
             cols = {var: raw[pos] for var, pos in node.out_vars}
-            return cols, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[f"scan{node.scan_idx}"] = n
+            return cols, valid, n
         if isinstance(node, QuotedExpandSpec):
             from kolibrie_tpu.core.dictionary import QUOTED_BIT
 
+            skey = f"quoted{seq['quoted']}"
+            seq["quoted"] += 1
             cols, valid, _ = eval_node(node.child)
             qid_sorted, qs, qp, qo = quoted
             qcol = cols.pop(node.qvar)
@@ -563,10 +593,13 @@ def _plan_body(
                 cols[var] = inner[ipos]
             for ipos, var in node.eq_checks:
                 valid = valid & (inner[ipos] == cols[var])
-            return cols, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[skey] = n
+            return cols, valid, n
         if isinstance(node, ValuesSpec):
             cols = {v: values[node.values_idx][i] for i, v in enumerate(node.vars)}
             valid = jnp.ones(node.n, dtype=bool)
+            stats[f"values{node.values_idx}"] = jnp.int32(node.n)
             return cols, valid, jnp.int32(node.n)
         if isinstance(node, JoinSpec):
             from kolibrie_tpu.ops.device_join import join_indices_presorted
@@ -622,6 +655,7 @@ def _plan_body(
                 else:
                     li, ri, valid, total = join_indices(lkey, rkey, node.cap)
             counts.append(total)
+            stats[f"join{node.join_idx}"] = jnp.sum(valid)
             out = {}
             for v, c in lcols.items():
                 out[v] = jnp.where(valid, c[li], 0)
@@ -630,11 +664,17 @@ def _plan_body(
                     out[v] = jnp.where(valid, c[ri], 0)
             return out, valid, total
         if isinstance(node, FilterSpec):
+            skey = f"filter{seq['filter']}"
+            seq["filter"] += 1
             cols, valid, _ = eval_node(node.child)
             mask = eval_expr(node.expr, cols, valid)
             valid = valid & mask
-            return cols, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[skey] = n
+            return cols, valid, n
         if isinstance(node, AntiJoinSpec):
+            skey = f"anti{seq['anti']}"
+            seq["anti"] += 1
             lcols, lvalid, _ = eval_node(node.left)
             rcols, rvalid, _ = eval_node(node.right)
             lc = [lcols[v] for v in node.key_vars]
@@ -649,8 +689,12 @@ def _plan_body(
             rs = jnp.sort(rkey)
             pos = jnp.clip(jnp.searchsorted(rs, lkey), 0, rs.shape[0] - 1)
             valid = lvalid & (rs[pos] != lkey)
-            return lcols, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[skey] = n
+            return lcols, valid, n
         if isinstance(node, UnionSpec):
+            skey = f"union{seq['union']}"
+            seq["union"] += 1
             parts = [eval_node(ch) for ch in node.children]
             cols = {}
             for v in node.vars:
@@ -664,7 +708,9 @@ def _plan_body(
                         )
                 cols[v] = jnp.concatenate(segs)
             valid = jnp.concatenate([p[1] for p in parts])
-            return cols, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[skey] = n
+            return cols, valid, n
         if isinstance(node, LeftOuterSpec):
             lcols, lvalid, _ = eval_node(node.left)
             rcols, rvalid, _ = eval_node(node.right)
@@ -694,7 +740,9 @@ def _plan_body(
                         ]
                     )
             valid = jnp.concatenate([mvalid, keep])
-            return out, valid, jnp.sum(valid)
+            n = jnp.sum(valid)
+            stats[f"optional{node.join_idx}"] = n
+            return out, valid, n
         if isinstance(node, WcojSpec):
             # Variable-at-a-time leapfrog over the two-tier sorted orders.
             # Counts are RAW range sizes (tombstoned/duplicate rows
@@ -761,6 +809,7 @@ def _plan_body(
                 cnt = jnp.where(wvalid, jnp.min(cntm, axis=0), 0)
                 total = jnp.sum(cnt.astype(jnp.int64))
                 counts.append(total)
+                stats[f"wcoj{lv.join_idx}:cand"] = total
                 cap = lv.cap
                 cum = jnp.cumsum(cnt)
                 slot = jnp.arange(cap, dtype=jnp.int32)
@@ -820,6 +869,11 @@ def _plan_body(
                     first = jnp.stack(first_l)[ch, slot]
                     is_base = jnp.stack(isb_l)[ch, slot]
                     new_valid = in_range & (val != SENT) & first
+                # dedup count: distinct candidate values BEFORE the
+                # liveness/base-representative probes (both formulations
+                # agree at this point — lex_probe_select's new_valid is
+                # the same pre-liveness predicate)
+                stats[f"wcoj{lv.join_idx}:dedup"] = jnp.sum(new_valid)
                 ex = []
                 for a, (bcols, dcols, del_pos), (keys, sent, *_r) in zip(
                     lv.accessors, segs, probes
@@ -865,6 +919,7 @@ def _plan_body(
                         braw_l.append((fh - fl) > 0)
                     braw = jnp.stack(braw_l)[ch, slot]
                     new_valid = new_valid & (is_base | ~braw)
+                stats[f"wcoj{lv.join_idx}:live"] = jnp.sum(new_valid)
                 wcols = {
                     v: jnp.where(new_valid, c[row_c], 0)
                     for v, c in wcols.items()
@@ -876,7 +931,7 @@ def _plan_body(
 
     cols, valid, _ = eval_node(spec.root)
     out = tuple(cols[v] for v in spec.out_vars)
-    return out, valid, tuple(counts)
+    return out, valid, tuple(counts), stats
 
 
 @partial(jax.jit, static_argnames=("spec", "use_pallas"))
@@ -993,7 +1048,7 @@ def _run_plan_k(
         # carry >= 0 always, so the shift is 0 at runtime — but XLA cannot
         # hoist the iteration body because scalars depends on the carry
         sc = scalars + (carry >> jnp.int64(62)).astype(scalars.dtype)
-        out, valid, _counts = _plan_body(
+        out, valid, _counts, _stats = _plan_body(
             spec, order_arrays, sc, masks, values, numf, quoted, params, use_pallas
         )
         checksum = sum(c.astype(jnp.uint64).sum() for c in out)
@@ -2064,11 +2119,17 @@ class LoweredPlan:
         from kolibrie_tpu.ops.join import join_indices as host_join_indices
 
         if not self.const_ok():
+            self.last_host_stats = {}
             return self.empty_table(), [0] * self.join_count
         self._refresh_masks()
         scan_ranges = self._host_scan_ranges()
         numf = self.db.numeric_values() if self.need_numf else None
         counts: List[int] = [0] * self.join_count
+        # numpy twin of _plan_body's analyze stats: same keys, same
+        # pre-order sequence numbering for index-less nodes — the
+        # EXPLAIN ANALYZE oracle tests assert exact agreement
+        hstats: Dict[str, int] = {}
+        hseq = {"filter": 0, "anti": 0, "union": 0, "quoted": 0}
 
         def eval_expr(expr, cols) -> np.ndarray:
             if isinstance(expr, MaskRef):
@@ -2150,8 +2211,12 @@ class LoweredPlan:
                 cols = {var: raw[pos] for var, pos in node.out_vars}
                 if mask is not None:
                     cols = {k: v[mask] for k, v in cols.items()}
+                hstats[f"scan{node.scan_idx}"] = (
+                    int(mask.sum()) if mask is not None else n
+                )
                 return cols
             if isinstance(node, ValuesSpec):
+                hstats[f"values{node.values_idx}"] = node.n
                 return {
                     v: self.values_tables[node.values_idx][i]
                     for i, v in enumerate(node.vars)
@@ -2169,18 +2234,24 @@ class LoweredPlan:
                 )
                 li, ri = host_join_indices(lkey, rkey)
                 counts[node.join_idx] = len(li)
+                hstats[f"join{node.join_idx}"] = len(li)
                 out = {v: c[li] for v, c in lcols.items()}
                 for v, c in rcols.items():
                     if v not in out:
                         out[v] = c[ri]
                 return out
             if isinstance(node, FilterSpec):
+                skey = f"filter{hseq['filter']}"
+                hseq["filter"] += 1
                 cols = eval_node(node.child)
                 mask = eval_expr(node.expr, cols)
+                hstats[skey] = int(mask.sum())
                 return {k: v[mask] for k, v in cols.items()}
             if isinstance(node, QuotedExpandSpec):
                 from kolibrie_tpu.core.dictionary import QUOTED_BIT
 
+                skey = f"quoted{hseq['quoted']}"
+                hseq["quoted"] += 1
                 cols = eval_node(node.child)
                 qcol = cols.pop(node.qvar)
                 qid, qs_, qp_, qo_ = host_quoted_table(self.db)
@@ -2194,14 +2265,21 @@ class LoweredPlan:
                     cols[var] = inner[ipos]
                 for ipos, var in node.eq_checks:
                     mask = mask & (inner[ipos] == cols[var])
+                hstats[skey] = int(mask.sum())
                 return {k: v[mask] for k, v in cols.items()}
             if isinstance(node, AntiJoinSpec):
                 from kolibrie_tpu.ops.join import anti_join_tables
 
+                skey = f"anti{hseq['anti']}"
+                hseq["anti"] += 1
                 lcols = eval_node(node.left)
                 rcols = eval_node(node.right)
-                return anti_join_tables(lcols, rcols)
+                out = anti_join_tables(lcols, rcols)
+                hstats[skey] = len(next(iter(out.values()), ()))
+                return out
             if isinstance(node, UnionSpec):
+                skey = f"union{hseq['union']}"
+                hseq["union"] += 1
                 parts = [eval_node(ch) for ch in node.children]
                 out = {}
                 for v in node.vars:
@@ -2213,6 +2291,7 @@ class LoweredPlan:
                             n = len(next(iter(ccols.values()), np.empty(0)))
                             segs.append(np.zeros(n, dtype=np.uint32))
                     out[v] = np.concatenate(segs) if segs else np.empty(0, np.uint32)
+                hstats[skey] = len(next(iter(out.values()), ()))
                 return out
             if isinstance(node, LeftOuterSpec):
                 from kolibrie_tpu.ops.join import _pack_shared_keys
@@ -2223,6 +2302,7 @@ class LoweredPlan:
                 rn = len(next(iter(rcols.values())))
                 if ln == 0 or rn == 0:
                     counts[node.join_idx] = 0
+                    hstats[f"optional{node.join_idx}"] = ln
                     out = {k: v.copy() for k, v in lcols.items()}
                     for k in rcols:
                         if k not in out:
@@ -2236,6 +2316,7 @@ class LoweredPlan:
                 matched = np.zeros(ln, dtype=bool)
                 matched[li] = True
                 unmatched = np.nonzero(~matched)[0]
+                hstats[f"optional{node.join_idx}"] = len(li) + len(unmatched)
                 out = {}
                 for k, col in lcols.items():
                     out[k] = np.concatenate([col[li], col[unmatched]])
@@ -2327,6 +2408,7 @@ class LoweredPlan:
                 cnt = np.min(cntm, axis=0)
                 total = int(cnt.sum())
                 counts[lv.join_idx] = total
+                hstats[f"wcoj{lv.join_idx}:cand"] = total
                 rows = np.repeat(np.arange(nrows), cnt)
                 kk = np.arange(total, dtype=np.int64) - np.repeat(
                     np.cumsum(cnt) - cnt, cnt
@@ -2364,6 +2446,10 @@ class LoweredPlan:
                     )
                     is_base[m] = isb
                 vvalid = first
+                # device dedup = in_range & (val != SENT) & first; host
+                # rows are exact-length (no padding in range) so val is
+                # never the sentinel and first alone is the same count
+                hstats[f"wcoj{lv.join_idx}:dedup"] = int(first.sum())
                 braw_ch = np.zeros(total, dtype=bool)
                 for ai, (a, bcanon, dcanon, dp, keys, sent, *_r) in enumerate(per):
                     fkeys = [k[rows] for k in keys] + [val]
@@ -2386,9 +2472,11 @@ class LoweredPlan:
                 cols = {v: c[rows][vvalid] for v, c in cols.items()}
                 cols[lv.var] = val[vvalid]
                 nrows = int(vvalid.sum())
+                hstats[f"wcoj{lv.join_idx}:live"] = nrows
             return cols
 
         table = eval_node(self.root)
+        self.last_host_stats = hstats
         return table, counts
 
     def calibrate_host(self) -> List[int]:
@@ -2407,7 +2495,8 @@ class LoweredPlan:
     # ------------------------------------------------------------ execution
 
     def run(self, tag: int = 0):
-        """One dispatch (no readback).  Returns (out_cols, valid, counts)."""
+        """One dispatch (no readback).  Returns (out_cols, valid, counts,
+        stats) — all device-resident."""
         from kolibrie_tpu.ops.pallas_kernels import pallas_enabled
 
         spec, args = self.build(tag)
@@ -2452,12 +2541,15 @@ class LoweredPlan:
 
         fp = _get_baggage("template", "unknown")
         for _attempt in range(max_attempts):
-            out_cols, valid, counts = out
+            out_cols, valid, counts, stats = out
+            self._last_stats = stats  # device-resident; fetched only on analyze
             counts_h = [int(c) for c in counts]
+            _note_fetch("converge.counts")
             overflow = [
                 i for i, c in enumerate(counts_h) if c > self._join_caps[i]
             ]
             if not overflow:
+                self._last_counts = counts_h
                 self._store_caps()
                 self._emit_wcoj_obs(counts_h)
                 if fp != "unknown":
@@ -2505,23 +2597,54 @@ class LoweredPlan:
         walk(self.root)
 
     def to_table(self, out_cols, valid) -> BindingTable:
+        _note_fetch("to_table")
         valid_h = np.asarray(valid)
         return {
             var: np.asarray(col)[valid_h].astype(np.uint32)
             for var, col in zip(self.out_vars, out_cols)
         }
 
-    def describe(self, counts: Optional[List[int]] = None) -> str:
+    def fetch_stats(self) -> Dict[str, int]:
+        """Host-read the per-operator stats of the last converged run.
+        ONE extra device→host sync, paid only by EXPLAIN ANALYZE — the
+        hot path never calls this."""
+        stats = getattr(self, "_last_stats", None)
+        if not stats:
+            return {}
+        _note_fetch("analyze.stats")
+        fetched = jax.device_get(stats)
+        return {k: int(v) for k, v in fetched.items()}
+
+    def describe(self, counts: Optional[List[int]] = None,
+                 analyze: Optional[Dict] = None) -> str:
         """Readable physical-plan tree for EXPLAIN surfaces: scans with
         their sorted order + bound constants + live range size, joins with
         key variables, capacities and (when provided) exact match counts,
         filters, and quoted expansions.  ``counts`` is the per-join exact
-        count list from :meth:`host_execute`/calibration."""
+        count list from :meth:`host_execute`/calibration.
+
+        ``analyze`` is a capture record from an actual dispatch (see
+        :mod:`kolibrie_tpu.obs.analyze`): its ``operators`` map annotates
+        every node with ``actual=`` rows (estimated-vs-actual side by
+        side) and joins/WCOJ levels with cap ``occ=`` percentages."""
         scan_ranges = self._host_scan_ranges()
         lines: List[str] = []
+        ops = (analyze or {}).get("operators", {}) or {}
+        acounts = (analyze or {}).get("counts", []) or []
+        dseq = {"filter": 0, "anti": 0, "union": 0, "quoted": 0}
 
         def term(c):
             return "?" if c is None else str(c)
+
+        def actual(key):
+            return f" actual={ops[key]}" if key in ops else ""
+
+        def occ(join_idx, cap):
+            from kolibrie_tpu.query.template import occupancy_pct
+
+            if join_idx < len(acounts) and isinstance(cap, int) and cap > 0:
+                return f" occ={occupancy_pct(acounts[join_idx], cap):.1f}%"
+            return ""
 
         def walk(node, depth):
             pad = "  " * depth
@@ -2532,7 +2655,7 @@ class LoweredPlan:
                 lines.append(
                     f"{pad}scan[{order_name}] ({term(consts[0])} "
                     f"{term(consts[1])} {term(consts[2])}) rows={n}"
-                    f" binds {vars_}"
+                    f"{actual(f'scan{node.scan_idx}')} binds {vars_}"
                 )
             elif isinstance(node, JoinSpec):
                 cnt = (
@@ -2545,14 +2668,17 @@ class LoweredPlan:
                 kind = "merge(rsorted)" if node.rsorted else "sort"
                 lines.append(
                     f"{pad}{kind}-join on ({', '.join(node.key_vars)})"
-                    f" cap={cap}{cnt}"
+                    f" cap={cap}{cnt}{actual(f'join{node.join_idx}')}"
+                    f"{occ(node.join_idx, cap)}"
                 )
                 walk(node.left, depth + 1)
                 walk(node.right, depth + 1)
             elif isinstance(node, AntiJoinSpec):
+                key = f"anti{dseq['anti']}"
+                dseq["anti"] += 1
                 lines.append(
                     f"{pad}anti-join (MINUS/NOT) on"
-                    f" ({', '.join(node.key_vars)})"
+                    f" ({', '.join(node.key_vars)}){actual(key)}"
                 )
                 walk(node.left, depth + 1)
                 walk(node.right, depth + 1)
@@ -2565,22 +2691,30 @@ class LoweredPlan:
                 lines.append(
                     f"{pad}left-outer-join (OPTIONAL) on"
                     f" ({', '.join(node.key_vars)}){cnt}"
+                    f"{actual(f'optional{node.join_idx}')}"
                 )
                 walk(node.left, depth + 1)
                 walk(node.right, depth + 1)
             elif isinstance(node, UnionSpec):
+                key = f"union{dseq['union']}"
+                dseq["union"] += 1
                 lines.append(
-                    f"{pad}union -> ({', '.join(node.vars)})"
+                    f"{pad}union -> ({', '.join(node.vars)}){actual(key)}"
                 )
                 for ch in node.children:
                     walk(ch, depth + 1)
             elif isinstance(node, FilterSpec):
-                lines.append(f"{pad}filter {node.expr}")
+                key = f"filter{dseq['filter']}"
+                dseq["filter"] += 1
+                lines.append(f"{pad}filter {node.expr}{actual(key)}")
                 walk(node.child, depth + 1)
             elif isinstance(node, QuotedExpandSpec):
+                key = f"quoted{dseq['quoted']}"
+                dseq["quoted"] += 1
                 vars_ = " ".join(f"?{v}@{p}" for v, p in node.out_vars)
                 lines.append(
-                    f"{pad}quoted-expand {node.qvar} -> {vars_ or '(checks only)'}"
+                    f"{pad}quoted-expand {node.qvar} -> "
+                    f"{vars_ or '(checks only)'}{actual(key)}"
                 )
                 walk(node.child, depth + 1)
             elif isinstance(node, WcojSpec):
@@ -2602,8 +2736,17 @@ class LoweredPlan:
                         f"/k{len(a.key_srcs)}"
                         for a in lv.accessors
                     )
+                    act = ""
+                    ck = f"wcoj{lv.join_idx}:cand"
+                    if ck in ops:
+                        act = (
+                            f" cand={ops[ck]}"
+                            f" dedup={ops.get(f'wcoj{lv.join_idx}:dedup', '?')}"
+                            f" live={ops.get(f'wcoj{lv.join_idx}:live', '?')}"
+                        )
                     lines.append(
-                        f"{pad}  level ?{lv.var} cap={cap}{cnt} [{accs}]"
+                        f"{pad}  level ?{lv.var} cap={cap}{cnt}{act}"
+                        f"{occ(lv.join_idx, cap)} [{accs}]"
                     )
             elif isinstance(node, ValuesSpec):
                 lines.append(f"{pad}values({', '.join(node.vars)}) rows={node.n}")
@@ -2680,6 +2823,16 @@ class LoweredPlan:
         with _obs_span("device.collect"):
             table = self.to_table(*parts)
         _COLLECT_LAT.observe(_time.perf_counter() - t1)
+        cap = _analyze.active()
+        if cap is not None:
+            cap.record(
+                "device",
+                source=self.last_source,
+                operators=self.fetch_stats(),
+                counts=list(getattr(self, "_last_counts", [])),
+                caps=list(self._join_caps),
+                rows=len(next(iter(table.values()))) if table else 0,
+            )
         check_deadline("device.execute.done")
         return table
 
@@ -2869,7 +3022,7 @@ def _execute_plan_batch(
                 jnp.asarray(np.stack(ups)),
                 jnp.asarray(np.stack(fps), dtype=jnp.float64),
             )
-            out_cols, valid, counts = _run_plan_batch(
+            out_cols, valid, counts, bstats = _run_plan_batch(
                 spec0,
                 order_arrays,
                 jnp.asarray(np.stack(scal)),
@@ -2890,6 +3043,18 @@ def _execute_plan_batch(
         lp0._store_caps()
     else:
         raise RuntimeError("batched plan capacities failed to converge")
+    cap = _analyze.active()
+    if cap is not None:
+        # batched stats leaves are [batch, ...] — one fetch, sliced per member
+        bstats_h = {k: np.asarray(v) for k, v in jax.device_get(bstats).items()}
+        _note_fetch("analyze.batch_stats")
+        for b, i in enumerate(live):
+            cap.record(
+                "device_batch",
+                member=i,
+                operators={k: int(v[b]) for k, v in bstats_h.items()},
+                caps=list(lowereds[live[0]]._join_caps),
+            )
     cols_h = [np.asarray(c) for c in out_cols]
     valid_h = np.asarray(valid)
     for b, i in enumerate(live):

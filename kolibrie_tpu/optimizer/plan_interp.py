@@ -67,6 +67,7 @@ import jax
 from jax import lax
 import jax.numpy as jnp
 
+from kolibrie_tpu.obs import analyze as _analyze
 from kolibrie_tpu.obs import metrics as _metrics
 from kolibrie_tpu.obs.spans import span as _obs_span
 
@@ -158,9 +159,12 @@ class InterpProgram:
         "var_slots",
         "out_reg",
         "join_count",
+        "n_real",
+        "stat_keys",
     )
 
-    def __init__(self, code, n_ops, cap, n_slots, var_slots, out_reg, join_count):
+    def __init__(self, code, n_ops, cap, n_slots, var_slots, out_reg,
+                 join_count, n_real=0, stat_keys=()):
         self.code = code  # np.int32 [n_ops, _W]
         self.n_ops = n_ops  # size-class bucket (rows incl. NOP padding)
         self.cap = cap
@@ -168,6 +172,10 @@ class InterpProgram:
         self.var_slots = var_slots  # var name -> slot index
         self.out_reg = out_reg
         self.join_count = join_count
+        self.n_real = n_real  # real rows before NOP padding
+        # per-row EXPLAIN ANALYZE key (shared with _plan_body's stats
+        # scheme); None for intermediate AND-chain filter rows
+        self.stat_keys = stat_keys
 
 
 def compile_bytecode(lowered) -> InterpProgram:
@@ -193,10 +201,13 @@ def compile_bytecode(lowered) -> InterpProgram:
         raise InterpUnsupported(f"{len(slots)} variables > {_MAX_SLOTS}")
     rows: List[List[int]] = []
     bound: List[set] = []  # vars bound by each register
+    stat_keys: List[Optional[str]] = []  # analyze key per row (None = sub-step)
+    fseq = [0]  # pre-order FilterSpec counter (matches _plan_body's seq)
 
-    def emit(row, vars_) -> int:
+    def emit(row, vars_, key=None) -> int:
         rows.append(row + [0] * (_W - len(row)))
         bound.append(vars_)
+        stat_keys.append(key)
         return len(rows) - 1
 
     def flatten_and(expr, out):
@@ -219,7 +230,9 @@ def compile_bytecode(lowered) -> InterpProgram:
                 vars_.add(var)
             k0, k1 = node.key_pos
             return emit(
-                [SCAN, node.order_idx, node.scan_idx, k0, k1] + tgt, vars_
+                [SCAN, node.order_idx, node.scan_idx, k0, k1] + tgt,
+                vars_,
+                key=f"scan{node.scan_idx}",
             )
         if isinstance(node, JoinSpec):
             if len(node.key_vars) > 2:
@@ -239,8 +252,14 @@ def compile_bytecode(lowered) -> InterpProgram:
             return emit(
                 [JOIN, lr, rr, len(ks), k0, k1, node.join_idx, from_right, bmask],
                 lv | rv,
+                key=f"join{node.join_idx}",
             )
         if isinstance(node, FilterSpec):
+            # pre-order key, assigned BEFORE the child walk (same scheme
+            # as the specialized path); it lands on the LAST row of the
+            # AND-chain — the row whose validity is the node's output
+            fkey = f"filter{fseq[0]}"
+            fseq[0] += 1
             src = walk(node.child)
             exprs: List[object] = []
             flatten_and(node.expr, exprs)
@@ -280,6 +299,7 @@ def compile_bytecode(lowered) -> InterpProgram:
                     )
                 else:
                     raise InterpUnsupported(type(e).__name__)
+            stat_keys[src] = fkey
             return src
         raise InterpUnsupported(type(node).__name__)
 
@@ -299,7 +319,8 @@ def compile_bytecode(lowered) -> InterpProgram:
     for i, row in enumerate(rows):
         code[i] = row
     return InterpProgram(
-        code, n_ops, cap, n_slots, slots, out_reg, lowered.join_count
+        code, n_ops, cap, n_slots, slots, out_reg, lowered.join_count,
+        n_real=n_real, stat_keys=tuple(stat_keys),
     )
 
 
@@ -459,22 +480,27 @@ def _run_interp(
     )
 
     def body(i, state):
-        regs, rvalid, counts = state
+        regs, rvalid, counts, oprows = state
         op = code[i]
         cols, valid, cnt, cidx = lax.switch(op[0], branches, op, regs, rvalid)
         return (
             regs.at[i].set(cols),
             rvalid.at[i].set(valid),
             counts.at[cidx].set(cnt),
+            # per-op rows-out for EXPLAIN ANALYZE: one reduction over a
+            # mask the op computed anyway, carried with the result so the
+            # host fetches it only under an active analyze capture
+            oprows.at[i].set(jnp.sum(valid).astype(jnp.int64)),
         )
 
     regs0 = jnp.zeros((n_ops, cap, n_slots), dtype=jnp.uint32)
     rvalid0 = jnp.zeros((n_ops, cap), dtype=bool)
     counts0 = jnp.zeros((n_ops + 1,), dtype=jnp.int64)
-    regs, rvalid, counts = lax.fori_loop(
-        0, n_ops, body, (regs0, rvalid0, counts0)
+    oprows0 = jnp.zeros((n_ops,), dtype=jnp.int64)
+    regs, rvalid, counts, oprows = lax.fori_loop(
+        0, n_ops, body, (regs0, rvalid0, counts0, oprows0)
     )
-    return regs[out_reg], rvalid[out_reg], counts[:n_ops]
+    return regs[out_reg], rvalid[out_reg], counts[:n_ops], oprows
 
 
 def interp_compile_stats() -> int:
@@ -563,7 +589,7 @@ def interp_execute(lowered, max_attempts: int = 12):
     through to the specialized path).  Shares the capacity protocol:
     overflow doubles the template's join caps via ``_store_caps`` — caps
     learned here pre-calibrate the eventual specialized compile."""
-    from kolibrie_tpu.optimizer.device_engine import _round_cap
+    from kolibrie_tpu.optimizer.device_engine import _note_fetch, _round_cap
 
     if not lowered.const_ok():
         return lowered.empty_table()
@@ -577,7 +603,10 @@ def interp_execute(lowered, max_attempts: int = 12):
             return None
         sz = f"{prog.n_ops}x{prog.cap}x{prog.n_slots}"
         with _obs_span("interp.dispatch", size_class=sz):
-            out_cols, out_valid, counts = _dispatch(lowered, prog, args)
+            out_cols, out_valid, counts, oprows = _dispatch(
+                lowered, prog, args
+            )
+        _note_fetch("interp.counts")
         counts_h = [int(c) for c in np.asarray(counts)[: prog.join_count]]
         overflow = [
             i
@@ -586,6 +615,7 @@ def interp_execute(lowered, max_attempts: int = 12):
         ]
         if not overflow:
             lowered._store_caps()
+            _note_fetch("interp.collect")
             valid_h = np.asarray(out_valid)
             cols_h = np.asarray(out_cols)
             table = {
@@ -594,6 +624,30 @@ def interp_execute(lowered, max_attempts: int = 12):
             }
             _INTERP_DISPATCH.inc()
             _INTERP_LAT.observe(_time.perf_counter() - t0)
+            cap = _analyze.active()
+            if cap is not None:
+                _note_fetch("analyze.oprows")
+                rows_h = np.asarray(oprows)
+                operators = {
+                    key: int(rows_h[i])
+                    for i, key in enumerate(prog.stat_keys)
+                    if key is not None
+                }
+                names = ("NOP", "SCAN", "JOIN", "FILTER_ID",
+                         "FILTER_NUMC", "FILTER_NUMV")
+                opcodes = {n: 0 for n in names}
+                for oc in prog.code[: prog.n_real, 0]:
+                    opcodes[names[int(oc)]] += 1
+                opcodes["NOP"] += prog.n_ops - prog.n_real
+                cap.record(
+                    "interp",
+                    size_class=sz,
+                    operators=operators,
+                    opcodes=opcodes,
+                    counts=counts_h,
+                    caps=list(lowered._join_caps),
+                    rows=int(valid_h.sum()),
+                )
             return table
         for i in overflow:
             lowered._join_caps[i] = _round_cap(2 * counts_h[i])

@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from kolibrie_tpu.obs import analyze as _analyze
 from kolibrie_tpu.obs import metrics as _m
 from kolibrie_tpu.obs.spans import span
 from kolibrie_tpu.ops.jax_compat import (
@@ -245,6 +246,12 @@ def _batched_body(
     def one(prm):
         ov = jnp.int32(0)
         table, valid = scan_param(premises[seed], fcols, fv, prm)
+        # Per-operator stats, SHARD-LOCAL (no psum: the host sees the
+        # [B, n, n_stats] block and can read imbalance per shard or sum
+        # across shards).  Layout: [seed rows, (exchange rows, join
+        # rows) per step, final rows] — exchange slot stays 0 when the
+        # step's all-to-all is elided by co-partitioning.
+        svec = [jnp.sum(valid).astype(jnp.int32)]
         # Partition tracking for exchange elision: the seed scans the
         # subject-partitioned mirror, so rows start partitioned by the
         # seed's subject var; the side mirrors are partitioned by their
@@ -263,6 +270,9 @@ def _batched_body(
                     table, valid, kv, n, axis, bucket_cap
                 )
                 ov = ov + dropped.astype(jnp.int32)
+                svec.append(jnp.sum(valid).astype(jnp.int32))
+            else:
+                svec.append(jnp.int32(0))
             part = kv
             li, ri, jvalid, total = _join_presorted(
                 table[kv], valid, rsorted, order, join_cap
@@ -284,6 +294,7 @@ def _batched_body(
                 elif v in extra:
                     jvalid = jvalid & (new_table[v] == c[ri])
             table, valid = new_table, jvalid
+            svec.append(jnp.sum(valid).astype(jnp.int32))
         for f in filters:
             col = table[f.var]
             if f.kind == "eq":
@@ -295,15 +306,17 @@ def _batched_body(
             else:
                 m = masks[f.mask_idx]
                 valid = valid & m[jnp.minimum(col, m.shape[0] - 1)]
+        svec.append(jnp.sum(valid).astype(jnp.int32))
         outs = tuple(jnp.where(valid, table[v], 0) for v in out_vars)
-        return outs, valid, ov
+        return outs, valid, ov, jnp.stack(svec)
 
-    outs, valid, ovs = lax.map(one, params)
+    outs, valid, ovs, svecs = lax.map(one, params)
     overflow = jnp.sum(ovs)  # each member's ov is already a global psum
     return (
         tuple(o[:, None] for o in outs),
         valid[:, None],
         overflow[None],
+        svecs[:, None, :],
     )
 
 
@@ -340,7 +353,7 @@ def _get_batched_fn(
             mesh=mesh,
             check_vma=_dist_check_vma(),
             in_specs=((spec,) * 8, (P(),) * n_masks, P()),
-            out_specs=((bspec,) * len(out_vars), bspec, P(axis)),
+            out_specs=((bspec,) * len(out_vars), bspec, P(axis), bspec),
         )
     )
 
@@ -809,7 +822,9 @@ class ShardedDatabase:
                         b_pad,
                     )
                     with _enable_x64(True):
-                        outs, valid, overflow = fn(state, masks, params)
+                        outs, valid, overflow, shard_stats = fn(
+                            state, masks, params
+                        )
                     if int(np.asarray(overflow)[0]) == 0:
                         break
                     join_cap *= 2
@@ -824,6 +839,33 @@ class ShardedDatabase:
                     )
                 valid_np = np.asarray(valid)
                 out_np = [np.asarray(o) for o in outs]
+                cap_rec = _analyze.active()
+                if cap_rec is not None:
+                    # stats ride the result transfer; materialized ONLY
+                    # under an active analyze capture
+                    stats_np = np.asarray(shard_stats)[:b]
+                    stat_names = ["seed"]
+                    for k in range(len(exemplar.steps)):
+                        stat_names += [f"exchange{k}", f"join{k}"]
+                    stat_names.append("final")
+                    for r in range(b):
+                        cap_rec.record(
+                            "sharded",
+                            member=r,
+                            template=fp,
+                            shards=self.n,
+                            steps=[
+                                (j, kv)
+                                for (j, kv, _kp, _ex) in exemplar.steps
+                            ],
+                            stat_names=stat_names,
+                            per_shard=stats_np[r].T.tolist(),
+                            operators={
+                                name: int(stats_np[r, :, i].sum())
+                                for i, name in enumerate(stat_names)
+                            },
+                            caps=[join_cap, bucket_cap],
+                        )
                 # per-shard span children: surviving rows per shard across
                 # the group (observable imbalance of THIS dispatch)
                 per_shard = valid_np[:b].sum(axis=(0, 2))

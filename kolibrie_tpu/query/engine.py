@@ -124,14 +124,21 @@ class QueryEngine:
     def stats(self) -> QueryEngineStats:
         return QueryEngineStats(memory_triple_count=len(self.db))
 
-    def explain_device(self, sparql: str, exact_counts: bool = True) -> str:
+    def explain_device(self, sparql: str, exact_counts: bool = True,
+                       analyze: bool = False) -> str:
         """Physical-plan EXPLAIN for the device engine: the Streamertail
         plan lowered to its device IR, rendered as a tree with scan orders
         + live range sizes, join keys + capacities, filters, quoted
         expansions and the final projection.  ``exact_counts`` also runs
         the host-oracle pass to annotate each join with its true match
         count (no device I/O).  Returns a 'host path: <reason>' line when
-        the plan is not device-expressible."""
+        the plan is not device-expressible.
+
+        ``analyze=True`` is EXPLAIN ANALYZE: the lowered plan actually
+        executes once under an analyze capture, and the tree is annotated
+        with per-operator actual row counts, cap occupancy percentages,
+        and the per-stage device time from the dispatch's spans —
+        estimated vs actual, PostgreSQL style."""
         from kolibrie_tpu.optimizer.device_engine import (
             Unsupported,
             lower_plan,
@@ -191,5 +198,27 @@ class QueryEngine:
             )
         except Unsupported as e:
             return f"host path: {e}"
-        counts = lowered.calibrate_host() if exact_counts else None
-        return lowered.describe(counts)
+        counts = (
+            lowered.calibrate_host() if exact_counts or analyze else None
+        )
+        if not analyze:
+            return lowered.describe(counts)
+        from kolibrie_tpu.obs import analyze as obs_analyze
+        from kolibrie_tpu.obs.spans import spans_snapshot, trace_scope
+
+        with obs_analyze.capture() as cap, trace_scope() as tid:
+            lowered.execute()
+        rec = cap.last("device") or {}
+        lines = [lowered.describe(counts, analyze=rec)]
+        if rec:
+            lines.append(f"source: {rec.get('source', '?')}")
+            lines.append(f"rows: {rec.get('rows', '?')}")
+        stage_spans = [
+            s for s in spans_snapshot(tid)
+            if s["name"].startswith(("device.", "interp."))
+        ]
+        if stage_spans:
+            lines.append("device time:")
+            for s in stage_spans:
+                lines.append(f"  {s['name']}: {s['dur_ms']:.3f} ms")
+        return "\n".join(lines)

@@ -226,6 +226,20 @@ def eval_where(
             if table is None:
                 table = try_device_execute(db, plan, capture=capture)
         if table is None:
+            from kolibrie_tpu.obs import analyze as _obs_analyze
+
+            cap_rec = _obs_analyze.active()
+            if cap_rec is not None:
+                # EXPLAIN ANALYZE honesty: say WHICH engine ran when the
+                # query never reached a device program
+                cap_rec.record(
+                    "host",
+                    reason=(
+                        "device lowering unavailable"
+                        if _device_routed(db)
+                        else "host-routed store"
+                    ),
+                )
             table = engine.execute_with_ids(plan)
     else:
         table = _naive_eval(engine, resolved, where, plan_filters)

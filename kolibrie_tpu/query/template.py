@@ -50,7 +50,18 @@ __all__ = [
     "CapAdvisor",
     "cap_advisor",
     "cap_advisor_enabled",
+    "occupancy_pct",
 ]
+
+
+def occupancy_pct(rows: int, cap: int) -> float:
+    """How full a template-cap slot ran: ``rows / cap`` as a percentage.
+    The EXPLAIN ANALYZE renderer and the cap advisor's telemetry share
+    this so 'occupancy' means one thing everywhere.  A non-positive cap
+    (degenerate/elided slot) reads as 0 rather than dividing by zero."""
+    if cap <= 0:
+        return 0.0
+    return 100.0 * rows / cap
 
 
 def _as_number(text: str) -> bool:

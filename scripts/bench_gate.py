@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel: diff a fresh bench.py block against the
+committed BENCH_r*.json trajectory and fail on >20% regressions.
+
+The trajectory files each hold ``{"n", "cmd", "rc", "tail", "parsed"}``
+where ``parsed`` is the bench's one-line JSON block — or ``None`` when
+that round's capture failed (r02/r05 are like this); such rounds are
+skipped, not fatal.  The gate compares the fresh block against the
+trajectory's BEST value per metric, so a slow round in history never
+lowers the bar:
+
+- headline ``value`` and any ``secondary`` key ending in ``_qps`` /
+  ``_per_sec``: higher is better, regression = fresh < best * (1 - t)
+- ``secondary`` keys ending in ``_ms`` / ``_s``: lower is better,
+  regression = fresh > best * (1 + t)
+
+Only metrics present in BOTH the fresh block and the trajectory are
+compared (new metrics have no bar yet; retired ones don't block), and
+only trajectory rounds whose headline ``metric`` NAME matches the fresh
+block's count — the trajectory mixes cpu/tpu captures and metric
+renames, and a cpu run must never be gated against a tpu bar.
+
+Usage:
+    python scripts/bench_gate.py --fresh out.json   # gate a saved block
+    python scripts/bench_gate.py --fresh -          # … from stdin
+    python scripts/bench_gate.py                    # run bench.py live
+    python scripts/bench_gate.py --smoke            # self-check, no bench
+
+``--smoke`` runs in scripts/lint.sh: it validates the committed
+trajectory's schema, proves the comparator catches an injected
+regression (and ignores noise under the threshold), and exercises the
+obs timeline ring end to end — all in-process, no live bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_THRESHOLD_PCT = 20.0
+
+# secondary keys that are environment probes, not performance metrics
+_SKIP_KEYS = ("rows", "budget_pct")
+
+
+def load_trajectory(repo: str = REPO) -> List[dict]:
+    """The committed bench rounds, oldest first; entries whose ``parsed``
+    is None (failed captures) are dropped here."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        with open(path) as f:
+            rec = json.load(f)
+        if not isinstance(rec, dict) or "parsed" not in rec:
+            raise SystemExit(f"bench gate: malformed trajectory file {path}")
+        if rec["parsed"] is not None:
+            rec["parsed"]["_path"] = os.path.basename(path)
+            out.append(rec["parsed"])
+    return out
+
+
+def _flatten(block: dict) -> Dict[str, float]:
+    """Headline value + numeric secondary leaves, as ``key -> float``.
+    Nested secondary dicts (obs, wcoj, …) flatten with a dotted prefix."""
+    out: Dict[str, float] = {}
+    if isinstance(block.get("value"), (int, float)):
+        out["value"] = float(block["value"])
+
+    def walk(prefix: str, d: dict):
+        for k, v in d.items():
+            if k in _SKIP_KEYS or k.startswith("_"):
+                continue
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                out[f"{prefix}{k}"] = float(v)
+            elif isinstance(v, dict):
+                walk(f"{prefix}{k}.", v)
+
+    walk("secondary.", block.get("secondary") or {})
+    return out
+
+
+def _direction(key: str) -> Optional[str]:
+    """'up' = higher is better, 'down' = lower is better, None = not a
+    gated metric (ratios, counts, timestamps…)."""
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf == "value" or leaf.endswith(("_qps", "_per_sec")):
+        return "up"
+    if leaf.endswith(("_ms", "_s")):
+        return "down"
+    return None
+
+
+def compare(
+    fresh: dict,
+    trajectory: List[dict],
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+) -> Tuple[List[str], List[str]]:
+    """Returns (regressions, checked) message lists."""
+    fresh_flat = _flatten(fresh)
+    # like against like: cpu/tpu captures and metric renames make raw
+    # cross-round comparison meaningless
+    trajectory = [
+        b for b in trajectory if b.get("metric") == fresh.get("metric")
+    ]
+    best: Dict[str, float] = {}
+    for block in trajectory:
+        for k, v in _flatten(block).items():
+            d = _direction(k)
+            if d is None:
+                continue
+            if k not in best:
+                best[k] = v
+            else:
+                best[k] = max(best[k], v) if d == "up" else min(best[k], v)
+    t = threshold_pct / 100.0
+    regressions, checked = [], []
+    for k, bar in sorted(best.items()):
+        if k not in fresh_flat or bar <= 0:
+            continue
+        v, d = fresh_flat[k], _direction(k)
+        if d == "up":
+            worse = v < bar * (1.0 - t)
+            delta = (bar - v) / bar * 100.0
+        else:
+            worse = v > bar * (1.0 + t)
+            delta = (v - bar) / bar * 100.0
+        checked.append(f"{k}: fresh={v:g} best={bar:g} ({delta:+.1f}%)")
+        if worse:
+            regressions.append(
+                f"{k}: fresh={v:g} vs best={bar:g} — "
+                f"{delta:.1f}% worse (threshold {threshold_pct:g}%)"
+            )
+    return regressions, checked
+
+
+def _read_fresh(arg: Optional[str]) -> dict:
+    if arg == "-":
+        text = sys.stdin.read()
+    elif arg:
+        with open(arg) as f:
+            text = f.read()
+    else:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+        )
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr[-2000:])
+            raise SystemExit("bench gate: live bench.py run failed")
+        text = proc.stdout
+    # the block is the LAST line that parses as a JSON object with "metric"
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            block = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(block, dict) and "metric" in block:
+            return block
+    raise SystemExit("bench gate: no bench JSON block found in input")
+
+
+# ------------------------------------------------------------------ smoke
+
+
+def smoke() -> None:
+    trajectory = load_trajectory()
+    assert trajectory, "trajectory empty — every committed round failed?"
+    for block in trajectory:
+        assert "metric" in block and "value" in block, block.get("_path")
+
+    # comparator self-check against the real trajectory: the best round
+    # itself must pass its own bar …
+    synth = dict(trajectory[-1])
+    regs, checked = compare(synth, trajectory)
+    assert checked, "no comparable metrics in the trajectory"
+    assert not regs, f"latest committed round fails its own gate: {regs}"
+    # … an injected 2x slowdown must fail it …
+    bad = json.loads(json.dumps(trajectory[-1]))
+    bad["value"] = bad["value"] / 2.0
+    regs, _ = compare(bad, trajectory)
+    assert any(r.startswith("value:") for r in regs), "missed 50% regression"
+    # … and sub-threshold noise must not
+    noisy = json.loads(json.dumps(trajectory[-1]))
+    noisy["value"] = noisy["value"] * 0.9
+    regs, _ = compare(noisy, trajectory)
+    assert not any(
+        r.startswith("value:") for r in regs
+    ), "10% noise tripped the 20% gate"
+
+    # timeline ring end to end, against an isolated registry
+    sys.path.insert(0, REPO)
+    from kolibrie_tpu.obs import metrics as m
+    from kolibrie_tpu.obs.timeseries import TimeSeriesRing
+
+    reg = m.Registry()
+    c = reg.counter("smoke_total")
+    ring = TimeSeriesRing(capacity=4, registry=reg)
+    ring.record(now=1.0)
+    c.inc(5)
+    ring.record(now=2.0)
+    series = ring.series()
+    deltas = series["metrics"]["smoke_total"]["series"][""]["deltas"]
+    assert deltas == [5.0], deltas
+    print(
+        f"bench gate smoke OK: {len(trajectory)} trajectory rounds, "
+        f"{len(checked)} gated metrics, ring deltas verified"
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", help="bench JSON block file, or - for stdin")
+    ap.add_argument(
+        "--threshold", type=float, default=DEFAULT_THRESHOLD_PCT,
+        help="regression threshold in percent (default 20)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="schema + comparator + ring self-check; no live bench",
+    )
+    args = ap.parse_args()
+    if args.smoke:
+        smoke()
+        return 0
+    trajectory = load_trajectory()
+    if not trajectory:
+        print("bench gate: no usable trajectory rounds; nothing to gate")
+        return 0
+    fresh = _read_fresh(args.fresh)
+    regressions, checked = compare(fresh, trajectory, args.threshold)
+    for line in checked:
+        print("  " + line)
+    if regressions:
+        print(f"bench gate: {len(regressions)} regression(s)")
+        for r in regressions:
+            print("  REGRESSION " + r)
+        return 1
+    print(f"bench gate OK: {len(checked)} metrics within {args.threshold:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

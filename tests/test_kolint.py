@@ -491,6 +491,78 @@ def test_kl502_good(tmp_path):
     assert res.findings == []
 
 
+# --------------------------------------- KL503: obs call inside jit code
+
+
+BAD_KL503 = """
+import jax
+import jax.numpy as jnp
+from kolibrie_tpu.obs import metrics
+from kolibrie_tpu.obs.spans import span
+
+CALLS = metrics.counter("calls_total", "calls")
+
+@jax.jit
+def step(x):
+    CALLS.inc()  # counts traces, not calls
+    with span("step"):  # times the trace, not the dispatch
+        return jnp.sum(x)
+"""
+
+GOOD_KL503 = """
+import jax
+import jax.numpy as jnp
+from kolibrie_tpu.obs import metrics
+from kolibrie_tpu.obs.spans import span
+
+CALLS = metrics.counter("calls_total", "calls")
+
+@jax.jit
+def step(x):
+    return jnp.sum(x)
+
+def serve(x):
+    CALLS.inc()  # host side: records per call
+    with span("serve"):
+        return step(x)
+"""
+
+
+def test_kl503_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL503)
+    assert rules_fired(res) == ["KL503"]
+    assert len(res.findings) == 2  # the metric inc AND the span
+    msgs = " ".join(f.message for f in res.findings)
+    assert "trace" in msgs
+
+
+def test_kl503_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL503)
+    assert res.findings == []
+
+
+def test_kl503_reaches_through_call_graph(tmp_path):
+    # the obs call hides one call down from the jit root — exactly the
+    # mistake the device stats-vector pattern exists to prevent
+    src = """
+import jax
+from kolibrie_tpu.obs import metrics
+
+ROWS = metrics.counter("rows_total", "rows")
+
+def tally(x):
+    ROWS.inc()
+    return x
+
+@jax.jit
+def root(x):
+    return tally(x)
+"""
+    res = lint(tmp_path, src)
+    assert rules_fired(res) == ["KL503"]
+    assert res.findings[0].scope == "tally"
+
+
 # ------------------------------------------- KL601: swallowed exception
 
 
@@ -854,7 +926,7 @@ def test_cli_list_rules(capsys):
     assert kolint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("KL101", "KL102", "KL201", "KL202", "KL203", "KL301", "KL302",
-                "KL401", "KL501", "KL502", "KL601", "KL701",
+                "KL401", "KL501", "KL502", "KL503", "KL601", "KL701",
                 "KL001", "KL002"):
         assert rid in out
 

@@ -323,3 +323,113 @@ def test_debug_profile_noops_on_cpu(server):
     _, out = post(server, "/debug/profile?seconds=0.01", {})
     assert out["profiled"] is False
     assert out["backend"] == "cpu"
+    assert "KOLIBRIE_PROFILE_FORCE" in out["reason"]
+
+
+def test_debug_profile_forced_on_cpu(server, monkeypatch):
+    # env is read per request, so the module-scoped server honors it
+    monkeypatch.setenv("KOLIBRIE_PROFILE_FORCE", "1")
+    _, out = post(server, "/debug/profile?seconds=0.01", {})
+    assert out["profiled"] is True
+    assert out["forced"] is True
+    assert out["backend"] == "cpu"
+    assert isinstance(out["trace_files"], int) and out["trace_files"] >= 1
+    assert out["trace_dir"]
+
+
+def test_label_escaping_round_trips():
+    # backslash, newline and double-quote through the exposition format
+    # and back: unescaping the rendered line recovers the original value
+    raw = 'a\\b"c\nd'
+    reg = obs_metrics.Registry()
+    reg.counter("t_rt", "test", labels=("v",)).labels(raw).inc()
+    text = obs_export.render_prometheus(reg)
+    m = re.search(r't_rt\{v="((?:[^"\\]|\\.)*)"\} 1', text)
+    assert m, text
+    unescaped = (
+        m.group(1)
+        .replace("\\\\", "\x00")
+        .replace("\\n", "\n")
+        .replace('\\"', '"')
+        .replace("\x00", "\\")
+    )
+    assert unescaped == raw
+
+
+# ----------------------------------------------- EXPLAIN ANALYZE (ISSUE 14)
+
+
+def test_store_query_explain_analyze(server):
+    post(server, "/store/load",
+         {"store_id": "obs_an", "rdf": NT, "format": "ntriples",
+          "mode": "device"})
+    _, out = post(server, "/store/query?explain=analyze",
+                  {"store_id": "obs_an", "sparql": QUERY})
+    assert len(out["data"]) == 64
+    recs = out["explain"]
+    assert isinstance(recs, list) and recs
+    ops = next(r["operators"] for r in recs
+               if r["kind"] in ("device", "interp"))
+    assert ops["scan0"] == 64
+
+
+def test_store_query_rejects_unknown_explain_mode(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server, "/store/query?explain=verbose",
+             {"store_id": "obs_an", "sparql": QUERY})
+    assert ei.value.code == 400
+
+
+def test_debug_explain_endpoint(server):
+    # inline dataset: per-operator actuals annotated onto the plan tree
+    _, out = post(server, "/debug/explain",
+                  {"rdf": NT, "format": "ntriples", "sparql": QUERY})
+    assert "actual=" in out["plan"]
+    assert "device time:" in out["plan"]
+    # registered store: same renderer, batcher's db under its lock
+    _, out = post(server, "/debug/explain",
+                  {"store_id": "obs_an", "sparql": QUERY})
+    assert "actual=" in out["plan"]
+    assert "source:" in out["plan"]
+
+
+def test_debug_timeline_endpoint(server):
+    from kolibrie_tpu.obs import timeseries
+
+    ring = timeseries.default_ring()
+    ring.record()
+    post(server, "/store/query", {"store_id": "obs_an", "sparql": QUERY})
+    ring.record()
+    _, text = get(server, "/debug/timeline")
+    body = json.loads(text)
+    assert body["samples"] >= 2
+    assert body["interval_s"] == timeseries.DEFAULT_INTERVAL_S
+    assert body["capacity"] == ring.capacity
+    # the serving counters the queries above moved are in the ring
+    assert "kolibrie_http_requests_total" in body["metrics"]
+    # ?metric= narrows, ?n= windows
+    _, text = get(server,
+                  "/debug/timeline?metric=kolibrie_http_requests_total&n=2")
+    narrowed = json.loads(text)
+    assert list(narrowed["metrics"]) == ["kolibrie_http_requests_total"]
+    assert narrowed["samples"] == 2
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(server, "/debug/timeline?n=bogus")
+    assert ei.value.code == 400
+
+
+def test_trace_id_reaches_interpreter_spans(server, monkeypatch):
+    # satellite: the client trace id must survive into the PR-9
+    # plan-interpreter route's spans
+    monkeypatch.setenv("KOLIBRIE_PLAN_INTERP", "force")
+    obs_spans.clear()
+    post(server, "/store/load",
+         {"store_id": "obs_int", "rdf": NT, "format": "ntriples",
+          "mode": "device"})
+    post(server, "/store/query", {"store_id": "obs_int", "sparql": QUERY},
+         headers={"X-Kolibrie-Trace-Id": "trace-interp-1"})
+    _, body = get(server, "/debug/traces?trace_id=trace-interp-1")
+    spans = [json.loads(l) for l in body.splitlines() if l]
+    names = {s["name"] for s in spans}
+    assert "interp.dispatch" in names, names
+    assert all(s["trace_id"] == "trace-interp-1" for s in spans)

@@ -353,11 +353,11 @@ def sharded_server(mesh8, monkeypatch):
     httpd.shutdown()
 
 
-def _post(base, path, payload):
+def _post(base, path, payload, headers=None):
     req = urllib.request.Request(
         base + path,
         data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
         method="POST",
     )
     with urllib.request.urlopen(req, timeout=60) as resp:
@@ -391,6 +391,87 @@ def test_http_sharded_store_end_to_end(sharded_server):
         metrics = resp.read().decode()
     assert "kolibrie_shard_rows_scanned_total" in metrics
     assert "kolibrie_store_shards" in metrics
+
+
+# ------------------------------------------------ EXPLAIN ANALYZE (ISSUE 14)
+
+
+def test_batched_analyze_matches_oracle(sharded_db):
+    # the shard-local stats vector rides the batched result transfer;
+    # summed across the mesh it must equal the oracle's row counts, and
+    # capturing it must not perturb results
+    from kolibrie_tpu.obs import analyze as obs_analyze
+
+    db, sh = sharded_db
+    texts = _template_group(db, 4)
+    oracle = [execute_query_volcano(t, db) for t in texts]
+    with obs_analyze.capture() as cap:
+        got = execute_queries_batched(db, texts)
+    assert got == oracle
+    recs = [r for r in cap.records if r["kind"] == "sharded"]
+    assert len(recs) == len(texts)
+    for rec in recs:
+        assert rec["shards"] == 8
+        assert rec["operators"]["final"] == len(oracle[rec["member"]])
+        # per-shard breakdowns sum to the cross-mesh operator totals
+        for i, name in enumerate(rec["stat_names"]):
+            assert len(rec["per_shard"][i]) == 8
+            assert sum(rec["per_shard"][i]) == rec["operators"][name]
+        # the subject-keyed star join is co-partitioned: exchange elided,
+        # its stats slot honestly reads zero
+        assert rec["operators"]["exchange0"] == 0
+        assert len(rec["caps"]) == 2
+
+
+def test_trace_id_reaches_shard_spans(sharded_server):
+    # satellite: a client trace id must survive the HTTP front door into
+    # the PR-8 shard_map dispatch's per-shard span children.  The mesh
+    # only takes GROUPS (>= 2 same-template members in one 5 ms batch
+    # window), so two members post concurrently under ONE trace id — the
+    # batch leader's dispatch then lands the shard spans under it.
+    from kolibrie_tpu.obs import spans as obs_spans
+
+    base = sharded_server
+    db = _lubm_db(1)
+    out = _post(
+        base,
+        "/store/load",
+        {"rdf": db.to_ntriples(), "format": "ntriples", "mode": "host"},
+    )
+    sid = out["store_id"]
+    texts = _template_group(db, 2)
+    spans = []
+    for attempt in range(8):  # the 5 ms window makes co-arrival racy
+        tid = f"trace-shard-http-{attempt}"
+        obs_spans.clear()
+        threads = [
+            threading.Thread(
+                target=_post,
+                args=(base, "/store/query", {"store_id": sid, "sparql": t}),
+                kwargs={"headers": {"X-Kolibrie-Trace-Id": tid}},
+            )
+            for t in texts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with urllib.request.urlopen(
+            base + f"/debug/traces?trace_id={tid}", timeout=60
+        ) as resp:
+            spans = [
+                json.loads(l) for l in resp.read().decode().splitlines() if l
+            ]
+        if any(s["name"] == "shard.dispatch" for s in spans):
+            break
+    assert spans and all(s["trace_id"] == tid for s in spans)
+    names = {s["name"] for s in spans}
+    assert "executor.sharded" in names, names
+    assert "shard.dispatch" in names, names
+    kids = [s for s in spans if s["name"] == "shard.partition"]
+    assert len(kids) == 8
+    ids = {s["span_id"] for s in spans}
+    assert all(k["parent_id"] in ids for k in kids)
 
 
 # ------------------------------------------------------------------ kolint
