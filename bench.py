@@ -1074,6 +1074,123 @@ def main():
         compile_tail = {"error": repr(e)}
     note(f"compile_tail sweep done ({compile_tail})")
 
+    # ---- mqo: shared-prefix evaluation across a standing-query fleet -----
+    # The PR-16 acceptance workload (docs/MQO.md).  (1) Fleet marginal-
+    # cost curve: N standing windows share one scan/join prefix and
+    # differ only in their filter; a fire round evaluates all N once,
+    # shared (KOLIBRIE_MQO=force, standing scopes — the RSP fire-path
+    # twin: same-content rounds are no-op mutation batches, so the
+    # prefix cache key (prefix_fp, base_version, delta_epoch) holds) vs
+    # independent (off).  Rows asserted identical per window; zero new
+    # specialized compiles on the shared side.  Window content size is
+    # seeded from the CITYBENCH_SWEEP grid (the RSP workload this fleet
+    # models).  (2) Batcher mixed-template A/B: one dispatch of a mixed
+    # same-prefix template group through execute_queries_batched, force
+    # vs off.
+    note("mqo shared-prefix fleet sweep")
+    mqo_block = None
+    try:
+        from kolibrie_tpu.optimizer import mqo as mqo_mod
+        from kolibrie_tpu.query.executor import execute_queries_batched
+        from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+        try:
+            with open(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "CITYBENCH_SWEEP.json")
+            ) as f:
+                _sizes = sorted({g["size"] for g in json.load(f)["grid"]})
+            # the sweep's LARGEST window: prefix scan/join work must
+            # dominate for the marginal-cost curve to be meaningful — at
+            # toy sizes the per-query suffix overhead is the whole cost
+            fleet_rows = _sizes[-1]
+        except (OSError, ValueError, KeyError):
+            fleet_rows = 50_000
+
+        def fleet_db():
+            dbf = SparqlDatabase()
+            lines = []
+            for i in range(fleet_rows):
+                s = f"<http://e/s{i}>"
+                lines.append(f'{s} <http://e/val> "{i % 100}" .')
+                lines.append(f'{s} <http://e/kind> "k{i % 7}" .')
+            dbf.parse_ntriples("\n".join(lines))
+            return dbf
+
+        def fleet_q(i):
+            return (
+                'SELECT ?s ?v WHERE { ?s <http://e/kind> "k3" . '
+                f"?s <http://e/val> ?v . FILTER(?v > {i % 90}) }}"
+            )
+
+        def fire_round(dbf, n, owners):
+            out = []
+            for i in range(n):
+                with mqo_mod.standing_scope(dbf, owners[i]):
+                    out.append(execute_query_volcano(fleet_q(i), dbf))
+            return out
+
+        mqo_block = {"fleet_rows": fleet_rows}
+        os.environ["KOLIBRIE_MQO"] = "off"
+        for n in (1, 8, 64, 256):
+            dbf = fleet_db()
+            owners = [f"w{i}" for i in range(n)]
+            for o in owners:
+                mqo_mod.register_standing(dbf, o)
+            os.environ["KOLIBRIE_MQO"] = "force"
+            fire_round(dbf, n, owners)  # warm parse/plan caches + prefix
+            comp0 = device_compile_stats()
+            t0 = time.perf_counter()
+            shared = fire_round(dbf, n, owners)
+            t_shared = time.perf_counter() - t0
+            comp1 = device_compile_stats()
+            os.environ["KOLIBRIE_MQO"] = "off"
+            fire_round(dbf, n, owners)  # warm the off-mode template slots
+            t0 = time.perf_counter()
+            indep = fire_round(dbf, n, owners)
+            t_indep = time.perf_counter() - t0
+            assert [sorted(map(tuple, r)) for r in shared] == [
+                sorted(map(tuple, r)) for r in indep
+            ], f"mqo fleet N={n}: shared rows diverge from independent"
+            mqo_block[f"fleet{n}_shared_per_query_ms"] = round(
+                1000 * t_shared / n, 4
+            )
+            mqo_block[f"fleet{n}_independent_per_query_ms"] = round(
+                1000 * t_indep / n, 4
+            )
+            mqo_block[f"fleet{n}_marginal_ratio"] = round(
+                t_shared / t_indep, 3
+            )
+            mqo_block[f"fleet{n}_new_compiles"] = sum(
+                comp1[k] - comp0[k] for k in comp1
+            )
+        st = mqo_mod.stats(dbf)
+        mqo_block["fleet256_cache_hits"] = sum(
+            p["cache_hits"] for p in st["prefixes"].values()
+        )
+        # batcher mixed-template A/B: one group of same-prefix templates
+        dbf = fleet_db()
+        texts = [fleet_q(i) for i in range(16)]
+        for mode, tag in (("force", "shared"), ("off", "independent")):
+            os.environ["KOLIBRIE_MQO"] = mode
+            execute_queries_batched(dbf, texts)  # warm
+            t0 = time.perf_counter()
+            batched = execute_queries_batched(dbf, texts)
+            mqo_block[f"batched_mixed_{tag}_ms"] = round(
+                1000 * (time.perf_counter() - t0), 3
+            )
+            if mode == "force":
+                rows_shared = [sorted(map(tuple, r)) for r in batched]
+            else:
+                assert rows_shared == [
+                    sorted(map(tuple, r)) for r in batched
+                ], "mqo batched A/B rows diverge"
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        mqo_block = {"error": repr(e)}
+    finally:
+        os.environ.pop("KOLIBRIE_MQO", None)
+    note(f"mqo sweep done ({mqo_block})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -1140,6 +1257,7 @@ def main():
                     "durability": durability_block,
                     "sharded_serving": sharded_block,
                     "compile_tail": compile_tail,
+                    "mqo": mqo_block,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

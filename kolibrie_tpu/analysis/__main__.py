@@ -62,6 +62,7 @@ def main(argv=None) -> int:
 
     # import for registration before --list-rules
     from kolibrie_tpu.analysis import (  # noqa: F401
+        rules_caching,
         rules_context,
         rules_errors,
         rules_locks,

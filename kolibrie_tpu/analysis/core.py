@@ -177,6 +177,7 @@ def run(
 ) -> RunResult:
     # rule modules self-register on import
     from kolibrie_tpu.analysis import (  # noqa: F401
+        rules_caching,
         rules_context,
         rules_durability,
         rules_errors,

@@ -659,6 +659,15 @@ class ColumnarTripleStore:
         self.compact()
         return self._delta_epoch
 
+    def version_key(self) -> Tuple[int, int]:
+        """``(base_version, delta_epoch)`` after one compaction — THE
+        cache key for any result derived from live store state (the MQO
+        prefix cache, kolint rule KL901).  One ``compact()`` call covers
+        both components, so the pair is read consistently even when a
+        mutation batch is pending."""
+        self.compact()
+        return (self._base_version, self._delta_epoch)
+
     @property
     def delta_device_cap(self) -> int:
         """Fixed device capacity of the delta segment (rows).  A function

@@ -106,6 +106,21 @@ _BATCH_SIZE = obs_metrics.histogram(
     "requests riding one dispatch",
     buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
 )
+_BATCH_QUEUE_AT_DISPATCH = obs_metrics.histogram(
+    "kolibrie_batcher_queue_depth_at_dispatch",
+    "pending-queue depth observed at the moment a leader drained it "
+    "(distinct from the scrape-time kolibrie_batcher_queue_depth gauge: "
+    "this one is sampled exactly when dispatch decisions are made, so "
+    "its distribution shows what the MQO sharing layer actually sees)",
+    buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_BATCH_DISTINCT_TEMPLATES = obs_metrics.histogram(
+    "kolibrie_batcher_distinct_templates_per_dispatch",
+    "distinct template fingerprints riding one dispatch — values >= 2 "
+    "are the mixed-template groups eligible for shared-prefix "
+    "evaluation (docs/MQO.md)",
+    buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+)
 _BATCH_FALLBACKS = obs_metrics.counter(
     "kolibrie_batcher_fallback_total",
     "batched dispatches that failed and fell back to solo retries",
@@ -331,6 +346,11 @@ class TemplateBatcher:
         self.shed_deadline = 0  # guarded by: lock
         # fp -> {"requests", "dedup_hits", "lat": [dispatch ms, ...]}
         self.templates: Dict[str, dict] = {}  # guarded by: lock
+        # bounded per-dispatch samples backing the /stats percentiles:
+        # queue depth the leader drained, and how many distinct templates
+        # rode the dispatch (>= 2 ⇒ MQO shared-prefix candidates)
+        self.depth_at_dispatch: List[int] = []  # guarded by: lock
+        self.distinct_per_dispatch: List[int] = []  # guarded by: lock
 
     # ------------------------------------------------------------- dispatch
 
@@ -456,6 +476,12 @@ class TemplateBatcher:
                     rec["dedup_hits"] += texts.count(text) - 1
                 rec["lat"].append(ms)
                 del rec["lat"][:-256]  # bounded latency window
+            self.depth_at_dispatch.append(len(batch))
+            del self.depth_at_dispatch[:-256]
+            self.distinct_per_dispatch.append(len(by_fp))
+            del self.distinct_per_dispatch[:-256]
+        _BATCH_QUEUE_AT_DISPATCH.observe(len(batch))
+        _BATCH_DISTINCT_TEMPLATES.observe(len(by_fp))
         _BATCH_DISPATCHES.inc()
         _BATCH_DEDUP.inc(len(texts) - len(uniq))
         _BATCH_SIZE.observe(len(batch))

@@ -109,6 +109,8 @@ def store_stats(batcher) -> dict:
             }
             for fp, rec in batcher.templates.items()
         }
+        depths = list(getattr(batcher, "depth_at_dispatch", ()))
+        distinct = list(getattr(batcher, "distinct_per_dispatch", ()))
         out = {
             "requests": batcher.requests,
             "dispatches": batcher.dispatches,
@@ -117,6 +119,14 @@ def store_stats(batcher) -> dict:
             "shed_queue_full": batcher.shed_queue_full,
             "shed_deadline": batcher.shed_deadline,
             "queue_depth": len(batcher.pending),
+            # dispatch-shape distribution (bounded recent window): how
+            # deep the drained queue ran and how template-diverse each
+            # dispatch was — distinct >= 2 is the population the MQO
+            # shared-prefix layer can help (docs/MQO.md)
+            "queue_depth_at_dispatch_p50": _pct(depths, 0.50),
+            "queue_depth_at_dispatch_p95": _pct(depths, 0.95),
+            "distinct_templates_p50": _pct(distinct, 0.50),
+            "distinct_templates_p95": _pct(distinct, 0.95),
             "per_template": per,
         }
     with batcher.dispatch_lock:
@@ -129,6 +139,11 @@ def store_stats(batcher) -> dict:
             # the degraded-routing signals (docs/SHARDING.md)
             out["sharding"] = sharded.stats()
     out["device_compiles"] = device_compile_stats()
+    from kolibrie_tpu.optimizer import mqo
+
+    # shared-prefix registry for this store: mode, standing count, per-
+    # prefix beneficiaries / shared evals / cache hits (docs/MQO.md)
+    out["mqo"] = mqo.stats(batcher.db)
     return out
 
 
@@ -151,6 +166,12 @@ def build_stats(state) -> dict:
         rstats = getattr(s.engine, "resilience_stats", None)
         if rstats is not None:
             info["windows"] = rstats()
+        mstats = getattr(s.engine, "mqo_stats", None)
+        if mstats is not None:
+            # fire-round prefix sharing across the session's standing
+            # windows (docs/MQO.md): hits climb when same-content rounds
+            # reuse the cached prefix table
+            info["mqo"] = mstats()
         per_session[sid] = info
     resilience = {
         "admission": state.admission.snapshot(),

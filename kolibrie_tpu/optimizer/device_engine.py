@@ -1255,6 +1255,11 @@ class LoweredPlan:
                 for name, consts in self.scan_descs
             ),
         )
+        # pre-actuals worthiness signal for the MQO layer: the planner's
+        # leaf-scan cardinality bound (optimizer/mqo.py, docs/MQO.md)
+        from kolibrie_tpu.optimizer.planner import estimated_prefix_rows
+
+        self.est_prefix_rows = estimated_prefix_rows(plan)
 
     def _compact_orders(self) -> None:
         """Drop sort orders no longer referenced after join-driven order
@@ -2781,10 +2786,11 @@ class LoweredPlan:
     def empty_table(self) -> BindingTable:
         return {v: np.empty(0, dtype=np.uint32) for v in self.out_vars}
 
-    # how the last execute() produced its rows: "interp" (plan-bytecode
-    # interpreter), "compiled" (specialized jit, compiled or warm), or
-    # "disk" (specialized jit whose executable loaded from the persistent
-    # compilation cache).  Plan-cache slots surface this as `source`.
+    # how the last execute() produced its rows: "mqo" (shared-prefix
+    # fan-out), "interp" (plan-bytecode interpreter), "compiled"
+    # (specialized jit, compiled or warm), or "disk" (specialized jit
+    # whose executable loaded from the persistent compilation cache).
+    # Plan-cache slots surface this as `source`.
     last_source: Optional[str] = None
 
     def execute(self) -> BindingTable:
@@ -2797,6 +2803,20 @@ class LoweredPlan:
         if not self.const_ok():
             return self.empty_table()
         tpl = _get_baggage("template", "unknown")
+        # multi-query sharing: when KOLIBRIE_MQO routes this template to a
+        # shared scan/join prefix, the prefix table comes from the
+        # version-keyed cache (or one interpreter dispatch) and only the
+        # filter suffix runs per member (optimizer/mqo.py, docs/MQO.md)
+        from kolibrie_tpu.optimizer import mqo as _mqo
+
+        if _mqo.mqo_mode() != "off":
+            t0 = _time.perf_counter()
+            table = _mqo.try_shared_execute(self)
+            if table is not None:
+                self.last_source = "mqo"
+                _DISPATCH_LAT.labels(tpl).observe(_time.perf_counter() - t0)
+                check_deadline("device.execute.done")
+                return table
         # zero-compile cold path: KOLIBRIE_PLAN_INTERP routes eligible
         # templates through the plan-bytecode interpreter until the
         # specialized executable exists (docs/COMPILE_CACHE.md); a shape

@@ -178,10 +178,12 @@ class InterpProgram:
         self.stat_keys = stat_keys
 
 
-def compile_bytecode(lowered) -> InterpProgram:
-    """Flatten ``lowered.root`` into the op table.  Requires
-    ``lowered.build()`` to have run (capacities populated).  Raises
-    :class:`InterpUnsupported` for shapes outside the repertoire."""
+def _emit_rows(lowered):
+    """Flatten ``lowered.root`` into raw op rows WITHOUT touching
+    capacities or the device — safe to call before ``lowered.build()``.
+    Returns ``(rows, bound, stat_keys, slots, out_reg)``; the MQO layer
+    uses this for prefix splitting/fingerprinting on host-routed stores.
+    Raises :class:`InterpUnsupported` for shapes outside the repertoire."""
     from kolibrie_tpu.optimizer.device_engine import (
         BoolNode,
         FilterSpec,
@@ -304,9 +306,17 @@ def compile_bytecode(lowered) -> InterpProgram:
         raise InterpUnsupported(type(node).__name__)
 
     out_reg = walk(lowered.root)
+    if len(rows) > _MAX_OPS:
+        raise InterpUnsupported(f"{len(rows)} ops > {_MAX_OPS}")
+    return rows, bound, stat_keys, slots, out_reg
+
+
+def compile_bytecode(lowered) -> InterpProgram:
+    """Flatten ``lowered.root`` into the op table.  Requires
+    ``lowered.build()`` to have run (capacities populated).  Raises
+    :class:`InterpUnsupported` for shapes outside the repertoire."""
+    rows, bound, stat_keys, slots, out_reg = _emit_rows(lowered)
     n_real = len(rows)
-    if n_real > _MAX_OPS:
-        raise InterpUnsupported(f"{n_real} ops > {_MAX_OPS}")
     caps = list(lowered._scan_caps.values()) + list(lowered._join_caps)
     cap = _bucket(max(caps) if caps else 1, 8)
     n_ops = _bucket(n_real, 4)

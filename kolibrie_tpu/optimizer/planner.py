@@ -45,6 +45,31 @@ def wcoj_mode() -> str:
     return mode if mode in ("auto", "off", "force") else "auto"
 
 
+def estimated_prefix_rows(plan) -> Optional[float]:
+    """Upper-bound row estimate for a physical plan's scan/join prefix:
+    the largest leaf-scan cardinality estimate in the tree.  The MQO
+    layer (optimizer/mqo.py) uses this as the pre-actuals worthiness
+    signal — ``rows × beneficiaries`` decides whether a shared prefix is
+    worth caching; once the prefix has actually run, the registry's
+    observed row counts replace it.  None when the plan has no estimated
+    scan leaves (VALUES-only shapes)."""
+    est: Optional[float] = None
+
+    def walk(node) -> None:
+        nonlocal est
+        if isinstance(node, (P.PhysIndexScan, P.PhysTableScan)):
+            e = float(node.estimated_rows or 0.0)
+            est = e if est is None else max(est, e)
+            return
+        for attr in ("left", "right", "child"):
+            c = getattr(node, attr, None)
+            if c is not None:
+                walk(c)
+
+    walk(plan)
+    return est
+
+
 def _gyo_cyclic(edge_sets: List[frozenset]) -> bool:
     """Hypergraph cyclicity via GYO reduction: repeatedly drop vertices
     that occur in exactly one edge and edges contained in another edge

@@ -201,8 +201,12 @@ class QueryEngine:
         counts = (
             lowered.calibrate_host() if exact_counts or analyze else None
         )
+        from kolibrie_tpu.optimizer import mqo
+
+        mqo_line = mqo.describe_shared(self.db, lowered)
         if not analyze:
-            return lowered.describe(counts)
+            out = lowered.describe(counts)
+            return out + "\n" + mqo_line if mqo_line else out
         from kolibrie_tpu.obs import analyze as obs_analyze
         from kolibrie_tpu.obs.spans import spans_snapshot, trace_scope
 
@@ -210,6 +214,8 @@ class QueryEngine:
             lowered.execute()
         rec = cap.last("device") or {}
         lines = [lowered.describe(counts, analyze=rec)]
+        if mqo_line:
+            lines.append(mqo_line)
         if rec:
             lines.append(f"source: {rec.get('source', '?')}")
             lines.append(f"rows: {rec.get('rows', '?')}")

@@ -225,6 +225,14 @@ def eval_where(
                     fused_clauses = table is not None
             if table is None:
                 table = try_device_execute(db, plan, capture=capture)
+        if table is None and not _device_routed(db):
+            # host-routed stores (RSP window stores live far below the
+            # device-routing floor) reach the MQO layer here: the shared
+            # prefix evaluates through the numpy twin and only the filter
+            # suffix runs per query (optimizer/mqo.py, docs/MQO.md)
+            from kolibrie_tpu.optimizer import mqo as _mqo
+
+            table = _mqo.try_shared_host(db, plan)
         if table is None:
             from kolibrie_tpu.obs import analyze as _obs_analyze
 
@@ -1010,6 +1018,7 @@ def _plan_cache_entry(db, sparql: str):
     occupancy and hit/miss/eviction counters.  Returns ``(entry, slot)``;
     ``entry`` carries the parsed ``cq``, ``slot`` has the
     ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
+    from kolibrie_tpu.optimizer.mqo import mqo_mode
     from kolibrie_tpu.optimizer.planner import wcoj_mode
     from kolibrie_tpu.ops.pallas_kernels import pallas_mode
     from kolibrie_tpu.query.compile_cache import record_template
@@ -1017,10 +1026,10 @@ def _plan_cache_entry(db, sparql: str):
 
     parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
-    # the join-strategy, interpreter-routing and Pallas kernel modes are
-    # part of the template fingerprint; a mode flip after parse must
-    # refingerprint (not replay the old-mode plan)
-    env_sig = (wcoj_mode(), _interp_mode(), pallas_mode())
+    # the join-strategy, interpreter-routing, Pallas kernel and MQO
+    # sharing modes are part of the template fingerprint; a mode flip
+    # after parse must refingerprint (not replay the old-mode plan)
+    env_sig = (wcoj_mode(), _interp_mode(), pallas_mode(), mqo_mode())
     ent = parse.get(sparql)
     if ent is None or ent["prefix_sig"] != prefix_sig or ent["env_sig"] != env_sig:
         ent = {
@@ -1461,10 +1470,59 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             if slot["params"] == ent["params"] and slot["lowered"] is None:
                 slot["plan"], slot["lowered"] = plan, lowered
             results[i] = _finish_select_table(db, q, table)
-    for i, text in enumerate(queries):
-        if results[i] is None:
-            results[i] = execute_query_volcano(text, db)
+    # multi-query sharing for the solo tail: register every still-pending
+    # member's prefix fingerprint as a transient beneficiary, so the MQO
+    # layer sees the dispatch's full fan-out before the first member runs
+    # (optimizer/mqo.py; fingerprints memoize per store version)
+    from kolibrie_tpu.optimizer import mqo as _mqo
+
+    transient_fps: List[str] = []
+    pending = [i for i in range(len(queries)) if results[i] is None]
+    if len(pending) >= 2 and _mqo.mqo_mode() != "off":
+        for i in pending:
+            fp = _solo_prefix_fp(db, queries[i])
+            if fp is not None:
+                transient_fps.append(fp)
+    with _mqo.transient_scope(db, transient_fps):
+        for i, text in enumerate(queries):
+            if results[i] is None:
+                results[i] = execute_query_volcano(text, db)
     return results
+
+
+def _solo_prefix_fp(db, text: str) -> Optional[str]:
+    """MQO prefix fingerprint for one batch member, or None when the
+    query is outside the batchable/shareable shape.  Never raises: a
+    member that fails here simply isn't registered as a beneficiary, and
+    the solo loop reports its real error in input order."""
+    from kolibrie_tpu.optimizer import mqo as _mqo
+    from kolibrie_tpu.optimizer.device_engine import Unsupported, lower_plan
+
+    try:
+        ent, _slot = _plan_cache_entry(db, text)
+        eligible = _batchable_select(db, ent["cq"])
+        if eligible is None:
+            return None
+        _q, w = eligible
+
+        def _lower():
+            try:
+                resolved = [resolve_pattern(db, p) for p in w.patterns]
+                logical = build_logical_plan(
+                    resolved, list(w.filters), [], None
+                )
+                planner = Streamertail(db.get_or_build_stats())
+                return lower_plan(db, planner.find_best_plan(logical))
+            except Unsupported:
+                return None
+
+        return _mqo.prefix_fp_for(db, ent["fp"], _lower)
+    except Exception:
+        # registration is best-effort routing state; the member's actual
+        # evaluation surfaces any real error — but the miss is counted so
+        # a systematically failing registration path stays visible
+        _mqo._DECLINED.labels("fp_error").inc()
+        return None
 
 
 def collect_all_patterns(where: WhereClause) -> List[PatternTriple]:

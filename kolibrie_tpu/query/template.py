@@ -160,16 +160,24 @@ def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
     ``KOLIBRIE_PALLAS`` is the third member: the kernel-vs-XLA routing is
     a static argument of the compiled plan body, and the cap advisor keys
     its high-water marks on the fingerprint — a mode flip must replan AND
-    re-learn in a fresh slot, never replay a stale one."""
+    re-learn in a fresh slot, never replay a stale one.  ``KOLIBRIE_MQO``
+    is the fourth: shared-prefix routing changes which engine produces a
+    template's rows, so a mode flip must land in a fresh fingerprint
+    (``off`` reproduces pre-MQO behavior bit-for-bit, docs/MQO.md)."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode  # lazy: avoids cycle
+    from kolibrie_tpu.optimizer.mqo import mqo_mode
     from kolibrie_tpu.optimizer.plan_interp import plan_interp_mode
     from kolibrie_tpu.ops.pallas_kernels import pallas_mode
 
     params: List[Any] = []
     structure = (
-        "interp",
-        plan_interp_mode(),
-        ("pallas", pallas_mode(), ("wcoj", wcoj_mode(), _ser(cq, params))),
+        "mqo",
+        mqo_mode(),
+        (
+            "interp",
+            plan_interp_mode(),
+            ("pallas", pallas_mode(), ("wcoj", wcoj_mode(), _ser(cq, params))),
+        ),
     )
     return structure, tuple(params)
 

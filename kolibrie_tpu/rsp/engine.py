@@ -323,8 +323,17 @@ class RSPEngine:
             or self.cross_window_enabled
         )
 
+        from kolibrie_tpu.optimizer import mqo as _mqo
+
         self.windows: List[WindowRunner] = []
         for cfg in window_configs:
+            # every standing window registers with the store's MQO prefix
+            # registry: same-prefix windows share one prefix evaluation
+            # per fire round, and fires against an unchanged store skip
+            # it entirely (optimizer/mqo.py, docs/MQO.md).  The runner's
+            # on_stop unregisters, so stopped windows stop counting as
+            # sharing beneficiaries.
+            _mqo.register_standing(self.r2r.db, cfg.window_iri)
             runner = WindowRunner(
                 WindowSpec(
                     cfg.window_iri,
@@ -333,6 +342,12 @@ class RSPEngine:
                     cfg.slide,
                     cfg.report,
                     cfg.tick,
+                    standing_owner=cfg.window_iri,
+                    on_stop=(
+                        lambda db=self.r2r.db, owner=cfg.window_iri: (
+                            _mqo.unregister_standing(db, owner)
+                        )
+                    ),
                 )
             )
             self.windows.append(runner)
@@ -378,7 +393,14 @@ class RSPEngine:
                         prev_window_triples.append(item)
                         self.r2r.add(item)
                     self.r2r.materialize()
-                results = self.r2r.execute_query(cfg.query)
+                # fire-time sharing: inside this scope the MQO layer
+                # treats the evaluation as this window's standing query,
+                # binding its prefix fingerprint lazily (constants may
+                # resolve differently as the dictionary grows)
+                from kolibrie_tpu.optimizer import mqo as _mqo
+
+                with _mqo.standing_scope(self.r2r.db, cfg.window_iri):
+                    results = self.r2r.execute_query(cfg.query)
             if self._has_joins:
                 mapped = [dict(row) for row in results]
                 self._result_queue.put(WindowResult(cfg.window_iri, mapped, ts))
@@ -883,6 +905,13 @@ class RSPEngine:
         return {
             "windows": [s.snapshot() for s in getattr(self, "supervisors", [])]
         }
+
+    def mqo_stats(self) -> dict:
+        """Shared-prefix registry snapshot for this engine's store
+        (standing registrations, per-prefix beneficiaries/actuals/hits)."""
+        from kolibrie_tpu.optimizer import mqo as _mqo
+
+        return _mqo.stats(self.r2r.db)
 
     def stop(self) -> None:
         for runner in self.windows:

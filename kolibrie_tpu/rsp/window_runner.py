@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from kolibrie_tpu.rsp.s2r import CSPARQLWindow, Report, ReportStrategy, Tick
 
@@ -16,6 +16,13 @@ class WindowSpec:
     slide: int
     report: str = ReportStrategy.ON_WINDOW_CLOSE
     tick: str = Tick.TIME_DRIVEN
+    # standing-query registration token: the RSP engine registers the
+    # window's query under this owner with the store's MQO prefix
+    # registry (optimizer/mqo.py, docs/MQO.md); ``on_stop`` unregisters
+    # it when the runner's lifecycle ends, so a stopped window never
+    # counts as a sharing beneficiary
+    standing_owner: Optional[str] = None
+    on_stop: Optional[Callable[[], None]] = None
 
 
 class WindowRunner:
@@ -41,3 +48,5 @@ class WindowRunner:
 
     def stop(self) -> None:
         self.window.stop()
+        if self.spec.on_stop is not None:
+            self.spec.on_stop()

@@ -199,6 +199,24 @@ def smoke() -> None:
         r.startswith("value:") for r in regs
     ), "10% noise tripped the 20% gate"
 
+    # the MQO fleet headline gates downward the moment a trajectory round
+    # carries it: per-window fire cost under shared-prefix evaluation is
+    # a latency (docs/MQO.md), so a future round that doubles it must
+    # trip the comparator exactly like any other _ms key
+    assert _direction("secondary.mqo.fleet64_shared_per_query_ms") == "down"
+    assert _direction("secondary.mqo.fleet64_marginal_ratio") is None
+    withmqo = json.loads(json.dumps(trajectory[-1]))
+    withmqo.setdefault("secondary", {})["mqo"] = {
+        "fleet64_shared_per_query_ms": 1.0
+    }
+    base = [json.loads(json.dumps(withmqo))]
+    slow = json.loads(json.dumps(withmqo))
+    slow["secondary"]["mqo"]["fleet64_shared_per_query_ms"] = 2.0
+    regs, _ = compare(slow, base)
+    assert any(
+        "mqo.fleet64_shared_per_query_ms" in r for r in regs
+    ), "missed 2x MQO fleet regression"
+
     # timeline ring end to end, against an isolated registry
     sys.path.insert(0, REPO)
     from kolibrie_tpu.obs import metrics as m

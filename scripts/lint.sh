@@ -15,6 +15,13 @@ rc=0
 echo "== kolint =="
 python -m kolibrie_tpu.analysis "$@" kolibrie_tpu/ || rc=1
 
+echo "== kolint cache-key versioning (KL901) =="
+# the rule is in the default set above; this explicit pass keeps the
+# cache-key discipline visible (and bisectable) on its own — result
+# caches keyed on store identity must fold in (base_version,
+# delta_epoch) or store.version_key() (docs/MQO.md)
+python -m kolibrie_tpu.analysis --rules KL901 kolibrie_tpu/ || rc=1
+
 echo "== compileall =="
 # -q: names only on failure; PYTHONDONTWRITEBYTECODE keeps the tree clean
 PYTHONDONTWRITEBYTECODE=1 python -m compileall -q kolibrie_tpu/ tests/ || rc=1

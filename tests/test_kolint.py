@@ -800,6 +800,80 @@ def test_kl801_suppression_with_reason(tmp_path):
     assert res.suppressed[0].rule == "KL801"
 
 
+# --------------------------------------- KL901: cache-key versioning
+
+
+BAD_KL901 = """
+_result_cache = {}
+
+def lookup(db, fp):
+    key = (id(db), fp)
+    if key in _result_cache:
+        return _result_cache[key]
+    table = run(db, fp)
+    _result_cache[key] = table
+    return table
+"""
+
+BAD_KL901_OBJ = """
+def lookup(db, fp, memo):
+    return memo.get((db, fp))
+"""
+
+GOOD_KL901 = """
+_result_cache = {}
+
+def lookup(db, fp):
+    key = (id(db), fp) + db.store.version_key()
+    if key in _result_cache:
+        return _result_cache[key]
+    table = run(db, fp)
+    _result_cache[key] = table
+    return table
+"""
+
+GOOD_KL901_COMPONENTS = """
+_result_cache = {}
+
+def lookup(db, fp):
+    key = (id(db), fp, db.store.base_version, db.store.delta_epoch)
+    _result_cache[key] = run(db, fp)
+"""
+
+GOOD_KL901_NO_IDENTITY = """
+_plan_cache = {}
+
+def lookup(text):
+    return _plan_cache.get(text)
+"""
+
+
+def test_kl901_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL901)
+    assert rules_fired(res) == ["KL901"]
+    assert "version_key" in res.findings[0].message
+
+
+def test_kl901_bare_object_key(tmp_path):
+    res = lint(tmp_path, BAD_KL901_OBJ)
+    assert rules_fired(res) == ["KL901"]
+
+
+def test_kl901_version_key_call_is_clean(tmp_path):
+    res = lint(tmp_path, GOOD_KL901)
+    assert res.findings == []
+
+
+def test_kl901_explicit_components_are_clean(tmp_path):
+    res = lint(tmp_path, GOOD_KL901_COMPONENTS)
+    assert res.findings == []
+
+
+def test_kl901_identity_free_key_is_out_of_scope(tmp_path):
+    res = lint(tmp_path, GOOD_KL901_NO_IDENTITY)
+    assert res.findings == []
+
+
 # ------------------------------------------------ suppression mechanics
 
 
