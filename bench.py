@@ -78,6 +78,322 @@ def build_db():
     return db, t_load
 
 
+# ---------------------------------------------------------------------------
+# Replication fleet (docs/REPLICATION.md): REAL server processes — one
+# primary shipping WAL segments, N followers mirroring it — measured for
+# aggregate read qps vs the single process, replication lag under
+# sustained ingest, and kill -9 → first-promoted-read failover time.
+# Callable standalone; scripts/bench_gate.py --smoke runs the reduced
+# shape (one follower, short windows) as a lint-time self-check.
+# ---------------------------------------------------------------------------
+
+
+def replication_fleet_bench(
+    note=lambda m: None,
+    fleet_sizes=(1, 2, 4),
+    read_duration_s=2.0,
+    n_universities=1,
+    n_client_threads=2,
+    lag_samples=24,
+):
+    import shutil
+    import socket
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from benches.lubm import generate_fast
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+    from kolibrie_tpu.replication.router import RouterCore
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def post(base, path, payload, timeout=120):
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def get_json(base, path, timeout=30):
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    root = tempfile.mkdtemp(prefix="kolibrie-bench-repl-")
+    procs = []
+
+    def spawn(name, extra_env):
+        port = free_port()
+        env = dict(os.environ)
+        # the fleet measures the host serving path on CPU: never inherit
+        # the parent bench's TPU tunnel or virtual-device flags
+        env.pop("XLA_FLAGS", None)
+        env.pop("KOLIBRIE_BENCH_CPU", None)
+        env.update(
+            {
+                "KOLIBRIE_DATA_DIR": os.path.join(root, name),
+                "KOLIBRIE_FSYNC": "group",
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        env.update(extra_env)
+        log = open(os.path.join(root, f"{name}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kolibrie_tpu.frontends.http_server",
+             "127.0.0.1", str(port)],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        rec = {"name": name, "proc": proc, "log": log, "port": port,
+               "base": f"http://127.0.0.1:{port}"}
+        procs.append(rec)
+        return rec
+
+    def wait_ready(rec, timeout_s=240.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if rec["proc"].poll() is not None:
+                with open(os.path.join(root, f"{rec['name']}.log"), "rb") as fh:
+                    tail = fh.read()[-1500:].decode("utf-8", "replace")
+                raise RuntimeError(f"{rec['name']} died during boot:\n{tail}")
+            try:
+                if get_json(rec["base"], "/healthz", 5).get("status") == "ready":
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        raise RuntimeError(f"{rec['name']} never became ready")
+
+    # LUBM read-heavy mix: constant-variants of two serving templates,
+    # the same worksFor/teacherOf family the sharded-serving block uses
+    _ub = "PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#> "
+    read_mix = [
+        _ub + "SELECT ?x ?c WHERE { ?x ub:worksFor "
+        f"<http://www.Department{d}.University0.edu> . "
+        "?x ub:teacherOf ?c }"
+        for d in range(8)
+    ] + [
+        _ub + "SELECT ?x ?p WHERE { ?x ub:memberOf "
+        f"<http://www.Department{d}.University0.edu> . "
+        "?x ub:advisor ?p }"
+        for d in range(8)
+    ]
+
+    # one dedicated loadgen CHILD process per node: a single client
+    # interpreter's GIL would cap the aggregate long before an N-node
+    # fleet does (each child reports its own count/duration)
+    _LOADGEN = r"""
+import json, sys, threading, time, urllib.request
+base, dur, n_threads = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
+queries = json.loads(sys.argv[4])
+stop_at = time.monotonic() + dur
+counts = [0] * n_threads
+errors = [0] * n_threads
+def worker(ti):
+    qi = ti
+    while time.monotonic() < stop_at:
+        req = urllib.request.Request(
+            base + "/store/query",
+            data=json.dumps({"store_id": "lubm",
+                             "sparql": queries[qi % len(queries)]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                ok = resp.status == 200
+                resp.read()
+        except Exception:
+            ok = False
+        counts[ti] += 1 if ok else 0
+        errors[ti] += 0 if ok else 1
+        qi += 1
+t0 = time.monotonic()
+ts = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+for t in ts: t.start()
+for t in ts: t.join()
+print(json.dumps({"count": sum(counts), "errors": sum(errors),
+                  "dt": time.monotonic() - t0}))
+"""
+
+    def measure_qps(bases, duration_s):
+        """Aggregate successful read qps: one loadgen child per node,
+        ``n_client_threads`` threads each, templates striped so every
+        node serves its own affinity slice of the mix (the router's
+        placement — docs/REPLICATION.md)."""
+        children = []
+        for i, base in enumerate(bases):
+            qs = read_mix[i::len(bases)] or read_mix
+            children.append(subprocess.Popen(
+                [sys.executable, "-c", _LOADGEN, base, str(duration_s),
+                 str(n_client_threads), json.dumps(qs)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True,
+            ))
+        qps = 0.0
+        errors = 0
+        for ch in children:
+            out, _err = ch.communicate(timeout=duration_s + 120)
+            rec = json.loads(out.strip().splitlines()[-1])
+            qps += rec["count"] / rec["dt"]
+            errors += rec["errors"]
+        return qps, errors
+
+    def pct(sorted_vals, q):
+        return sorted_vals[min(len(sorted_vals) - 1,
+                               int(round(q * (len(sorted_vals) - 1))))]
+
+    try:
+        # ---- boot the whole fleet at once (boots overlap) ----------------
+        repl_port = free_port()
+        primary = spawn("primary", {
+            "KOLIBRIE_REPL_PORT": str(repl_port),
+            "KOLIBRIE_REPL_SEAL_INTERVAL_S": "0.05",
+        })
+        followers = [
+            spawn(f"follower{i}", {
+                "KOLIBRIE_REPL_SOURCE": f"127.0.0.1:{repl_port}",
+                "KOLIBRIE_REPL_POLL_INTERVAL_S": "0.05",
+            })
+            for i in range(max(fleet_sizes))
+        ]
+        wait_ready(primary)
+        note("replication: primary up, loading LUBM")
+
+        gen_db = SparqlDatabase()
+        ls, lp, lo = generate_fast(n_universities, gen_db.dictionary)
+        gen_db.store.add_batch(ls, lp, lo)
+        nt = gen_db.to_ntriples()
+        n_triples = len(gen_db.store)
+        st, out = post(primary["base"], "/store/load",
+                       {"store_id": "lubm", "rdf": nt,
+                        "format": "ntriples", "mode": "host"})
+        assert st == 200, out
+        token = out["watermark"]
+
+        for rec in followers:
+            wait_ready(rec)
+        # every follower must cover the loaded data before reads count
+        for rec in followers:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                hz = get_json(rec["base"], "/healthz", 10)
+                wm = (hz.get("replication") or {}).get("watermark") or {}
+                if int(wm.get("applied_segment") or 0) >= token["segment"]:
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"{rec['name']} never caught up")
+        note("replication: fleet caught up, measuring")
+
+        # warm each node's parse/plan caches once per template
+        for rec in [primary] + followers:
+            for q in read_mix:
+                post(rec["base"], "/store/query",
+                     {"store_id": "lubm", "sparql": q})
+
+        block = {
+            "dataset": f"lubm{n_universities}",
+            "triples": n_triples,
+            "read_mix_templates": len(read_mix),
+            "client_threads_per_node": n_client_threads,
+            "read_window_s": read_duration_s,
+            "note": "followers serve the read mix while the primary owns "
+            "writes; on a 1-core proxy the fleet shares the core, so the "
+            "speedup lower-bounds what separate machines get",
+        }
+        single_qps, errs = measure_qps([primary["base"]], read_duration_s)
+        block["single_read_qps"] = round(single_qps, 1)
+        read_errors = errs
+        for n in fleet_sizes:
+            qps, errs = measure_qps(
+                [rec["base"] for rec in followers[:n]], read_duration_s
+            )
+            block[f"fleet{n}_read_qps"] = round(qps, 1)
+            read_errors += errs
+        if 2 in fleet_sizes and single_qps > 0:
+            block["fleet2_speedup_vs_single"] = round(
+                block["fleet2_read_qps"] / single_qps, 2
+            )
+        block["read_errors"] = read_errors
+
+        # ---- replication lag under sustained ingest ----------------------
+        # each marker batch is acked by the primary, then timed until a
+        # follower serves it: ack-to-visible wall time, p50/p99
+        lags_ms = []
+        fol0 = followers[0]
+        filler = "\n".join(
+            f"<http://bench/fill{j}> <http://bench/p> \"x{j}\" ."
+            for j in range(64)
+        )
+        for j in range(lag_samples):
+            marker = f"<http://bench/m{j}> <http://bench/mark> \"{j}\" ."
+            st, out = post(primary["base"], "/store/load",
+                           {"store_id": "lubm", "rdf": filler + "\n" + marker,
+                            "format": "ntriples"})
+            assert st == 200, out
+            t_ack = time.monotonic()
+            probe = (f"SELECT ?v WHERE {{ <http://bench/m{j}> "
+                     "<http://bench/mark> ?v }")
+            while True:
+                st, res = post(fol0["base"], "/store/query",
+                               {"store_id": "lubm", "sparql": probe})
+                if st == 200 and res.get("data"):
+                    lags_ms.append((time.monotonic() - t_ack) * 1000.0)
+                    break
+                if time.monotonic() - t_ack > 30.0:
+                    lags_ms.append(30_000.0)
+                    break
+                time.sleep(0.01)
+        lags_ms.sort()
+        block["repl_lag_p50_ms"] = round(pct(lags_ms, 0.50), 1)
+        block["repl_lag_p99_ms"] = round(pct(lags_ms, 0.99), 1)
+
+        # ---- failover: kill -9 the primary mid-ingest --------------------
+        # time from SIGKILL to the FIRST successful read answered by the
+        # promoted follower (probe + promote + serve, the whole path)
+        post(primary["base"], "/store/load",
+             {"store_id": "lubm", "rdf": filler, "format": "ntriples"})
+        t_kill = time.monotonic()
+        primary["proc"].kill()
+        core = RouterCore(
+            [(rec["name"], rec["base"]) for rec in [primary] + followers],
+            probe_timeout_s=2.0, evict_after=1, promote_after=1,
+            promote_cooldown_s=0.0,
+        )
+        failover_ms = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            core.probe_once()
+            prom = core.primary()
+            if prom is not None and prom.name != "primary":
+                st, _res = post(prom.url, "/store/query",
+                                {"store_id": "lubm", "sparql": read_mix[0]})
+                if st == 200:
+                    failover_ms = (time.monotonic() - t_kill) * 1000.0
+                    break
+            time.sleep(0.02)
+        if failover_ms is None:
+            raise RuntimeError(f"failover never completed: {core.stats()}")
+        block["failover_ms"] = round(failover_ms, 1)
+        block["promoted"] = core.primary().name
+        return block
+    finally:
+        for rec in procs:
+            if rec["proc"].poll() is None:
+                rec["proc"].kill()
+                rec["proc"].wait(timeout=30)
+            rec["log"].close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     import jax
 
@@ -1191,6 +1507,17 @@ def main():
         os.environ.pop("KOLIBRIE_MQO", None)
     note(f"mqo sweep done ({mqo_block})")
 
+    # ---- replication fleet: WAL-shipped read replicas + failover ---------
+    # ISSUE-17 acceptance: aggregate read qps of N followers vs the single
+    # process, p99 ack-to-visible replication lag under sustained ingest,
+    # and kill -9 → first-promoted-read failover time.
+    note("replication fleet sweep")
+    try:
+        replication_block = replication_fleet_bench(note=note)
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        replication_block = {"error": repr(e)}
+    note(f"replication fleet done ({replication_block})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -1258,6 +1585,7 @@ def main():
                     "sharded_serving": sharded_block,
                     "compile_tail": compile_tail,
                     "mqo": mqo_block,
+                    "replication": replication_block,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "
                     "plan cached automatically on the database (round 5), "

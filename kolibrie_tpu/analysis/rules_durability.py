@@ -14,6 +14,17 @@ KL701  a write-mode ``open()`` call in a durability-tagged module
        ``fsio.py`` itself is exempt — it IS the idiom.  Append-mode
        WAL segment streams carry an explicit suppression with the
        reason (``# kolint: ignore[KL701] ...``).
+
+KL702  WAL frame parsing outside the sanctioned packages.  The
+       ``KWALSEG1`` frame layout (u32 len | u32 crc | payload) is owned
+       by ``durability/wal.py`` and shared with ``replication/`` (the
+       ship protocol IS the frame format); everyone else goes through
+       the frame API — ``wal.read_frame`` / ``wal.encode_record`` /
+       ``wal.scan_segment_file`` — so a layout change (or the CRC/
+       truncation discipline) has exactly one home.  Flagged: importing
+       underscore internals from ``durability.wal``, and raw
+       ``struct.unpack``/``Struct(...)`` calls in a module that names
+       the ``KWALSEG`` magic.
 """
 
 from __future__ import annotations
@@ -22,7 +33,7 @@ import ast
 from typing import List
 
 from kolibrie_tpu.analysis.core import Finding, rule
-from kolibrie_tpu.analysis.project import Project
+from kolibrie_tpu.analysis.project import Project, terminal_name
 
 _MARKER = "durable-path"
 _WRITE_CHARS = ("w", "a", "x", "+")
@@ -91,4 +102,87 @@ def durable_write_path(project: Project) -> List[Finding]:
                     "(temp → fsync → rename) so a crash never tears it",
                 )
             )
+    return out
+
+
+_FRAME_ZONE = ("durability/", "replication/")
+_UNPACK_NAMES = ("unpack", "unpack_from", "iter_unpack", "Struct")
+
+
+def _in_frame_zone(f) -> bool:
+    return any(
+        f"/{zone}" in f.rel or f.rel.startswith(zone) for zone in _FRAME_ZONE
+    )
+
+
+def _names_wal_magic(f) -> bool:
+    """The module mentions the ``KWALSEG`` segment magic in a literal —
+    the telltale of hand-rolled frame parsing."""
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bytes):
+                try:
+                    v = v.decode("ascii")
+                except UnicodeDecodeError:
+                    continue
+            if isinstance(v, str) and "KWALSEG" in v:
+                return True
+    return False
+
+
+@rule(
+    "KL702",
+    "WAL frame bytes parsed outside durability/ + replication/ — go "
+    "through the frame API (wal.read_frame / wal.encode_record / "
+    "wal.scan_segment_file) so the KWALSEG1 layout has one owner",
+)
+def wal_frame_api(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or _in_frame_zone(f):
+            continue
+        # (a) importing the wal module's underscore internals (_FRAME,
+        # _META_LEN, _scan_segment, ...) couples the importer to layout
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.endswith("durability.wal")
+            ):
+                for alias in node.names:
+                    if alias.name.startswith("_"):
+                        out.append(
+                            Finding(
+                                "KL702",
+                                f.rel,
+                                node.lineno,
+                                f"importing frame internal "
+                                f"{alias.name!r} from durability.wal — "
+                                "use the public frame API "
+                                "(read_frame/encode_record/"
+                                "scan_segment_file)",
+                            )
+                        )
+        # (b) raw struct unpacking in a module that names the magic:
+        # hand-rolled KWALSEG1 parsing that will rot when the layout,
+        # CRC, or truncation discipline changes
+        if not _names_wal_magic(f):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name in _UNPACK_NAMES:
+                out.append(
+                    Finding(
+                        "KL702",
+                        f.rel,
+                        node.lineno,
+                        f"raw struct {name}() beside the KWALSEG magic — "
+                        "WAL frames are read via wal.read_frame / "
+                        "wal.scan_segment_file, never unpacked by hand "
+                        "outside durability/ + replication/",
+                    )
+                )
     return out

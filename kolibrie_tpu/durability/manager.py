@@ -288,6 +288,51 @@ class RecoveryResult:
         self.stats: Dict[str, object] = {}
 
 
+def replay_records(res: "RecoveryResult", records) -> None:
+    """Apply WAL records (``(meta, tail)`` pairs, in order) onto a
+    :class:`RecoveryResult`.  Shared by crash recovery and the
+    replication follower's shipped-segment apply path — the record-kind
+    dispatch must never fork between the two.
+
+    Replay is IDEMPOTENT: adds are set-semantic, deletes of absent rows
+    no-op, and dictionary growth blocks skip the already-applied overlap
+    — so overlapping or duplicated delivery of a segment is safe."""
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    for meta, tail in records:
+        kind = meta.get("k")
+        if kind == "mut":
+            sid = str(meta.get("st"))
+            db = res.stores.get(sid)
+            if db is None:
+                db = SparqlDatabase()
+                db.execution_mode = res.modes.get(sid, "auto")
+                res.stores[sid] = db
+            _apply_mutation(db, meta, tail)
+        elif kind == "store":
+            sid = str(meta.get("st"))
+            res.modes[sid] = meta.get("mode") or "auto"
+            if sid in res.stores:
+                res.stores[sid].execution_mode = res.modes[sid]
+            else:
+                db = SparqlDatabase()
+                db.execution_mode = res.modes[sid]
+                res.stores[sid] = db
+        elif kind == "sess":
+            res.sessions[str(meta.get("sid"))] = {
+                "register": meta.get("cfg") or {},
+                "state": None,
+            }
+        elif kind == "sck":
+            rec = res.sessions.setdefault(
+                str(meta.get("sid")), {"register": {}, "state": None}
+            )
+            rec["state"] = tail
+        elif kind == "sdel":
+            res.sessions.pop(str(meta.get("sid")), None)
+        # unknown kinds are skipped: forward-compatible replay
+
+
 # ------------------------------------------------------------------ manager
 
 
@@ -386,6 +431,17 @@ class DurabilityManager:
                 }
         return manifest, stores, sessions
 
+    def load_generation(
+        self, gen: int
+    ) -> Tuple[dict, Dict[str, object], Dict[str, dict]]:
+        """Public CRC-verified generation load — the replication follower
+        restores from a just-shipped generation through this."""
+        return self._load_generation(gen)
+
+    def generation_dir(self, gen: int) -> str:
+        """Path of one generation's directory (ship source/target)."""
+        return self._gen_path(gen)
+
     # -------------------------------------------------------------- recovery
 
     def recover(self) -> RecoveryResult:
@@ -422,38 +478,7 @@ class DurabilityManager:
                 shutil.rmtree(os.path.join(self.snap_dir, name), ignore_errors=True)
         wal_start = int(manifest.get("wal_start", 1)) if manifest else 1
         records, scan = scan_wal(self.wal_dir, start_segment=wal_start)
-        for meta, tail in records:
-            kind = meta.get("k")
-            if kind == "mut":
-                sid = str(meta.get("st"))
-                db = res.stores.get(sid)
-                if db is None:
-                    db = SparqlDatabase()
-                    db.execution_mode = res.modes.get(sid, "auto")
-                    res.stores[sid] = db
-                _apply_mutation(db, meta, tail)
-            elif kind == "store":
-                sid = str(meta.get("st"))
-                res.modes[sid] = meta.get("mode") or "auto"
-                if sid in res.stores:
-                    res.stores[sid].execution_mode = res.modes[sid]
-                else:
-                    db = SparqlDatabase()
-                    db.execution_mode = res.modes[sid]
-                    res.stores[sid] = db
-            elif kind == "sess":
-                res.sessions[str(meta.get("sid"))] = {
-                    "register": meta.get("cfg") or {},
-                    "state": None,
-                }
-            elif kind == "sck":
-                rec = res.sessions.setdefault(
-                    str(meta.get("sid")), {"register": {}, "state": None}
-                )
-                rec["state"] = tail
-            elif kind == "sdel":
-                res.sessions.pop(str(meta.get("sid")), None)
-            # unknown kinds are skipped: forward-compatible replay
+        replay_records(res, records)
         for sid, db in res.stores.items():
             db.store.compact()
             res.modes.setdefault(sid, db.execution_mode)

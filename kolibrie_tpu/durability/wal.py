@@ -304,6 +304,27 @@ class WalWriter:
             self._rotate_locked()
             return self.segment
 
+    def seal_if_dirty(self) -> Optional[int]:
+        """Rotate ONLY if the active segment holds records; returns the
+        sealed (now-immutable) segment index, or None if there was
+        nothing to seal.  The replication shipper calls this so followers
+        can pull the tail of the log without shipping half-open files —
+        sealed segments never change, which is what makes whole-file CRC
+        shipping sound."""
+        with self._lock:
+            if self._fh is None or self._size <= len(SEG_MAGIC):
+                return None
+            sealed = self.segment
+            self._rotate_locked()
+            return sealed
+
+    def position(self) -> Tuple[int, int]:
+        """Durable high-water mark ``(segment, byte_offset)`` of the
+        active segment — the watermark token handed to clients for
+        read-your-writes and shown in ``/healthz``."""
+        with self._lock:
+            return self.segment, self._size
+
     def _rotate_locked(self) -> None:  # kolint: holds[_lock]
         self._fh.flush()
         if self.fsync_policy != "never":
@@ -364,6 +385,47 @@ class ScanStats:
         }
 
 
+def read_frame(fh) -> Optional[Tuple[dict, bytes]]:
+    """THE frame API (with :func:`encode_record`): read one record frame
+    from a binary stream positioned at a frame boundary and return
+    ``(meta, tail)``, or ``None`` at clean EOF.
+
+    Raises :class:`DurabilityError` naming the corruption (torn header,
+    torn payload, crc mismatch, …) — callers that can retry (the
+    replication shipper reconnects and re-requests) handle it; the
+    recovery scanner uses :func:`scan_wal`, which truncates instead.
+    Works over any blocking binary stream — segment files and
+    ``socket.makefile("rb")`` alike (``BufferedReader.read(n)`` returns
+    exactly ``n`` bytes unless the stream ends).  Code outside
+    ``durability/`` + ``replication/`` must come through here rather
+    than unpacking ``KWALSEG1`` frames by hand (kolint KL702)."""
+    hdr = fh.read(_FRAME.size)
+    if not hdr:
+        return None  # clean EOF
+    if len(hdr) < _FRAME.size:
+        raise DurabilityError("torn frame header")
+    plen, crc = _FRAME.unpack(hdr)
+    if plen > MAX_RECORD_BYTES:
+        raise DurabilityError("implausible record length")
+    payload = fh.read(plen)
+    if len(payload) < plen:
+        raise DurabilityError("torn record payload")
+    if zlib.crc32(payload) != crc:
+        raise DurabilityError("crc mismatch")
+    if plen < _META_LEN.size:
+        raise DurabilityError("short payload")
+    (mlen,) = _META_LEN.unpack_from(payload)
+    if _META_LEN.size + mlen > plen:
+        raise DurabilityError("meta overruns payload")
+    try:
+        meta = json.loads(
+            payload[_META_LEN.size : _META_LEN.size + mlen].decode("utf-8")
+        )
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        raise DurabilityError("undecodable meta")
+    return meta, payload[_META_LEN.size + mlen :]
+
+
 def _scan_segment(path: str) -> Tuple[List[Tuple[dict, bytes]], int, Optional[str]]:
     """Read one segment; returns ``(records, good_end_offset, corrupt_reason)``.
     ``corrupt_reason`` is None iff the file ended cleanly on a record
@@ -375,32 +437,25 @@ def _scan_segment(path: str) -> Tuple[List[Tuple[dict, bytes]], int, Optional[st
             return records, 0, "bad segment magic"
         good = fh.tell()
         while True:
-            hdr = fh.read(_FRAME.size)
-            if not hdr:
-                return records, good, None  # clean EOF
-            if len(hdr) < _FRAME.size:
-                return records, good, "torn frame header"
-            plen, crc = _FRAME.unpack(hdr)
-            if plen > MAX_RECORD_BYTES:
-                return records, good, "implausible record length"
-            payload = fh.read(plen)
-            if len(payload) < plen:
-                return records, good, "torn record payload"
-            if zlib.crc32(payload) != crc:
-                return records, good, "crc mismatch"
-            if plen < _META_LEN.size:
-                return records, good, "short payload"
-            (mlen,) = _META_LEN.unpack_from(payload)
-            if _META_LEN.size + mlen > plen:
-                return records, good, "meta overruns payload"
             try:
-                meta = json.loads(
-                    payload[_META_LEN.size : _META_LEN.size + mlen].decode("utf-8")
-                )
-            except (UnicodeDecodeError, json.JSONDecodeError):
-                return records, good, "undecodable meta"
-            records.append((meta, payload[_META_LEN.size + mlen :]))
+                rec = read_frame(fh)
+            except DurabilityError as exc:
+                return records, good, str(exc)
+            if rec is None:
+                return records, good, None  # clean EOF
+            records.append(rec)
             good = fh.tell()
+
+
+def scan_segment_file(
+    path: str,
+) -> Tuple[List[Tuple[dict, bytes]], int, Optional[str]]:
+    """Public per-segment scan for replication: ``(records,
+    good_end_offset, corrupt_reason)``.  Unlike :func:`scan_wal` this
+    inspects exactly one file and never truncates — the follower decides
+    whether a torn tail means "refetch the whole segment" (shipped files
+    land atomically, so local tears are pre-crash debris)."""
+    return _scan_segment(path)
 
 
 def scan_wal(

@@ -47,6 +47,7 @@ from kolibrie_tpu.resilience.errors import (
     DeadlineExceeded,
     KolibrieError,
     NotFound,
+    NotPrimary,
     Overloaded,
     QueryError,
     RequestTooLarge,
@@ -497,7 +498,9 @@ class TemplateBatcher:
 
 
 class _ServerState:
-    def __init__(self, data_dir: Optional[str] = None):
+    def __init__(
+        self, data_dir: Optional[str] = None, role: str = "primary"
+    ):
         self.sessions: Dict[str, EngineSession] = {}  # guarded by: lock
         self.stores: Dict[str, TemplateBatcher] = {}  # guarded by: lock
         self.lock = threading.Lock()
@@ -509,6 +512,15 @@ class _ServerState:
         self.durability = None
         self.recovery_stats: dict = {}
         self.prewarmer = None  # set by make_server
+        # replication role lifecycle: "primary" | "follower"; a follower
+        # becomes primary via /admin/promote.  ``replication`` is the
+        # ShipServer (primary) or ReplicationFollower (follower), or None
+        # when this node is a plain single-process server.
+        self.role = role
+        self.replication = None
+        self.primary_hint = ""  # follower: where writes should go
+        self.repl_port: Optional[int] = None  # ship port (this or promoted)
+        self.repl_seal_interval_s = 0.25
         # the persistent compilation cache must be live BEFORE the first
         # lowering this process performs — including recovery's own WAL
         # replay dispatches, which should hit artifacts a previous
@@ -516,10 +528,16 @@ class _ServerState:
         from kolibrie_tpu.query import compile_cache
 
         compile_cache.enable(data_dir=data_dir)
-        if data_dir:
+        if data_dir and role == "primary":
             from kolibrie_tpu.durability import DurabilityManager
 
             self.durability = DurabilityManager(data_dir)
+            self.status = "recovering"
+        elif role == "follower":
+            # the follower's OWN DurabilityManager lives inside the
+            # ReplicationFollower (it is never started — the follower
+            # journals nothing until promotion); the gate stays closed
+            # until the first bootstrap completes
             self.status = "recovering"
 
 
@@ -533,6 +551,33 @@ def _recover_server_state(state: _ServerState) -> None:
     # (thread-locals do not cross the make_server -> worker hop)
     with trace_scope(None):
         _recover_server_state_traced(state)
+
+
+def _rebuild_sessions(
+    state: _ServerState, sessions: Dict[str, dict]
+) -> Tuple[Dict[str, str], int]:
+    """Rebuild live /rsp sessions from recovered CONFIGURATION + state
+    blobs (shared by startup recovery and follower promotion).  Returns
+    (per-session failures, highest numeric session id seen)."""
+    failures: Dict[str, str] = {}
+    max_id = 0
+    for sid, rec in sessions.items():
+        reg = rec.get("register") or {}
+        if not reg.get("query"):
+            failures[sid] = "no CONFIGURATION logged (checkpoint only)"
+            continue
+        try:
+            _, session, _ = _build_session(
+                state, reg, restore_blob=rec.get("state"), session_id=sid
+            )
+            session.recovered = True
+            session.last_checkpoint = rec.get("state")
+        except Exception as e:
+            failures[sid] = repr(e)
+            continue
+        if sid.isdigit():
+            max_id = max(max_id, int(sid))
+    return failures, max_id
 
 
 def _recover_server_state_traced(state: _ServerState) -> None:
@@ -559,22 +604,8 @@ def _recover_server_state_traced(state: _ServerState) -> None:
                 max_id = max(max_id, int(m.group(1)))
         with state.lock:
             state.stores.update(batchers)
-        for sid, rec in result.sessions.items():
-            reg = rec.get("register") or {}
-            if not reg.get("query"):
-                failures[sid] = "no CONFIGURATION logged (checkpoint only)"
-                continue
-            try:
-                _, session, _ = _build_session(
-                    state, reg, restore_blob=rec.get("state"), session_id=sid
-                )
-                session.recovered = True
-                session.last_checkpoint = rec.get("state")
-            except Exception as e:
-                failures[sid] = repr(e)
-                continue
-            if sid.isdigit():
-                max_id = max(max_id, int(sid))
+        failures, max_sess = _rebuild_sessions(state, result.sessions)
+        max_id = max(max_id, max_sess)
         stats = dict(result.stats)
     except Exception as e:
         # recovery must never wedge the server closed: serve empty, but
@@ -633,6 +664,51 @@ def _maybe_snapshot(state: _ServerState) -> None:
         # a failed snapshot never fails the request that tripped it; the
         # WAL keeps growing and the next request retries
         _DURABILITY_ERRORS.labels("snapshot").inc()
+
+
+def _make_follower(
+    state: _ServerState,
+    data_dir: str,
+    source: str,
+    poll_interval_s: float = 0.15,
+):
+    """Wire a :class:`ReplicationFollower` into the serving state: every
+    store the replay surfaces gets a TemplateBatcher (or its db refreshed
+    after a re-bootstrap), and replay serializes against the batcher's
+    dispatch lock so reads never observe a half-applied segment."""
+    from kolibrie_tpu.replication.follower import ReplicationFollower
+
+    host, _, port = source.rpartition(":")
+
+    def _lock_for(sid):
+        with state.lock:
+            b = state.stores.get(sid)
+        return b.dispatch_lock if b is not None else None
+
+    def _on_store_update(sid, db, created):
+        with state.lock:
+            b = state.stores.get(sid)
+            if b is None:
+                state.stores[sid] = TemplateBatcher(db)
+                b = None
+        if b is not None and b.db is not db:
+            # re-bootstrap replaced the store object: swap it in under
+            # the dispatch lock so in-flight queries finish on the old db
+            with b.dispatch_lock:
+                b.db = db
+        _maybe_attach_sharded(db)
+
+    follower = ReplicationFollower(
+        data_dir,
+        host or "127.0.0.1",
+        int(port),
+        poll_interval_s=poll_interval_s,
+        on_store_update=_on_store_update,
+        lock_for=_lock_for,
+    )
+    state.replication = follower
+    state.primary_hint = source
+    return follower
 
 
 def _build_rsp_engine(
@@ -881,10 +957,24 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         "/rsp/push": "_handle_rsp_push",
         "/rsp/checkpoint": "_handle_rsp_checkpoint",
         "/rsp/restore": "_handle_rsp_restore",
+        "/admin/promote": "_handle_admin_promote",
         "/debug/profile": "_handle_debug_profile",
         "/debug/prewarm": "_handle_debug_prewarm",
         "/debug/explain": "_handle_debug_explain",
     }
+
+    # a follower serves reads at bounded staleness; writes belong on the
+    # primary (409 not_primary re-aims the router's role map)
+    _MUTATING_ROUTES = frozenset(
+        {
+            "/store/load",
+            "/rsp-query",
+            "/rsp/register",
+            "/rsp/push",
+            "/rsp/checkpoint",
+            "/rsp/restore",
+        }
+    )
 
     def do_POST(self):
         path = self.path.partition("?")[0]
@@ -910,6 +1000,15 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                     phase = self.state.status
                     if phase != "ready":
                         raise Unavailable(phase=phase)
+                    if (
+                        self.state.role != "primary"
+                        and path in self._MUTATING_ROUTES
+                    ):
+                        # follower (or mid-promotion candidate): writes
+                        # re-aim at the primary via the router's role map
+                        raise NotPrimary(
+                            primary_hint=self.state.primary_hint
+                        )
                     getattr(self, name)()
                 except Exception as e:
                     # single choke point: handlers raise taxonomy errors
@@ -1055,9 +1154,18 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         except Exception as e:
             raise BadRequest(f"RDF parse error: {e}") from e
         _maybe_snapshot(state)
-        self._send_json(
-            {"store_id": sid, "loaded": n, "triples": len(batcher.db.store)}
-        )
+        body = {
+            "store_id": sid,
+            "loaded": n,
+            "triples": len(batcher.db.store),
+        }
+        if state.durability is not None and state.durability.wal is not None:
+            # read-your-writes token: a follower that has applied this
+            # segment holds this write (segments seal whole — see
+            # replication/primary.py)
+            seg, off = state.durability.wal.position()
+            body["watermark"] = {"segment": seg, "offset": off}
+        self._send_json(body)
 
     def _handle_store_query(self):
         """Query a persistent store through the template batcher:
@@ -1084,6 +1192,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             batcher = state.stores.get(str(req.get("store_id") or ""))
         if batcher is None:
             raise NotFound("store not found")
+        self._check_min_watermark(req.get("min_watermark"))
         start = time.perf_counter()
         analysis = None
         with state.admission.admitted_scope(), deadline_scope(
@@ -1124,14 +1233,118 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         same source of truth as TemplateBatcher.stats()."""
         self._send_json(obs_export.build_stats(self.state))
 
+    def _check_min_watermark(self, min_wm) -> None:
+        """Read-your-writes: the client passes back the ``watermark``
+        token a write returned; a follower that has not yet applied that
+        segment answers 503 ``catching_up`` (+ jittered Retry-After) so
+        the router tries the next replica instead of serving stale
+        rows.  The primary trivially satisfies its own tokens."""
+        if min_wm is None:
+            return
+        try:
+            want = (
+                int(min_wm.get("segment", 0))
+                if isinstance(min_wm, dict)
+                else int(min_wm)
+            )
+        except (TypeError, ValueError, AttributeError):
+            raise BadRequest(f"invalid min_watermark: {min_wm!r}")
+        state = self.state
+        if state.role != "follower":
+            return
+        repl = state.replication
+        applied = repl.applied_segment if repl is not None else -1
+        if applied < want:
+            raise Unavailable(
+                "follower behind requested watermark "
+                f"(applied={applied} < {want})",
+                phase="catching_up",
+            )
+
+    def _handle_admin_promote(self):
+        """Promote this follower to primary (the router's supervisor, or
+        an operator, POSTs here after the old primary dies).  Highest
+        durable watermark wins ACROSS candidates — that choice is the
+        caller's; this node just finalizes: stop replicating, truncate
+        unapplied local segments, open a fresh WAL segment, attach the
+        stores, rebuild /rsp sessions, and (if configured) start shipping
+        to the next generation of followers."""
+        state = self.state
+        with state.lock:
+            repl = state.replication
+            eligible = state.role == "follower" and repl is not None
+            if eligible:
+                # claim the transition under the lock: concurrent
+                # /admin/promote posts must not double-finalize
+                state.role = "candidate"
+        if not eligible:
+            self._send_json(
+                {
+                    "role": state.role,
+                    "promoted": False,
+                    "watermark": (
+                        repl.watermark() if repl is not None else {}
+                    ),
+                }
+            )
+            return
+        wm = repl.promote()
+        state.durability = repl.manager
+        failures, max_sess = _rebuild_sessions(state, repl.res.sessions)
+        import re
+
+        max_id = max_sess
+        with state.lock:
+            for sid in state.stores:
+                m = re.fullmatch(r"store-(\d+)", sid)
+                if m:
+                    max_id = max(max_id, int(m.group(1)))
+            state.counter = itertools.count(max_id + 1)
+            state.role = "primary"
+            state.primary_hint = ""
+            if failures:
+                state.recovery_stats = dict(
+                    state.recovery_stats, session_failures=failures
+                )
+        if state.repl_port is not None:
+            from kolibrie_tpu.replication.primary import ShipServer
+
+            state.replication = ShipServer(
+                state.durability,
+                port=state.repl_port,
+                seal_interval_s=state.repl_seal_interval_s,
+            )
+        else:
+            state.replication = None
+        self._send_json(
+            {"role": "primary", "promoted": True, "watermark": wm}
+        )
+
     def _handle_healthz(self):
         """Readiness probe: 200 ``ready`` / 503 ``recovering``/``draining``
-        (Docker HEALTHCHECK and the chaos harness poll this)."""
+        (Docker HEALTHCHECK, the router's prober, and the chaos harness
+        poll this).  Always carries the role and the store/WAL watermark —
+        single-process servers included, so one curl answers 'what have
+        you durably got' everywhere."""
         state = self.state
-        body = {"status": state.status}
+        body = {"status": state.status, "role": state.role}
+        with state.lock:
+            batchers = dict(state.stores)
+        wm: dict = {
+            "stores": {
+                sid: list(b.db.store.version_key())
+                for sid, b in sorted(batchers.items())
+            }
+        }
         if state.durability is not None:
             body["durability"] = state.durability.stats()
             body["recovery"] = state.recovery_stats
+            if state.durability.wal is not None:
+                seg, off = state.durability.wal.position()
+                wm["durable_wal"] = {"segment": seg, "offset": off}
+        body["watermark"] = wm
+        if state.replication is not None:
+            body["replication"] = state.replication.stats()
         self._send_json(body, 200 if state.status == "ready" else 503)
 
     def _handle_rsp_results(self, session_id: str):
@@ -1519,13 +1732,26 @@ def make_server(
     quiet: bool = False,
     data_dir: Optional[str] = None,
     recover_async: bool = True,
+    repl_port: Optional[int] = None,
+    repl_source: Optional[str] = None,
+    repl_poll_interval_s: float = 0.15,
+    repl_seal_interval_s: float = 0.25,
 ):
     """Build the HTTP server.  With ``data_dir`` the server is durable:
     every store mutation batch and session checkpoint rides the WAL, and
     boot runs crash recovery (latest valid snapshot + WAL replay) before
     the gate opens — on a background thread by default so the socket
-    binds immediately and serves 503 + Retry-After while replaying."""
-    state = _ServerState(data_dir=data_dir)
+    binds immediately and serves 503 + Retry-After while replaying.
+
+    Replication (docs/REPLICATION.md): ``repl_port`` starts a WAL-segment
+    ship server on a durable primary (followers pull from it);
+    ``repl_source`` ("host:port" of a primary's ship server) boots this
+    node as a read-only follower of that primary instead — ``data_dir``
+    is then the follower's own mirror directory."""
+    role = "follower" if repl_source else "primary"
+    state = _ServerState(data_dir=data_dir, role=role)
+    state.repl_port = repl_port
+    state.repl_seal_interval_s = repl_seal_interval_s
     handler = type(
         "BoundHandler", (KolibrieHandler,), {"state": state, "quiet": quiet}
     )
@@ -1566,6 +1792,40 @@ def make_server(
             ).start()
         else:
             _recover_server_state(state)
+        if repl_port is not None:
+            # the ship server serves on-disk state only, so it can start
+            # before recovery finishes — followers just see the segments
+            # and generation the recovering primary already has
+            from kolibrie_tpu.replication.primary import ShipServer
+
+            state.replication = ShipServer(
+                state.durability,
+                port=repl_port,
+                seal_interval_s=repl_seal_interval_s,
+            )
+    elif role == "follower":
+        if not data_dir:
+            raise ValueError("a follower needs data_dir (its mirror)")
+        follower = _make_follower(
+            state, data_dir, repl_source,
+            poll_interval_s=repl_poll_interval_s,
+        )
+
+        def _follower_gate():
+            # the poll loop runs bootstrap; the gate opens on the first
+            # completed one and the server starts serving reads
+            follower.start()
+            while state.status == "recovering" and not follower.promoted:
+                if follower.bootstrapped:
+                    with state.lock:
+                        if state.status == "recovering":
+                            state.status = "ready"
+                    return
+                time.sleep(0.05)
+
+        threading.Thread(
+            target=_follower_gate, daemon=True, name="kolibrie-follower"
+        ).start()
     return httpd
 
 
@@ -1584,6 +1844,11 @@ def shutdown_gracefully(httpd, timeout_s: float = 30.0) -> None:
         # stop the warmer before the final snapshot: it persists the
         # manifest so the NEXT incarnation knows this one's hot set
         state.prewarmer.stop()
+    repl = state.replication
+    if repl is not None:
+        # follower: stop the poll loop; primary: close the ship listener
+        closer = getattr(repl, "stop", None) or getattr(repl, "close")
+        closer()
     if state.durability is not None:
         try:
             _snapshot_now(state)
@@ -1599,7 +1864,28 @@ def serve(host: str = "127.0.0.1", port: int = 7878) -> None:
     import signal
 
     data_dir = os.environ.get("KOLIBRIE_DATA_DIR") or None
-    httpd = make_server(host, port, data_dir=data_dir)
+    repl_port_raw = os.environ.get("KOLIBRIE_REPL_PORT") or ""
+    repl_source = os.environ.get("KOLIBRIE_REPL_SOURCE") or None
+    # chaos harnesses arm delivery faults in child processes via env
+    # (KOLIBRIE_FAULT_PLAN JSON); a no-op in production where it is unset
+    from kolibrie_tpu.resilience import faultinject
+
+    plan = faultinject.plan_from_env()
+    if plan is not None:
+        faultinject.install(plan)
+    httpd = make_server(
+        host,
+        port,
+        data_dir=data_dir,
+        repl_port=int(repl_port_raw) if repl_port_raw else None,
+        repl_source=repl_source,
+        repl_poll_interval_s=float(
+            os.environ.get("KOLIBRIE_REPL_POLL_INTERVAL_S", "0.15")
+        ),
+        repl_seal_interval_s=float(
+            os.environ.get("KOLIBRIE_REPL_SEAL_INTERVAL_S", "0.25")
+        ),
+    )
 
     def _on_sigterm(signum, frame):
         # drain on a worker thread: the handler itself must return fast,
@@ -1615,6 +1901,11 @@ def serve(host: str = "127.0.0.1", port: int = 7878) -> None:
     print(f"kolibrie-tpu server listening on http://{host}:{port}")
     if data_dir:
         print(f"durable data dir: {data_dir}")
+    state = httpd.RequestHandlerClass.state
+    if repl_source:
+        print(f"replicating from {repl_source} (read-only follower)")
+    elif state.replication is not None:
+        print(f"shipping WAL segments on port {state.replication.port}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

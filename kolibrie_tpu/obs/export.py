@@ -196,13 +196,23 @@ def build_stats(state) -> dict:
     # base-version churn; "is steady state really zero-retry" dashboard)
     from kolibrie_tpu.query.template import cap_advisor
 
-    return {
+    out = {
         "stores": {sid: store_stats(b) for sid, b in stores.items()},
         "rsp_sessions": len(sessions),
         "resilience": resilience,
         "compile_tail": compile_tail,
         "cap_advisor": cap_advisor.stats(),
     }
+    # replication block: ship/apply counters + watermark/lag on nodes
+    # with a role in a fleet (primary ship server or follower); absent on
+    # plain single-process servers
+    replication = getattr(state, "replication", None)
+    if replication is not None:
+        out["replication"] = {
+            "node_role": getattr(state, "role", "primary"),
+            **replication.stats(),
+        }
+    return out
 
 
 # ------------------------------------------------- scrape-time collectors

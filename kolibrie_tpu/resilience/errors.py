@@ -20,6 +20,9 @@ Design rules:
 
 from __future__ import annotations
 
+import os
+import random
+import threading
 from typing import Dict, Optional, Tuple
 
 # obs.spans is stdlib-only and imports nothing from resilience, so this
@@ -122,11 +125,45 @@ class WindowCrash(KolibrieError):
     code = "window_crashed"
 
 
+# --------------------------------------------------------- retry jitter
+#
+# A restarted fleet answers every queued client with 503 + Retry-After
+# at once; a FIXED value marches them all back in lockstep, so the
+# thundering herd re-forms at every interval.  Spread the advice across
+# ``[base, base * (1 + spread)]`` from a per-process stream that is
+# deterministic under ``KOLIBRIE_RETRY_JITTER_SEED`` (chaos tests freeze
+# it) and pid-seeded otherwise, so the replicas of one fleet
+# de-synchronise from each other too.
+
+_jitter_lock = threading.Lock()
+_jitter_rng = random.Random(
+    os.environ.get("KOLIBRIE_RETRY_JITTER_SEED") or os.getpid()
+)
+
+
+def jittered_retry_after(base_s: float = 1.0, spread: float = 0.5) -> float:
+    """Retry-After advice drawn from the seeded jitter stream:
+    uniform in ``[base_s, base_s * (1 + spread)]``."""
+    with _jitter_lock:
+        u = _jitter_rng.random()
+    return base_s * (1.0 + spread * u)
+
+
+def reset_retry_jitter(seed) -> None:
+    """Re-seed the jitter stream — tests freeze the sequence with this."""
+    global _jitter_rng
+    with _jitter_lock:
+        _jitter_rng = random.Random(seed)
+
+
 class Unavailable(KolibrieError):
     """The server is up but not serving: replaying its WAL after a crash
-    (``recovering``) or draining in-flight work before a SIGTERM exit
-    (``draining``).  Clients should honor ``Retry-After`` — the HTTP
-    layer emits the header from ``retry_after_s``."""
+    (``recovering``), draining in-flight work before a SIGTERM exit
+    (``draining``), or a follower still behind a requested watermark
+    (``catching_up``).  Clients should honor ``Retry-After`` — the HTTP
+    layer emits the header from ``retry_after_s``.  When no explicit
+    value is given the advice is jittered (see above) so a fleet's
+    clients don't retry in lockstep."""
 
     http_status = 503
     code = "unavailable"
@@ -135,16 +172,42 @@ class Unavailable(KolibrieError):
         self,
         message: str = "server unavailable",
         phase: str = "recovering",
-        retry_after_s: float = 1.0,
+        retry_after_s: Optional[float] = None,
     ):
         super().__init__(message)
         self.phase = phase
-        self.retry_after_s = retry_after_s
+        self.retry_after_s = (
+            jittered_retry_after() if retry_after_s is None else retry_after_s
+        )
 
     def payload(self, context: str = "") -> Dict[str, object]:
         out = super().payload(context)
         out["phase"] = self.phase
         out["retry_after_s"] = self.retry_after_s
+        return out
+
+
+class NotPrimary(KolibrieError):
+    """A mutating request reached a read-only follower replica.  409 so
+    routers/clients distinguish "wrong node" (re-aim at the primary, no
+    backoff needed) from "node down" (503, retry with backoff).  The
+    payload carries the follower's replication source as a hint."""
+
+    http_status = 409
+    code = "not_primary"
+
+    def __init__(
+        self,
+        message: str = "this replica is a read-only follower",
+        primary_hint: str = "",
+    ):
+        super().__init__(message)
+        self.primary_hint = primary_hint
+
+    def payload(self, context: str = "") -> Dict[str, object]:
+        out = super().payload(context)
+        if self.primary_hint:
+            out["primary_hint"] = self.primary_hint
         return out
 
 

@@ -85,6 +85,26 @@ class InjectedFsyncFault(InjectedFault, OSError):
     reached the disk cache but durability cannot be acknowledged."""
 
 
+class InjectedShipTorn(InjectedFault):
+    """Simulated link failure mid-ship (site ``repl.send``): a PREFIX of
+    the protocol frame reaches the peer, then the connection dies.  The
+    receiver sees a short read / CRC failure and must reconnect and
+    re-request — never apply the partial frame."""
+
+
+class InjectedShipDrop(InjectedFault):
+    """Simulated dropped delivery (site ``repl.send``): the frame
+    silently never leaves the sender.  The receiver times out and
+    re-requests on a fresh connection."""
+
+
+class InjectedShipDuplicate(InjectedFault):
+    """Simulated duplicated delivery (site ``repl.send``): the frame is
+    sent TWICE back-to-back.  The receiver must treat the replay as a
+    no-op (sequence ids at the protocol layer, applied-segment watermark
+    at the replication layer)."""
+
+
 class _SiteRule:
     __slots__ = (
         "site",
@@ -223,3 +243,63 @@ def fault_point(site: str) -> None:
         return
     for plan in active_plans():
         plan.hit(site)
+
+
+# ------------------------------------------------------------- env plans
+
+#: error-class names an env-declared rule may inject — chaos tests arm
+#: child SERVER processes through the environment, where passing a
+#: class object is impossible
+_ENV_ERRORS = {
+    cls.__name__: cls
+    for cls in (
+        InjectedCompileError,
+        InjectedDeviceOOM,
+        InjectedWindowCrash,
+        InjectedTornWrite,
+        InjectedBitFlip,
+        InjectedFsyncFault,
+        InjectedShipTorn,
+        InjectedShipDrop,
+        InjectedShipDuplicate,
+    )
+}
+
+FAULT_PLAN_ENV = "KOLIBRIE_FAULT_PLAN"
+
+
+def plan_from_env(env: Optional[Dict[str, str]] = None) -> Optional[FaultPlan]:
+    """Build (but do not install) a plan from ``KOLIBRIE_FAULT_PLAN`` —
+    JSON like::
+
+        {"seed": 7, "rules": [
+            {"site": "repl.send", "error": "InjectedShipDuplicate",
+             "rate": 0.25, "max_fires": 4}]}
+
+    Returns None when the variable is unset/empty.  Malformed JSON or an
+    unknown error name raises ``ValueError`` loudly — a chaos run with a
+    silently-ignored fault plan would "pass" by testing nothing."""
+    import json as _json
+    import os as _os
+
+    raw = (env if env is not None else _os.environ).get(FAULT_PLAN_ENV, "")
+    if not raw.strip():
+        return None
+    try:
+        spec = _json.loads(raw)
+    except _json.JSONDecodeError as exc:
+        raise ValueError(f"unparseable {FAULT_PLAN_ENV}: {exc}") from exc
+    plan = FaultPlan(seed=int(spec.get("seed", 0)))
+    for rule in spec.get("rules", []):
+        name = rule.get("error")
+        if name is not None and name not in _ENV_ERRORS:
+            raise ValueError(f"{FAULT_PLAN_ENV} names unknown error {name!r}")
+        plan.add(
+            rule["site"],
+            error=_ENV_ERRORS[name] if name is not None else None,
+            latency_s=float(rule.get("latency_s", 0.0)),
+            rate=float(rule.get("rate", 1.0)),
+            at_calls=rule.get("at_calls"),
+            max_fires=rule.get("max_fires"),
+        )
+    return plan

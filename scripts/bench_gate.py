@@ -217,6 +217,32 @@ def smoke() -> None:
         "mqo.fleet64_shared_per_query_ms" in r for r in regs
     ), "missed 2x MQO fleet regression"
 
+    # the replication fleet gates like MQO: read qps gates upward, lag
+    # and failover gate downward, ratios/counters are informational
+    assert _direction("secondary.replication.fleet2_read_qps") == "up"
+    assert _direction("secondary.replication.single_read_qps") == "up"
+    assert _direction("secondary.replication.repl_lag_p99_ms") == "down"
+    assert _direction("secondary.replication.failover_ms") == "down"
+    assert _direction(
+        "secondary.replication.fleet2_speedup_vs_single"
+    ) is None
+    withrepl = json.loads(json.dumps(trajectory[-1]))
+    withrepl.setdefault("secondary", {})["replication"] = {
+        "fleet2_read_qps": 100.0,
+        "failover_ms": 500.0,
+    }
+    base = [json.loads(json.dumps(withrepl))]
+    slow = json.loads(json.dumps(withrepl))
+    slow["secondary"]["replication"]["fleet2_read_qps"] = 40.0
+    slow["secondary"]["replication"]["failover_ms"] = 2000.0
+    regs, _ = compare(slow, base)
+    assert any("replication.fleet2_read_qps" in r for r in regs), (
+        "missed 60% fleet read-qps regression"
+    )
+    assert any("replication.failover_ms" in r for r in regs), (
+        "missed 4x failover regression"
+    )
+
     # timeline ring end to end, against an isolated registry
     sys.path.insert(0, REPO)
     from kolibrie_tpu.obs import metrics as m
@@ -231,9 +257,25 @@ def smoke() -> None:
     series = ring.series()
     deltas = series["metrics"]["smoke_total"]["series"][""]["deltas"]
     assert deltas == [5.0], deltas
+
+    # live reduced replication fleet — one primary + one follower process,
+    # short windows: proves the bench block's whole path (boot, WAL ship,
+    # catch-up, read qps, lag sampling, kill -9 failover) at lint time
+    import bench
+
+    repl = bench.replication_fleet_bench(
+        fleet_sizes=(1,), read_duration_s=0.5, lag_samples=3,
+    )
+    for key in ("single_read_qps", "fleet1_read_qps",
+                "repl_lag_p99_ms", "failover_ms"):
+        assert repl.get(key, 0) > 0, (key, repl)
     print(
         f"bench gate smoke OK: {len(trajectory)} trajectory rounds, "
-        f"{len(checked)} gated metrics, ring deltas verified"
+        f"{len(checked)} gated metrics, ring deltas verified, "
+        f"replication fleet smoke: single={repl['single_read_qps']}qps "
+        f"fleet1={repl['fleet1_read_qps']}qps "
+        f"lag_p99={repl['repl_lag_p99_ms']}ms "
+        f"failover={repl['failover_ms']}ms"
     )
 
 

@@ -23,6 +23,8 @@ import urllib.request
 import pytest
 
 from kolibrie_tpu.durability import wal
+from kolibrie_tpu.replication.router import RouterCore
+from kolibrie_tpu.resilience.faultinject import FaultPlan, InjectedShipDuplicate
 
 pytestmark = pytest.mark.chaos
 
@@ -44,6 +46,22 @@ def post(base, path, payload, timeout=60):
         return e.code, json.loads(e.read())
 
 
+def post_raw(base, path, payload, timeout=60):
+    """Like :func:`post` but also returns the response headers — the
+    Retry-After assertions need them."""
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
 def get(base, path, timeout=60):
     try:
         with urllib.request.urlopen(base + path, timeout=timeout) as resp:
@@ -61,7 +79,7 @@ def _free_port():
 class ServerProc:
     """A real ``http_server`` child process on a durable data dir."""
 
-    def __init__(self, data_dir, port=None):
+    def __init__(self, data_dir, port=None, extra_env=None):
         self.data_dir = str(data_dir)
         self.port = port or _free_port()
         self.base = f"http://127.0.0.1:{self.port}"
@@ -73,6 +91,7 @@ class ServerProc:
                 "JAX_PLATFORMS": "cpu",
             }
         )
+        env.update(extra_env or {})
         self.log_path = self.data_dir + ".server.log"
         self._log = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
@@ -347,3 +366,236 @@ def test_kill9_mid_window_session_resumes_from_checkpoint(data_dir, tmp_path):
         assert st == 200 and out["recovered"] is False
     finally:
         srv2.stop()
+
+
+# ------------------------------------------------ replication (ISSUE 17)
+#
+# The in-process cases stage the exact debris and delivery faults; the
+# process-level case kills a real primary with SIGKILL mid-ingest and
+# lets the router's promotion supervisor fail over to the follower.
+
+
+def _repl_triples(db):
+    return sorted(db.iter_decoded())
+
+
+def _make_repl_primary(tmp_path, n):
+    from kolibrie_tpu.durability.manager import DurabilityManager
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+    from kolibrie_tpu.replication.primary import ShipServer
+
+    m = DurabilityManager(str(tmp_path / "primary"), fsync_policy="always")
+    m.start()
+    db = SparqlDatabase()
+    m.attach("store-1", db)
+    for i in range(n):
+        db.add_triple_parts(f"<http://x/s{i}>", "<http://x/p>", f'"{i}"')
+    return m, db, ShipServer(m, seal_interval_s=0.0)
+
+
+def test_follower_bootstrap_from_debris(tmp_path):
+    """A follower data dir left behind by a crash — a ``.tmp-gen-*``
+    snapshot staging dir and a torn-tail WAL segment whose intact prefix
+    encodes a destructive ``clear`` — must be CLEANED on bootstrap, not
+    replayed: any invalid local segment is pre-crash junk and is deleted
+    whole (shipped segments land atomically, so a valid copy is always
+    re-fetchable)."""
+    from kolibrie_tpu.replication.follower import ReplicationFollower
+
+    m, db, ship = _make_repl_primary(tmp_path, n=14)
+    fol_dir = tmp_path / "follower"
+    os.makedirs(fol_dir / "wal")
+    os.makedirs(fol_dir / "snapshots")
+    # debris 1: a half-fetched snapshot generation
+    tmp_gen = fol_dir / "snapshots" / ".tmp-gen-00000001"
+    os.makedirs(tmp_gen)
+    (tmp_gen / "partial.json").write_bytes(b"{ half written")
+    # debris 2: a torn-tail segment whose valid prefix would CLEAR the
+    # store if it were truncated-and-replayed instead of deleted
+    torn = wal.segment_path(str(fol_dir / "wal"), 1)
+    frame = wal.encode_record({"k": "mut", "st": "store-1", "ev": "clear"})
+    with open(torn, "wb") as fh:
+        fh.write(wal.SEG_MAGIC)
+        fh.write(frame)
+        fh.write(frame[: len(frame) // 2])
+    fol = ReplicationFollower(str(fol_dir), ship.host, ship.port)
+    try:
+        report = fol.bootstrap()
+        assert report["tmp_gens"] == 1
+        assert report["bad_segments"] == 1
+        assert not os.path.exists(tmp_gen)
+        assert not os.path.exists(torn)
+        fol.poll_once()
+        got = _repl_triples(fol.res.stores["store-1"])
+        assert got == _repl_triples(db)
+        assert got, "the staged `clear` debris must never have applied"
+    finally:
+        fol.stop()
+        ship.close()
+        m.close()
+
+
+def test_duplicated_segment_delivery_is_idempotent(tmp_path):
+    """Seeded duplicate-delivery injection on the ship wire: every early
+    send goes out twice (requests and replies alike).  The client's
+    sequence ids discard the stale copies and the follower's applied
+    watermark skips re-listed segments, so the mirror converges to the
+    oracle with nothing double-applied."""
+    from kolibrie_tpu.replication import protocol
+    from kolibrie_tpu.replication.follower import ReplicationFollower
+    from kolibrie_tpu.replication.protocol import ProtocolError
+
+    m, db, ship = _make_repl_primary(tmp_path, n=11)
+    fol = ReplicationFollower(str(tmp_path / "follower"), ship.host, ship.port)
+    dup_fired = protocol._SHIP_FAULTS.labels("duplicated")
+    dup_discarded = protocol._DUP_DISCARDS.labels()
+    fired0, discarded0 = dup_fired.value, dup_discarded.value
+    plan = FaultPlan(seed=23).add(
+        "repl.send", error=InjectedShipDuplicate, rate=1.0, max_fires=8
+    )
+    try:
+        with plan.installed():
+            for _ in range(30):
+                try:
+                    if not fol.bootstrapped:
+                        fol.bootstrap()
+                    fol.poll_once()
+                    break
+                except (ProtocolError, OSError):
+                    continue
+        assert fol.bootstrapped
+        assert dup_fired.value > fired0, "the injection never fired"
+        assert dup_discarded.value > discarded0, "no duplicate was absorbed"
+        assert _repl_triples(fol.res.stores["store-1"]) == _repl_triples(db)
+        applied = fol.applied_segment
+        # a clean poll after the fault window changes nothing
+        fol.poll_once()
+        assert fol.applied_segment == applied
+        assert _repl_triples(fol.res.stores["store-1"]) == _repl_triples(db)
+    finally:
+        fol.stop()
+        ship.close()
+        m.close()
+
+
+def _wait_follower_applied(base, min_segment, timeout_s=45.0):
+    """Poll a follower's /healthz until its replication watermark covers
+    ``min_segment``; returns the watermark."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _st, out = get(base, "/healthz", timeout=5)
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.1)
+            continue
+        wm = (out.get("replication") or {}).get("watermark") or {}
+        last = wm
+        if int(wm.get("applied_segment") or 0) >= min_segment:
+            return wm
+        time.sleep(0.05)
+    raise AssertionError(f"follower never applied segment {min_segment}: {last}")
+
+
+def test_kill9_primary_mid_ingest_follower_promotes(data_dir, tmp_path):
+    """The ISSUE 17 failover drill: a real primary shipping WAL segments
+    to a real follower process is SIGKILLed mid-ingest; the router's
+    promotion supervisor picks the follower (highest durable watermark)
+    and POSTs /admin/promote.  The promoted node must serve every write
+    whose shipping was CONFIRMED (follower watermark covered its token),
+    must never invent rows beyond what the dead primary acknowledged,
+    and must accept new writes as a journaling primary.  Writes acked in
+    the async window between last ship and the kill may be lost — that
+    is the documented replication guarantee (docs/REPLICATION.md):
+    confirmed ⊆ recovered ⊆ acknowledged."""
+    repl_port = _free_port()
+    prim = ServerProc(
+        data_dir,
+        extra_env={
+            "KOLIBRIE_REPL_PORT": str(repl_port),
+            "KOLIBRIE_REPL_SEAL_INTERVAL_S": "0.05",
+        },
+    )
+    fol = ServerProc(
+        str(tmp_path / "follower-data"),
+        extra_env={
+            "KOLIBRIE_REPL_SOURCE": f"127.0.0.1:{repl_port}",
+            "KOLIBRIE_REPL_POLL_INTERVAL_S": "0.05",
+        },
+    )
+    try:
+        prim.wait_ready()
+        fol.wait_ready()  # follower gates ready on its first bootstrap
+
+        # phase A: acked AND confirmed shipped (watermark covers token)
+        st, out = post(prim.base, "/store/load",
+                       {"rdf": _ntriples(0, 40), "format": "ntriples"})
+        assert st == 200, out
+        store_id = out["store_id"]
+        st, out = post(prim.base, "/store/load",
+                       {"rdf": _ntriples(40, 70), "format": "ntriples",
+                        "store_id": store_id})
+        assert st == 200, out
+        token = out["watermark"]
+        _wait_follower_applied(fol.base, token["segment"])
+
+        # a follower is read-only: mutations 409 with the primary hint
+        st, out = post(fol.base, "/store/load",
+                       {"rdf": _ntriples(0, 1), "format": "ntriples",
+                        "store_id": store_id})
+        assert st == 409 and out["code"] == "not_primary", out
+        assert out["primary_hint"] == f"127.0.0.1:{repl_port}"
+        # ...but serves bounded-staleness reads of the confirmed state
+        assert _store_rows(fol.base, store_id) == _oracle(0, 70)
+        # a read-your-writes token it cannot satisfy yet → 503
+        # catching_up with jittered Retry-After advice
+        st, out, headers = post_raw(
+            fol.base, "/store/query",
+            {"store_id": store_id,
+             "sparql": "SELECT ?s ?p ?o WHERE { ?s ?p ?o }",
+             "min_watermark": {"segment": 10_000}},
+        )
+        assert st == 503 and out["phase"] == "catching_up", out
+        assert 1.0 <= out["retry_after_s"] <= 1.5
+        assert int(headers["Retry-After"]) >= 1
+
+        # phase B: acked on the primary, then SIGKILL before the ship
+        # loop is given any chance to confirm
+        st, out = post(prim.base, "/store/load",
+                       {"rdf": _ntriples(70, 90), "format": "ntriples",
+                        "store_id": store_id})
+        assert st == 200, out
+        prim.kill9()
+
+        # the promotion supervisor: probe until the follower is primary
+        core = RouterCore(
+            [("prim", prim.base), ("fol", fol.base)],
+            probe_timeout_s=2.0, evict_after=2, promote_after=2,
+            promote_cooldown_s=0.0,
+        )
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            core.probe_once()
+            p = core.primary()
+            if p is not None and p.name == "fol":
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"no promotion: {core.stats()}")
+        assert core.promotions == 1
+
+        st, health = get(fol.base, "/healthz")
+        assert st == 200 and health["role"] == "primary"
+        rows = _store_rows(fol.base, store_id)
+        # confirmed ⊆ recovered ⊆ acknowledged — and nothing invented
+        assert rows >= _oracle(0, 70), "confirmed acked writes lost"
+        assert rows <= _oracle(0, 90), "rows invented beyond acked writes"
+        # the promoted node is a real primary: writes journal and serve
+        st, out = post(fol.base, "/store/load",
+                       {"rdf": _ntriples(90, 95), "format": "ntriples",
+                        "store_id": store_id})
+        assert st == 200, out
+        assert _store_rows(fol.base, store_id) == rows | _oracle(90, 95)
+    finally:
+        prim.stop()
+        fol.stop()

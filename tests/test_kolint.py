@@ -716,6 +716,87 @@ def test_kl701_suppression_with_reason(tmp_path):
     assert res.suppressed[0].rule == "KL701"
 
 
+# ------------------------------------------- KL702: WAL frame discipline
+
+
+BAD_KL702_UNPACK = """
+import struct
+
+MAGIC = b"KWALSEG1"
+
+def peek_record(buf):
+    # hand-rolled frame parse: rots the moment the layout/CRC changes
+    length, crc = struct.unpack("<II", buf[len(MAGIC):len(MAGIC) + 8])
+    return length, crc
+"""
+
+BAD_KL702_IMPORT = """
+from kolibrie_tpu.durability.wal import _FRAME
+
+def peek_record(buf):
+    return _FRAME.unpack_from(buf, 0)
+"""
+
+GOOD_KL702 = """
+import struct
+
+from kolibrie_tpu.durability.wal import read_frame, scan_segment_file
+
+def peek_record(fh):
+    return read_frame(fh)  # the sanctioned frame API
+
+def unrelated_binary_parse(buf):
+    # struct use WITHOUT the WAL magic nearby is someone else's format
+    return struct.unpack("<I", buf[:4])
+"""
+
+
+def test_kl702_raw_unpack_beside_magic(tmp_path):
+    res = lint(tmp_path, BAD_KL702_UNPACK)
+    assert rules_fired(res) == ["KL702"]
+    assert "read_frame" in res.findings[0].message
+
+
+def test_kl702_underscore_import(tmp_path):
+    res = lint(tmp_path, BAD_KL702_IMPORT)
+    assert rules_fired(res) == ["KL702"]
+    assert "_FRAME" in res.findings[0].message
+
+
+def test_kl702_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL702)
+    assert res.findings == []
+
+
+def test_kl702_magic_without_unpack_is_fine(tmp_path):
+    # naming the magic alone (docs, tests asserting on headers) is fine
+    res = lint(tmp_path, 'MAGIC = b"KWALSEG1"\n')
+    assert res.findings == []
+
+
+@pytest.mark.parametrize("zone", ["durability", "replication"])
+def test_kl702_sanctioned_zones_are_exempt(tmp_path, zone):
+    # the frame format's owners parse it by hand by definition
+    sub = tmp_path / zone
+    sub.mkdir()
+    p = sub / "frames.py"
+    p.write_text(BAD_KL702_UNPACK)
+    res = core.run([str(p)], use_baseline=False, root=str(tmp_path))
+    assert res.findings == []
+
+
+def test_kl702_suppression_with_reason(tmp_path):
+    src = BAD_KL702_UNPACK.replace(
+        "    length, crc = struct.unpack",
+        "    # kolint: ignore[KL702] fixture: forensic dump tool\n"
+        "    length, crc = struct.unpack",
+    )
+    res = lint(tmp_path, src)
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].rule == "KL702"
+
+
 # --------------------------------------------- KL801: Pallas containment
 
 
