@@ -324,6 +324,82 @@ print(json.dumps({"count": sum(counts), "errors": sum(errors),
             )
         block["read_errors"] = read_errors
 
+        # ---- fleet observability: router-path overhead + /fleet scrape ---
+        # The same read mix proxied through an in-process router twice:
+        # spans+metrics recording on, then the obs runtime kill switch off
+        # (what KOLIBRIE_OBS_DISABLED=1 sets at import) — same < 3% budget
+        # as the single-process obs sweep.  Then /fleet/metrics latency
+        # with the TTL cache defeated, so the number is the true N-node
+        # scrape sweep and merge, not a cache hit.
+        note("replication: fleet observability sweep")
+        try:
+            import threading
+
+            from kolibrie_tpu.obs import runtime as obs_runtime
+            from kolibrie_tpu.replication.router import make_router
+
+            r_httpd, r_core = make_router(
+                [(rec["name"], rec["base"]) for rec in [primary] + followers],
+                quiet=True, probe_interval_s=3600.0, auto_promote=False,
+            )
+            try:
+                threading.Thread(
+                    target=r_httpd.serve_forever, daemon=True
+                ).start()
+                router_base = f"http://127.0.0.1:{r_httpd.server_address[1]}"
+                r_core.probe_once()
+                # warm the proxy path once per template
+                for q in read_mix:
+                    post(router_base, "/store/query",
+                         {"store_id": "lubm", "sparql": q})
+                instrumented = disabled = 0.0
+                try:
+                    # interleaved best-of-2 per mode: the loadgen child
+                    # dominates noise at this window size
+                    for _ in range(2):
+                        obs_runtime.set_enabled(True)
+                        q_on, _e = measure_qps([router_base],
+                                               read_duration_s)
+                        instrumented = max(instrumented, q_on)
+                        obs_runtime.set_enabled(False)
+                        q_off, _e = measure_qps([router_base],
+                                                read_duration_s)
+                        disabled = max(disabled, q_off)
+                finally:
+                    obs_runtime.set_enabled(True)
+                overhead_pct = (
+                    (disabled - instrumented) / disabled * 100.0
+                    if disabled > 0 else 0.0
+                )
+                r_core.fleet_cache_ttl_s = 0.0
+                scrape_ms = []
+                for _ in range(8):
+                    t0 = time.perf_counter()
+                    r_core.fleet_metrics()
+                    scrape_ms.append((time.perf_counter() - t0) * 1000.0)
+                scrape_ms.sort()
+                block["fleet_obs"] = {
+                    "router_instrumented_read_qps": round(instrumented, 1),
+                    "router_obs_disabled_read_qps": round(disabled, 1),
+                    "obs_overhead_pct": round(overhead_pct, 2),
+                    "budget_pct": 3.0,
+                    "fleet_metrics_scrape_p50_ms": round(
+                        pct(scrape_ms, 0.50), 2
+                    ),
+                    "fleet_metrics_scrape_p99_ms": round(
+                        pct(scrape_ms, 0.99), 2
+                    ),
+                    # router registry + every healthy backend in the sweep
+                    "fleet_metrics_nodes": 1 + len(followers) + 1,
+                }
+            finally:
+                r_core.stop()
+                r_httpd.shutdown()
+                r_httpd.server_close()
+        except Exception as e:  # noqa: BLE001 — bench must survive its probes
+            block["fleet_obs"] = {"error": repr(e)}
+        note(f"replication: fleet obs done ({block['fleet_obs']})")
+
         # ---- replication lag under sustained ingest ----------------------
         # each marker batch is acked by the primary, then timed until a
         # follower serves it: ack-to-visible wall time, p50/p99

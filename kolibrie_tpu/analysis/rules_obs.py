@@ -10,11 +10,17 @@ KL501  metric label value not provably drawn from a bounded set
        (f-string / format / dict lookup / subscript as a label value)
 KL502  span(…) opened without a `with` scope — the span never exits,
        never lands in the ring, and corrupts the parent stack
+KL504  bare print() in library code — diagnostics belong in the
+       structured logger (obs/log.py) where they carry level, component
+       and trace id; user-facing output must name its stream with an
+       explicit ``file=`` argument.  ``__main__.py`` modules, code under
+       an ``if __name__ == "__main__"`` guard, and tests are exempt.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 from typing import List
 
 from kolibrie_tpu.analysis.core import Finding, rule
@@ -181,6 +187,87 @@ def _chain_base_name(expr: ast.AST):
             return expr.id
         else:
             return None
+
+
+# ------------------------------------------------------------------ KL504
+
+
+def _main_guard_ranges(tree: ast.Module) -> List[tuple]:
+    """Line spans of ``if __name__ == "__main__":`` blocks — script
+    bodies are CLI territory, prints there are the interface."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if (
+            isinstance(t, ast.Compare)
+            and isinstance(t.left, ast.Name)
+            and t.left.id == "__name__"
+        ):
+            out.append((node.lineno, node.end_lineno or node.lineno))
+    return out
+
+
+def _kl504_exempt_file(rel: str) -> bool:
+    base = os.path.basename(rel)
+    if base == "__main__.py":  # CLI entry point by definition
+        return True
+    if base.startswith("test_") or base == "conftest.py":
+        return True
+    parts = rel.replace(os.sep, "/").split("/")
+    return "tests" in parts
+
+
+@rule(
+    "KL504",
+    "bare print() in library code — use the structured logger "
+    "(obs/log.py) for diagnostics, or pass an explicit file= stream "
+    "for user-facing output",
+)
+def bare_print(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or _kl504_exempt_file(f.rel):
+            continue
+        guards = _main_guard_ranges(f.tree)
+        # innermost-enclosing-function index for the baseline scope key
+        spans = sorted(
+            (
+                (info.node.lineno, info.node.end_lineno or info.node.lineno,
+                 info.qualname)
+                for info in f.functions.values()
+                if hasattr(info.node, "lineno")
+            ),
+            key=lambda s: s[1] - s[0],
+        )
+        for node in ast.walk(f.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue  # stream named explicitly → intentional output
+            if any(lo <= node.lineno <= hi for lo, hi in guards):
+                continue
+            scope = next(
+                (q for lo, hi, q in spans if lo <= node.lineno <= hi), ""
+            )
+            out.append(
+                Finding(
+                    "KL504",
+                    f.rel,
+                    node.lineno,
+                    "bare print() in library code — diagnostics go through "
+                    "obs.log.get_logger(component) (level + trace id + "
+                    "tail ring); user-facing output must name its stream "
+                    "(print(..., file=sys.stdout))",
+                    scope=scope,
+                )
+            )
+    return out
 
 
 @rule(

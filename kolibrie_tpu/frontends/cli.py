@@ -13,6 +13,10 @@ import sys
 import time
 from typing import List, Optional
 
+from kolibrie_tpu.obs import log as obslog
+
+_log = obslog.get_logger("cli")
+
 
 def _read_arg(value: str) -> str:
     """Accept either inline text or a path to a file holding the text."""
@@ -91,13 +95,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from kolibrie_tpu.frontends.rules import apply_n3_logic
 
         inferred = apply_n3_logic(db, _read_arg(args.n3logic))
-        print(f"# n3logic inferred {inferred} fact(s)", file=sys.stderr)
+        _log.info("n3logic rules applied", inferred=inferred)
 
     for rule_text in args.rule:
         from kolibrie_tpu.frontends.rules import apply_sparql_rules
 
         inferred = apply_sparql_rules(db, [_read_arg(rule_text)])
-        print(f"# rule inferred {inferred} fact(s)", file=sys.stderr)
+        _log.info("sparql rule applied", inferred=inferred)
 
     if args.export:
         writer = {
@@ -112,7 +116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.explain:
         from kolibrie_tpu.query.engine import QueryEngine
 
-        print(QueryEngine(db).explain_device(sparql))
+        print(QueryEngine(db).explain_device(sparql), file=sys.stdout)
         return 0
     start = time.perf_counter()
     run = execute_query if args.legacy else execute_query_volcano
@@ -120,7 +124,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     elapsed_ms = (time.perf_counter() - start) * 1000.0
     _print_table(rows, sys.stdout)
     if args.time:
-        print(f"# {len(rows)} row(s) in {elapsed_ms:.2f} ms", file=sys.stderr)
+        _log.info("query executed", rows=len(rows), elapsed_ms=round(elapsed_ms, 2))
     return 0
 
 

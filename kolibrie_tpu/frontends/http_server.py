@@ -28,6 +28,8 @@ from kolibrie_tpu.frontends.rules import (
     strip_hash_comments,
 )
 from kolibrie_tpu.obs import export as obs_export
+from kolibrie_tpu.obs import flightrec
+from kolibrie_tpu.obs import log as obslog
 from kolibrie_tpu.obs import metrics as obs_metrics
 from kolibrie_tpu.obs.spans import (
     current_trace_id,
@@ -147,6 +149,19 @@ _SHARDED_ATTACH_ERRORS = obs_metrics.counter(
     "sharded-serving attach/refresh attempts that failed (store keeps "
     "serving single-device — the degraded path)",
 )
+_READS_SHED_CATCHING_UP = obs_metrics.counter(
+    "kolibrie_reads_shed_catching_up_total",
+    "reads refused because this follower was behind the client's "
+    "read-your-writes watermark (the router retries the next replica) — "
+    "a replication-SLO burn counter",
+)
+_PROMOTE_FINALIZE_SECONDS = obs_metrics.histogram(
+    "kolibrie_promote_finalize_seconds",
+    "follower-side promotion finalize (stop poll, truncate, reattach, "
+    "rebuild sessions) wall time — the node-local share of failover",
+)
+
+_log = obslog.get_logger("http_server")
 
 _PLAYGROUND_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -521,6 +536,9 @@ class _ServerState:
         self.primary_hint = ""  # follower: where writes should go
         self.repl_port: Optional[int] = None  # ship port (this or promoted)
         self.repl_seal_interval_s = 0.25
+        self.data_dir = data_dir
+        self.flightrec = None  # rolling blackbox recorder (durable nodes)
+        self.http_port: Optional[int] = None  # bound port, for identity
         # the persistent compilation cache must be live BEFORE the first
         # lowering this process performs — including recovery's own WAL
         # replay dispatches, which should hit artifacts a previous
@@ -939,13 +957,16 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self.headers.get("X-Kolibrie-Trace-Id") or None
         ) as tid:
             self._trace_id = tid
-            try:
-                handler = routes.get(path)
-                if handler is None:
-                    raise NotFound("not found")
-                handler()
-            except Exception as e:
-                self._send_failure(e)
+            with span(
+                "http.request", route=path, method="GET", node=obslog.node()
+            ):
+                try:
+                    handler = routes.get(path)
+                    if handler is None:
+                        raise NotFound("not found")
+                    handler()
+                except Exception as e:
+                    self._send_failure(e)
 
     _POST_ROUTES = {
         "/query": "_handle_query",
@@ -961,7 +982,12 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         "/debug/profile": "_handle_debug_profile",
         "/debug/prewarm": "_handle_debug_prewarm",
         "/debug/explain": "_handle_debug_explain",
+        "/debug/bundle": "_handle_debug_bundle",
     }
+
+    # routes that must answer regardless of recovering/draining — the
+    # flight recorder exists precisely for the moments the gate is shut
+    _ALWAYS_OPEN_ROUTES = frozenset({"/debug/bundle"})
 
     # a follower serves reads at bounded staleness; writes belong on the
     # primary (409 not_primary re-aims the router's role map)
@@ -990,7 +1016,9 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             self.headers.get("X-Kolibrie-Trace-Id") or None
         ) as tid:
             self._trace_id = tid
-            with span("http.request", route=path, method="POST"):
+            with span(
+                "http.request", route=path, method="POST", node=obslog.node()
+            ):
                 try:
                     if name is None:
                         raise NotFound("not found")
@@ -998,7 +1026,10 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                     # and are refused outright during drain; observability
                     # GETs (/healthz, /stats, /metrics) stay open throughout
                     phase = self.state.status
-                    if phase != "ready":
+                    if (
+                        phase != "ready"
+                        and path not in self._ALWAYS_OPEN_ROUTES
+                    ):
                         raise Unavailable(phase=phase)
                     if (
                         self.state.role != "primary"
@@ -1255,6 +1286,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         repl = state.replication
         applied = repl.applied_segment if repl is not None else -1
         if applied < want:
+            _READS_SHED_CATCHING_UP.inc()
             raise Unavailable(
                 "follower behind requested watermark "
                 f"(applied={applied} < {want})",
@@ -1288,6 +1320,7 @@ class KolibrieHandler(BaseHTTPRequestHandler):
                 }
             )
             return
+        t0 = time.perf_counter()
         wm = repl.promote()
         state.durability = repl.manager
         failures, max_sess = _rebuild_sessions(state, repl.res.sessions)
@@ -1316,6 +1349,16 @@ class KolibrieHandler(BaseHTTPRequestHandler):
             )
         else:
             state.replication = None
+        elapsed = time.perf_counter() - t0
+        _PROMOTE_FINALIZE_SECONDS.observe(elapsed)
+        obslog.set_identity("primary", getattr(state, "http_port", None))
+        _log.info(
+            "promotion finalized",
+            finalize_ms=round(elapsed * 1000.0, 1),
+            applied_segment=wm.get("applied_segment"),
+            applied_records=wm.get("applied_records"),
+            session_failures=failures,
+        )
         self._send_json(
             {"role": "primary", "promoted": True, "watermark": wm}
         )
@@ -1402,6 +1445,20 @@ class KolibrieHandler(BaseHTTPRequestHandler):
         body["interval_s"] = timeseries.DEFAULT_INTERVAL_S
         body["capacity"] = ring.capacity
         self._send_json(body)
+
+    def _handle_debug_bundle(self):
+        """``POST /debug/bundle``: dump a postmortem bundle on demand —
+        the operator's 'grab everything before I poke it' button.  Open
+        even while recovering/draining (that is when it matters)."""
+        state = self.state
+        if state.data_dir is None:
+            raise BadRequest("no data_dir: nowhere to write a bundle")
+        path = flightrec.dump(
+            state.data_dir,
+            "manual",
+            stats_fn=lambda: obs_export.build_stats(state),
+        )
+        self._send_json({"ok": True, "path": path})
 
     def _handle_debug_explain(self):
         """``POST /debug/explain``: EXPLAIN ANALYZE against a registered
@@ -1756,6 +1813,10 @@ def make_server(
         "BoundHandler", (KolibrieHandler,), {"state": state, "quiet": quiet}
     )
     httpd = ThreadingHTTPServer((host, port), handler)
+    state.http_port = httpd.server_address[1]
+    # node identity (role:port) stamps every span and log record so a
+    # cross-process trace names which node each hop ran on
+    obslog.set_identity(role, state.http_port)
 
     def _targets():
         with state.lock:
@@ -1782,6 +1843,18 @@ def make_server(
         if _TIMELINE_SAMPLER is None:
             _TIMELINE_SAMPLER = timeseries.Sampler(timeseries.default_ring())
             _TIMELINE_SAMPLER.start()
+    # rolling blackbox: durable nodes keep a recent postmortem bundle on
+    # disk at all times, so even kill -9 leaves evidence (the SIGTERM and
+    # fatal-error paths write a final, uniquely-named bundle on top)
+    if data_dir and os.environ.get("KOLIBRIE_FLIGHTREC_DISABLED") != "1":
+        state.flightrec = flightrec.FlightRecorder(
+            data_dir,
+            interval_s=float(
+                os.environ.get("KOLIBRIE_FLIGHTREC_INTERVAL_S", "5.0")
+            ),
+            stats_fn=lambda: obs_export.build_stats(state),
+        )
+        state.flightrec.start()
     if state.durability is not None:
         if recover_async:
             threading.Thread(
@@ -1837,9 +1910,19 @@ def shutdown_gracefully(httpd, timeout_s: float = 30.0) -> None:
     state = httpd.RequestHandlerClass.state
     with state.lock:
         state.status = "draining"
+    _log.info("draining", timeout_s=timeout_s)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline and state.admission.inflight > 0:
         time.sleep(0.05)
+    if state.flightrec is not None:
+        # final bundle BEFORE teardown: it captures the still-live stats
+        # surface; the rolling blackbox stays behind as well
+        state.flightrec.stop()
+        flightrec.try_dump(
+            state.data_dir,
+            "sigterm",
+            stats_fn=lambda: obs_export.build_stats(state),
+        )
     if state.prewarmer is not None:
         # stop the warmer before the final snapshot: it persists the
         # manifest so the NEXT incarnation knows this one's hot set
@@ -1898,14 +1981,19 @@ def serve(host: str = "127.0.0.1", port: int = 7878) -> None:
         signal.signal(signal.SIGTERM, _on_sigterm)
     except ValueError:
         pass  # not the main thread (embedded in tests)
-    print(f"kolibrie-tpu server listening on http://{host}:{port}")
-    if data_dir:
-        print(f"durable data dir: {data_dir}")
     state = httpd.RequestHandlerClass.state
+    if data_dir:
+        # an uncaught fatal error on the serving process leaves a bundle
+        flightrec.install_excepthook(
+            data_dir, stats_fn=lambda: obs_export.build_stats(state)
+        )
+    _log.info("listening", host=host, port=port, url=f"http://{host}:{port}")
+    if data_dir:
+        _log.info("durable data dir", data_dir=data_dir)
     if repl_source:
-        print(f"replicating from {repl_source} (read-only follower)")
+        _log.info("replicating (read-only follower)", source=repl_source)
     elif state.replication is not None:
-        print(f"shipping WAL segments on port {state.replication.port}")
+        _log.info("shipping WAL segments", port=state.replication.port)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -24,7 +24,10 @@ import sys
 import threading
 from typing import List, Tuple
 
+from kolibrie_tpu.obs import log as obslog
 from kolibrie_tpu.replication.router import make_router
+
+_log = obslog.get_logger("router_main")
 
 
 def parse_replicas(spec: str) -> List[Tuple[str, str]]:
@@ -60,10 +63,12 @@ def serve(host: str = "127.0.0.1", port: int = 8090) -> None:
         auto_promote=auto,
     )
     bound = httpd.server_address
-    print(
-        f"kolibrie router on http://{bound[0]}:{bound[1]} "
-        f"fronting {len(replicas)} replicas",
-        flush=True,
+    obslog.set_identity("router", bound[1])
+    _log.info(
+        "router listening",
+        url=f"http://{bound[0]}:{bound[1]}",
+        replicas=len(replicas),
+        auto_promote=auto,
     )
     stop = threading.Event()
 

@@ -16,73 +16,20 @@ them exactly.
 
 from __future__ import annotations
 
-import math
 from typing import List
 
 from kolibrie_tpu.obs import metrics
-from kolibrie_tpu.obs.metrics import Registry
+
+# rendering itself is stdlib-only and shared with the router's fleet
+# aggregation — it lives in promtext; re-exported here because every
+# existing caller imports it from this module
+from kolibrie_tpu.obs.promtext import render_prometheus  # noqa: F401
 
 # Satellite: module-scope imports — previously re-imported inside
 # TemplateBatcher.stats() on every /stats poll.
 from kolibrie_tpu.optimizer.device_engine import device_compile_stats
 from kolibrie_tpu.query.executor import plan_cache_info
 from kolibrie_tpu.resilience.breaker import breaker_board
-
-# ------------------------------------------------------------ prometheus
-
-
-def _escape_help(s: str) -> str:
-    return s.replace("\\", "\\\\").replace("\n", "\\n")
-
-
-def _escape_label(s: str) -> str:
-    return (
-        s.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
-    )
-
-
-def _fmt_value(v: float) -> str:
-    if v == math.inf:
-        return "+Inf"
-    if v == -math.inf:
-        return "-Inf"
-    f = float(v)
-    return str(int(f)) if f.is_integer() else repr(f)
-
-
-def _labels_str(names, values, extra=()) -> str:
-    pairs = [
-        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
-    ] + [f'{n}="{_escape_label(v)}"' for n, v in extra]
-    return "{" + ",".join(pairs) + "}" if pairs else ""
-
-
-def render_prometheus(registry: Registry = metrics.REGISTRY) -> str:
-    """The registry in Prometheus text exposition format v0.0.4.
-    Runs registered collectors first so pull-style gauges are fresh."""
-    registry.run_collectors()
-    lines: List[str] = []
-    for fam in registry.families():
-        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
-        lines.append(f"# TYPE {fam.name} {fam.kind}")
-        for values, child in fam.children():
-            if fam.kind in ("counter", "gauge"):
-                lines.append(
-                    f"{fam.name}{_labels_str(fam.label_names, values)} "
-                    f"{_fmt_value(child.value)}"
-                )
-            else:  # histogram
-                for le, acc in child.cumulative():
-                    ls = _labels_str(
-                        fam.label_names, values, extra=[("le", _fmt_value(le))]
-                    )
-                    lines.append(f"{fam.name}_bucket{ls} {acc}")
-                base = _labels_str(fam.label_names, values)
-                with child._lock:
-                    s, c = child.sum, child.count
-                lines.append(f"{fam.name}_sum{base} {_fmt_value(s)}")
-                lines.append(f"{fam.name}_count{base} {c}")
-    return "\n".join(lines) + "\n"
 
 
 # ----------------------------------------------------------------- /stats
@@ -283,3 +230,11 @@ def refresh_server_gauges(state) -> None:
                 _store_shard_imbalance_gauge.labels(sid).set(
                     sh_stats["imbalance"]
                 )
+    # follower watermark/lag SLO gauges refresh at scrape time so a
+    # wedged poll loop cannot freeze the lag /metrics reports — the
+    # follower owns the gauge families; primaries (ShipServer) have no
+    # refresh hook and push their counters inline
+    replication = getattr(state, "replication", None)
+    refresh = getattr(replication, "refresh_gauges", None)
+    if refresh is not None:
+        refresh()

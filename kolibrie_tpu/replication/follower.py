@@ -53,7 +53,9 @@ from kolibrie_tpu.durability.wal import (
     scan_segment_file,
     segment_path,
 )
+from kolibrie_tpu.obs import log as obslog
 from kolibrie_tpu.obs import metrics as obs_metrics
+from kolibrie_tpu.obs import spans as obs_spans
 from kolibrie_tpu.replication.protocol import (
     ProtocolError,
     ShipClient,
@@ -80,9 +82,24 @@ _LAG_SEGMENTS = obs_metrics.gauge(
     "kolibrie_repl_lag_segments",
     "sealed segments the follower has not applied yet",
 )
+_LAG_RECORDS = obs_metrics.gauge(
+    "kolibrie_repl_lag_records",
+    "primary-appended WAL records not yet applied here "
+    "(same-epoch estimate, re-baselined at bootstrap)",
+)
 _APPLIED_SEGMENT = obs_metrics.gauge(
     "kolibrie_repl_applied_segment", "highest fully-applied segment index"
 )
+_APPLIED_RECORDS = obs_metrics.gauge(
+    "kolibrie_repl_applied_records",
+    "WAL records applied since the last bootstrap (watermark component)",
+)
+_APPLY_SECONDS = obs_metrics.histogram(
+    "kolibrie_repl_apply_seconds",
+    "per-segment replay (scan-to-applied) wall time",
+)
+
+_log = obslog.get_logger("replication.follower")
 
 
 class ReplicationFollower:
@@ -120,6 +137,12 @@ class ReplicationFollower:
         self.applied_segment = 0
         self.applied_records = 0
         self.primary_pos = (0, 0)  # last seen (active_segment, offset)
+        # primary's process-lifetime append count, and its value at our
+        # last bootstrap: the difference minus our own applies is the
+        # lag-in-records SLO estimate (clamped — the counters live in
+        # different processes and reset on different events)
+        self.primary_records = 0
+        self.records_baseline = 0
         self.last_applied_unix = 0.0
         self.bootstrapped = False
         self.promoted = False
@@ -212,6 +235,7 @@ class ReplicationFollower:
             i = j
         self.applied_records += len(records)
         _RECORDS_APPLIED.inc(len(records))
+        _APPLIED_RECORDS.set(self.applied_records)
 
     def _advance_from_local(self) -> None:
         """Replay locally-present segments that directly continue the
@@ -222,11 +246,18 @@ class ReplicationFollower:
             path = segment_path(self.manager.wal_dir, nxt)
             if not os.path.exists(path):
                 return
-            records, _good, reason = scan_segment_file(path)
-            if reason is not None:
-                os.unlink(path)  # torn local copy: refetch whole
-                return
-            self._apply_records(records)
+            t0 = time.perf_counter()
+            with obs_spans.span(
+                "repl.apply_segment", segment=nxt, node=obslog.node()
+            ) as sp:
+                records, _good, reason = scan_segment_file(path)
+                if reason is not None:
+                    os.unlink(path)  # torn local copy: refetch whole
+                    return
+                if sp is not None:
+                    sp.attrs["records"] = len(records)
+                self._apply_records(records)
+            _APPLY_SECONDS.observe(time.perf_counter() - t0)
             with self._lock:
                 self.applied_segment = nxt
                 self.last_applied_unix = time.time()
@@ -266,12 +297,21 @@ class ReplicationFollower:
             self.applied_records = 0
             pos = manifest.get("pos") or [0, 0]
             self.primary_pos = (int(pos[0]), int(pos[1]))
+            self.primary_records = int(manifest.get("records", 0))
+            self.records_baseline = self.primary_records
         for sid, db in res.stores.items():
             self.on_store_update(sid, db, created=sid not in old)
         self._advance_from_local()
         self.bootstrapped = True
         self.stats_counters["bootstraps"] += 1
         _BOOTSTRAPS.inc()
+        _log.info(
+            "bootstrap complete",
+            generation=gen,
+            wal_start=wal_start,
+            source=f"{self.source_host}:{self.source_port}",
+            **removed,
+        )
         return {"generation": gen, "wal_start": wal_start, **removed}
 
     # --------------------------------------------------------- poll loop
@@ -297,6 +337,7 @@ class ReplicationFollower:
         pos = meta.get("pos") or [0, 0]
         with self._lock:
             self.primary_pos = (int(pos[0]), int(pos[1]))
+            self.primary_records = int(meta.get("records", 0))
         self.stats_counters["polls"] += 1
         for idx in sorted(int(i) for i in meta.get("sealed") or ()):
             if idx <= self.applied_segment:
@@ -310,14 +351,18 @@ class ReplicationFollower:
                 self.bootstrap()
                 break
         _LAG_SEGMENTS.set(self.lag_segments())
+        _LAG_RECORDS.set(self.lag_records())
 
     def _poll_loop(self) -> None:
         backoff = self.poll_interval_s
         while not self._stop.is_set():
             try:
-                if not self.bootstrapped:
-                    self.bootstrap()
-                self.poll_once()
+                # each poll round is a root activity on this node: mint a
+                # fresh trace so apply spans group per-round in the ring
+                with obs_spans.trace_scope(None):
+                    if not self.bootstrapped:
+                        self.bootstrap()
+                    self.poll_once()
                 backoff = self.poll_interval_s
             except (ProtocolError, OSError):
                 self.stats_counters["poll_errors"] += 1
@@ -360,7 +405,13 @@ class ReplicationFollower:
         for sid, db in self.res.stores.items():
             self.manager.attach(sid, db, log_create=False)
         self.promoted = True
-        return self.watermark()
+        wm = self.watermark()
+        _log.info(
+            "promoted to primary",
+            applied_segment=wm["applied_segment"],
+            applied_records=wm["applied_records"],
+        )
+        return wm
 
     # ------------------------------------------------------------- state
 
@@ -370,6 +421,28 @@ class ReplicationFollower:
             # the newest sealed segment is active-1; clamp for a fresh
             # primary that has sealed nothing yet
             return max(0, (active - 1) - self.applied_segment)
+
+    def lag_records(self) -> int:
+        """Records the primary appended (in this epoch) that we have not
+        applied.  An estimate: both counters are process-local, so the
+        clamp absorbs restarts and snapshot re-baselines."""
+        with self._lock:
+            behind = (
+                self.primary_records
+                - self.records_baseline
+                - self.applied_records
+            )
+            return max(0, behind)
+
+    def refresh_gauges(self) -> None:
+        """Pull the watermark/lag state into the SLO gauges — called by
+        the exporter at scrape time so ``/metrics`` stays truthful even
+        when the poll loop is wedged (exactly when lag matters)."""
+        _LAG_SEGMENTS.set(self.lag_segments())
+        _LAG_RECORDS.set(self.lag_records())
+        with self._lock:
+            _APPLIED_SEGMENT.set(self.applied_segment)
+            _APPLIED_RECORDS.set(self.applied_records)
 
     def watermark(self) -> dict:
         with self._lock:
@@ -391,6 +464,7 @@ class ReplicationFollower:
             "source": f"{self.source_host}:{self.source_port}",
             "bootstrapped": self.bootstrapped,
             "lag_segments": self.lag_segments(),
+            "lag_records": self.lag_records(),
             **self.stats_counters,
         }
         out["watermark"] = self.watermark()

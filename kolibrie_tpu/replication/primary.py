@@ -148,17 +148,22 @@ class ShipServer:
     # ------------------------------------------------------------- replies
 
     def _wal_state(self):
-        """(sealed_segments, wal_start, position) — all from disk + the
-        live writer, consistent enough for pull-style shipping."""
+        """(sealed_segments, wal_start, position, records) — all from
+        disk + the live writer, consistent enough for pull-style
+        shipping.  ``records`` is the writer's process-lifetime append
+        count: the follower differences it against its own apply count
+        for the ``kolibrie_repl_lag_records`` SLO gauge."""
         wal = self.manager.wal
         segs = list_segments(self.manager.wal_dir)
         if wal is not None:
             active, off = wal.position()
+            records = wal.appended_records
         else:
             active, off = (segs[-1] + 1) if segs else 1, 0
+            records = 0
         sealed = [i for i in segs if i < active]
         wal_start = segs[0] if segs else active
-        return sealed, wal_start, (active, off)
+        return sealed, wal_start, (active, off), records
 
     def _manifest_meta(self, q) -> dict:
         gen = self.manager.generation
@@ -169,7 +174,7 @@ class ShipServer:
                 path = os.path.join(root, name)
                 if os.path.isfile(path):
                     files.append({"name": name, "size": os.path.getsize(path)})
-        sealed, wal_start, pos = self._wal_state()
+        sealed, wal_start, pos, records = self._wal_state()
         return {
             "t": "manifest",
             "q": q,
@@ -178,6 +183,7 @@ class ShipServer:
             "sealed": sealed,
             "wal_start": wal_start,
             "pos": list(pos),
+            "records": records,
         }
 
     def _maybe_seal(self) -> None:
@@ -193,7 +199,7 @@ class ShipServer:
             _SEALS.inc()
 
     def _poll_meta(self, q, after: int) -> dict:
-        sealed, wal_start, pos = self._wal_state()
+        sealed, wal_start, pos, records = self._wal_state()
         return {
             "t": "poll",
             "q": q,
@@ -201,6 +207,7 @@ class ShipServer:
             "wal_start": wal_start,
             "gen": self.manager.generation,
             "pos": list(pos),
+            "records": records,
             "now": time.time(),
         }
 
@@ -224,7 +231,7 @@ class ShipServer:
         )
 
     def _send_segment(self, conn, q, seg: int) -> None:
-        sealed, wal_start, _pos = self._wal_state()
+        sealed, wal_start, _pos, _records = self._wal_state()
         if seg not in sealed:
             # pruned by a snapshot (bootstrap again) or not sealed yet
             send_msg(
@@ -249,7 +256,7 @@ class ShipServer:
     # -------------------------------------------------------------- admin
 
     def stats(self) -> dict:
-        sealed, wal_start, pos = self._wal_state()
+        sealed, wal_start, pos, _records = self._wal_state()
         return {
             "role": "primary",
             "addr": f"{self.host}:{self.port}",

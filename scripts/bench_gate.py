@@ -243,6 +243,45 @@ def smoke() -> None:
         "missed 4x failover regression"
     )
 
+    # fleet observability gates the same way: router-path read qps must
+    # not fall, the /fleet/metrics scrape sweep must not slow down, and
+    # the overhead ratio / node count stay informational
+    assert _direction(
+        "secondary.replication.fleet_obs.router_instrumented_read_qps"
+    ) == "up"
+    assert _direction(
+        "secondary.replication.fleet_obs.router_obs_disabled_read_qps"
+    ) == "up"
+    assert _direction(
+        "secondary.replication.fleet_obs.fleet_metrics_scrape_p50_ms"
+    ) == "down"
+    assert _direction(
+        "secondary.replication.fleet_obs.obs_overhead_pct"
+    ) is None
+    assert _direction(
+        "secondary.replication.fleet_obs.fleet_metrics_nodes"
+    ) is None
+    withfo = json.loads(json.dumps(trajectory[-1]))
+    withfo.setdefault("secondary", {})["replication"] = {
+        "fleet_obs": {
+            "router_instrumented_read_qps": 200.0,
+            "fleet_metrics_scrape_p50_ms": 10.0,
+        }
+    }
+    base = [json.loads(json.dumps(withfo))]
+    slow = json.loads(json.dumps(withfo))
+    slow["secondary"]["replication"]["fleet_obs"] = {
+        "router_instrumented_read_qps": 80.0,
+        "fleet_metrics_scrape_p50_ms": 40.0,
+    }
+    regs, _ = compare(slow, base)
+    assert any(
+        "fleet_obs.router_instrumented_read_qps" in r for r in regs
+    ), "missed 60% router read-qps regression"
+    assert any(
+        "fleet_obs.fleet_metrics_scrape_p50_ms" in r for r in regs
+    ), "missed 4x fleet scrape regression"
+
     # timeline ring end to end, against an isolated registry
     sys.path.insert(0, REPO)
     from kolibrie_tpu.obs import metrics as m
@@ -269,13 +308,22 @@ def smoke() -> None:
     for key in ("single_read_qps", "fleet1_read_qps",
                 "repl_lag_p99_ms", "failover_ms"):
         assert repl.get(key, 0) > 0, (key, repl)
+    fo = repl.get("fleet_obs") or {}
+    assert "error" not in fo, fo
+    for key in ("router_instrumented_read_qps", "router_obs_disabled_read_qps",
+                "fleet_metrics_scrape_p50_ms"):
+        assert fo.get(key, 0) > 0, (key, fo)
+    assert fo.get("fleet_metrics_nodes", 0) >= 3, fo
     print(
         f"bench gate smoke OK: {len(trajectory)} trajectory rounds, "
         f"{len(checked)} gated metrics, ring deltas verified, "
         f"replication fleet smoke: single={repl['single_read_qps']}qps "
         f"fleet1={repl['fleet1_read_qps']}qps "
         f"lag_p99={repl['repl_lag_p99_ms']}ms "
-        f"failover={repl['failover_ms']}ms"
+        f"failover={repl['failover_ms']}ms "
+        f"fleet_obs: router={fo['router_instrumented_read_qps']}qps "
+        f"overhead={fo['obs_overhead_pct']}% "
+        f"scrape_p50={fo['fleet_metrics_scrape_p50_ms']}ms"
     )
 
 

@@ -22,6 +22,12 @@ echo "== kolint cache-key versioning (KL901) =="
 # delta_epoch) or store.version_key() (docs/MQO.md)
 python -m kolibrie_tpu.analysis --rules KL901 kolibrie_tpu/ || rc=1
 
+echo "== kolint print hygiene (KL504) =="
+# also in the default set; standalone pass keeps the no-bare-print
+# discipline visible — library diagnostics go through obs/log.py, user
+# output names its stream (docs/OBSERVABILITY.md)
+python -m kolibrie_tpu.analysis --rules KL504 kolibrie_tpu/ || rc=1
+
 echo "== compileall =="
 # -q: names only on failure; PYTHONDONTWRITEBYTECODE keeps the tree clean
 PYTHONDONTWRITEBYTECODE=1 python -m compileall -q kolibrie_tpu/ tests/ || rc=1

@@ -23,6 +23,8 @@ import urllib.request
 import pytest
 
 from kolibrie_tpu.durability import wal
+from kolibrie_tpu.obs import flightrec
+from kolibrie_tpu.obs.spans import spans_snapshot, trace_scope
 from kolibrie_tpu.replication.router import RouterCore
 from kolibrie_tpu.resilience.faultinject import FaultPlan, InjectedShipDuplicate
 
@@ -514,18 +516,21 @@ def test_kill9_primary_mid_ingest_follower_promotes(data_dir, tmp_path):
         extra_env={
             "KOLIBRIE_REPL_PORT": str(repl_port),
             "KOLIBRIE_REPL_SEAL_INTERVAL_S": "0.05",
+            # fast blackbox checkpoints: the flight recorder is how a
+            # SIGKILLed primary still leaves a postmortem bundle
+            "KOLIBRIE_FLIGHTREC_INTERVAL_S": "0.1",
         },
     )
-    fol = ServerProc(
-        str(tmp_path / "follower-data"),
-        extra_env={
-            "KOLIBRIE_REPL_SOURCE": f"127.0.0.1:{repl_port}",
-            "KOLIBRIE_REPL_POLL_INTERVAL_S": "0.05",
-        },
-    )
+    follower_env = {
+        "KOLIBRIE_REPL_SOURCE": f"127.0.0.1:{repl_port}",
+        "KOLIBRIE_REPL_POLL_INTERVAL_S": "0.05",
+    }
+    fol = ServerProc(str(tmp_path / "follower-data"), extra_env=follower_env)
+    fol2 = ServerProc(str(tmp_path / "follower2-data"), extra_env=follower_env)
     try:
         prim.wait_ready()
-        fol.wait_ready()  # follower gates ready on its first bootstrap
+        fol.wait_ready()  # followers gate ready on their first bootstrap
+        fol2.wait_ready()
 
         # phase A: acked AND confirmed shipped (watermark covers token)
         st, out = post(prim.base, "/store/load",
@@ -538,6 +543,7 @@ def test_kill9_primary_mid_ingest_follower_promotes(data_dir, tmp_path):
         assert st == 200, out
         token = out["watermark"]
         _wait_follower_applied(fol.base, token["segment"])
+        _wait_follower_applied(fol2.base, token["segment"])
 
         # a follower is read-only: mutations 409 with the primary hint
         st, out = post(fol.base, "/store/load",
@@ -567,9 +573,26 @@ def test_kill9_primary_mid_ingest_follower_promotes(data_dir, tmp_path):
         assert st == 200, out
         prim.kill9()
 
-        # the promotion supervisor: probe until the follower is primary
+        # ISSUE 18: kill -9 cannot be caught, but the flight recorder's
+        # rolling blackbox checkpoint means the dead primary STILL left
+        # a parseable postmortem bundle behind
+        bundles = flightrec.list_bundles(data_dir)
+        assert bundles, "dead primary left no postmortem bundle"
+        blackbox = [
+            p for p in bundles
+            if os.path.basename(p) == flightrec.BLACKBOX_DIRNAME
+        ]
+        assert blackbox, f"no blackbox among {bundles}"
+        bundle = flightrec.read_bundle(blackbox[0])
+        assert bundle["manifest"]["reason"] == "checkpoint"
+        assert bundle["manifest"]["role"] == "primary"
+        assert isinstance(bundle["spans"], list)
+        assert isinstance(bundle["log_tail"], list)
+        assert bundle["config"]["env"]["KOLIBRIE_DATA_DIR"] == prim.data_dir
+
+        # the promotion supervisor: probe until a follower is primary
         core = RouterCore(
-            [("prim", prim.base), ("fol", fol.base)],
+            [("prim", prim.base), ("fol", fol.base), ("fol2", fol2.base)],
             probe_timeout_s=2.0, evict_after=2, promote_after=2,
             promote_cooldown_s=0.0,
         )
@@ -577,25 +600,71 @@ def test_kill9_primary_mid_ingest_follower_promotes(data_dir, tmp_path):
         while time.monotonic() < deadline:
             core.probe_once()
             p = core.primary()
-            if p is not None and p.name == "fol":
+            if p is not None and p.name in ("fol", "fol2"):
                 break
             time.sleep(0.1)
         else:
             raise AssertionError(f"no promotion: {core.stats()}")
         assert core.promotions == 1
+        winner = {"fol": fol, "fol2": fol2}[p.name]
 
-        st, health = get(fol.base, "/healthz")
+        # ISSUE 18: one probe round runs under ONE trace id — the
+        # router's span ring and BOTH surviving replicas' rings hold it
+        with trace_scope(None) as probe_tid:
+            core.probe_once()
+        probed = {
+            s["attrs"]["replica"]
+            for s in spans_snapshot(probe_tid)
+            if s["name"] == "router.probe"
+        }
+        assert probed >= {"fol", "fol2"}, probed
+        for node in (fol, fol2):
+            with urllib.request.urlopen(
+                node.base + f"/debug/traces?trace_id={probe_tid}", timeout=30
+            ) as resp:
+                recs = [
+                    json.loads(ln)
+                    for ln in resp.read().decode().splitlines()
+                    if ln.strip()
+                ]
+            assert recs, f"{node.base} has no spans for the probe trace"
+            assert {r["trace_id"] for r in recs} == {probe_tid}
+
+        # segment replay left tagged spans on the promoted follower
+        with urllib.request.urlopen(
+            winner.base + "/debug/traces", timeout=30
+        ) as resp:
+            all_spans = [
+                json.loads(ln)
+                for ln in resp.read().decode().splitlines()
+                if ln.strip()
+            ]
+        applied = [s for s in all_spans if s["name"] == "repl.apply_segment"]
+        assert applied and all(
+            isinstance(s["attrs"]["segment"], int) for s in applied
+        )
+
+        # /fleet/status renders the promoted follower's watermark
+        status = core.fleet_status()
+        promoted_view = status["nodes"][p.name]
+        assert promoted_view["role"] == "primary"
+        assert promoted_view["applied_segment"] >= token["segment"]
+        assert promoted_view["applied_lag_segments"] == 0
+        assert status["last_failover_ms"] > 0.0
+
+        st, health = get(winner.base, "/healthz")
         assert st == 200 and health["role"] == "primary"
-        rows = _store_rows(fol.base, store_id)
+        rows = _store_rows(winner.base, store_id)
         # confirmed ⊆ recovered ⊆ acknowledged — and nothing invented
         assert rows >= _oracle(0, 70), "confirmed acked writes lost"
         assert rows <= _oracle(0, 90), "rows invented beyond acked writes"
         # the promoted node is a real primary: writes journal and serve
-        st, out = post(fol.base, "/store/load",
+        st, out = post(winner.base, "/store/load",
                        {"rdf": _ntriples(90, 95), "format": "ntriples",
                         "store_id": store_id})
         assert st == 200, out
-        assert _store_rows(fol.base, store_id) == rows | _oracle(90, 95)
+        assert _store_rows(winner.base, store_id) == rows | _oracle(90, 95)
     finally:
         prim.stop()
         fol.stop()
+        fol2.stop()

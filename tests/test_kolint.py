@@ -563,6 +563,55 @@ def root(x):
     assert res.findings[0].scope == "tally"
 
 
+# ------------------------------------- KL504: bare print in library code
+
+
+BAD_KL504 = """
+def apply_segment(idx):
+    print(f"applying segment {idx}")  # invisible to the log tail / traces
+    return idx
+"""
+
+GOOD_KL504 = """
+import sys
+
+def render_table(rows, out):
+    for row in rows:
+        print(row, file=out)  # user-facing output names its stream
+
+def export(text):
+    print(text, file=sys.stdout)
+
+if __name__ == "__main__":
+    print("usage: mod [args]")  # script body is CLI territory
+"""
+
+
+def test_kl504_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL504)
+    assert rules_fired(res) == ["KL504"]
+    assert res.findings[0].scope == "apply_segment"
+    assert "obs.log" in res.findings[0].message
+
+
+def test_kl504_good(tmp_path):
+    res = lint(tmp_path, GOOD_KL504)
+    assert res.findings == []
+
+
+def test_kl504_module_level_print_fires(tmp_path):
+    res = lint(tmp_path, "print('import-time chatter')\n")
+    assert rules_fired(res) == ["KL504"]
+    assert res.findings[0].scope == ""
+
+
+def test_kl504_exempts_entry_points_and_tests(tmp_path):
+    src = "print('hello from a script')\n"
+    assert lint(tmp_path, src, name="__main__.py").findings == []
+    assert lint(tmp_path, src, name="test_thing.py").findings == []
+    assert lint(tmp_path, src, name="conftest.py").findings == []
+
+
 # ------------------------------------------- KL601: swallowed exception
 
 
@@ -1081,7 +1130,7 @@ def test_cli_list_rules(capsys):
     assert kolint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("KL101", "KL102", "KL201", "KL202", "KL203", "KL301", "KL302",
-                "KL401", "KL501", "KL502", "KL503", "KL601", "KL701",
+                "KL401", "KL501", "KL502", "KL503", "KL504", "KL601", "KL701",
                 "KL001", "KL002"):
         assert rid in out
 
