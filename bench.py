@@ -1594,6 +1594,131 @@ def main():
         replication_block = {"error": repr(e)}
     note(f"replication fleet done ({replication_block})")
 
+    # ---- stats advisor: feedback-driven replanning A/B -------------------
+    # ISSUE-19 acceptance: advisor-off vs advisor-on over a mixed LUBM +
+    # triangle workload with identical rows on both sides, zero regression
+    # on the queries the static router already gets right, and the
+    # headline — the AGM-misrouted LUBM Q9 flipping from WCOJ to the
+    # measured binary join after one observed execution, while the
+    # triangle hub (AGM's home turf) stays on WCOJ.
+    note("stats advisor sweep")
+    stats_advisor_block = None
+    try:
+        from benches.lubm import (
+            LUBM_Q2 as _SQ2,
+            LUBM_Q9 as _SQ9,
+            generate_fast as _sgen,
+        )
+        from kolibrie_tpu.optimizer.stats_advisor import stats_advisor
+        from kolibrie_tpu.query.engine import QueryEngine as _SEngine
+        from kolibrie_tpu.query.sparql_database import (
+            SparqlDatabase as _SDb,
+        )
+
+        sa_env_before = {
+            k: os.environ.get(k)
+            for k in ("KOLIBRIE_STATS_ADVISOR", "KOLIBRIE_WCOJ")
+        }
+        try:
+            os.environ["KOLIBRIE_WCOJ"] = "auto"
+            adb = _SDb()
+            as_, ap_, ao_ = _sgen(30, adb.dictionary)
+            adb.store.add_batch(as_, ap_, ao_)
+            adb.store.compact()
+            adb.execution_mode = db.execution_mode
+            _M = 64
+            _tl = []
+            for _pred, _a, _b in (
+                ("p1", "x", "y"), ("p2", "y", "z"), ("p3", "z", "x")
+            ):
+                for _i in range(_M):
+                    _tl.append(
+                        f"<https://t.example/{_a}{_i}> "
+                        f"<https://t.example/{_pred}> "
+                        f"<https://t.example/{_b}0> ."
+                    )
+                    _tl.append(
+                        f"<https://t.example/{_a}0> "
+                        f"<https://t.example/{_pred}> "
+                        f"<https://t.example/{_b}{_i}> ."
+                    )
+            sdb = _SDb()
+            sdb.parse_ntriples("\n".join(_tl))
+            sdb.execution_mode = db.execution_mode
+            stri_q = (
+                "PREFIX t: <https://t.example/> SELECT ?x ?y ?z WHERE "
+                "{ ?x t:p1 ?y . ?y t:p2 ?z . ?z t:p3 ?x }"
+            )
+            workload = {
+                "lubm_q2": (adb, _SQ2),
+                "lubm_q9": (adb, _SQ9),
+                "triangle_agm": (sdb, stri_q),
+            }
+
+            def _sa_timed(dbx, q, n=5):
+                rows = execute_query_volcano(q, dbx)  # warm: learn
+                execute_query_volcano(q, dbx)  # drift replan lands here
+                best = float("inf")
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    execute_query_volcano(q, dbx)
+                    best = min(best, time.perf_counter() - t0)
+                return best * 1000.0, sorted(map(tuple, rows))
+
+            os.environ["KOLIBRIE_STATS_ADVISOR"] = "off"
+            off_ms, off_rows = {}, {}
+            for name, (dbx, q) in workload.items():
+                off_ms[name], off_rows[name] = _sa_timed(dbx, q)
+            os.environ["KOLIBRIE_STATS_ADVISOR"] = "auto"
+            stats_advisor.reset()
+            on_ms = {}
+            for name, (dbx, q) in workload.items():
+                ms, rows_on = _sa_timed(dbx, q)
+                assert rows_on == off_rows[name], (
+                    f"advisor A/B rows diverge on {name}"
+                )
+                on_ms[name] = ms
+            q9_exp = _SEngine(adb).explain_device(_SQ9, exact_counts=False)
+            tri_exp = _SEngine(sdb).explain_device(
+                stri_q, exact_counts=False
+            )
+            off_total, on_total = sum(off_ms.values()), sum(on_ms.values())
+            stats_advisor_block = {
+                name: {
+                    "rows": len(off_rows[name]),
+                    "advisor_off_ms": round(off_ms[name], 3),
+                    "advisor_on_ms": round(on_ms[name], 3),
+                    "speedup": (
+                        round(off_ms[name] / on_ms[name], 3)
+                        if on_ms[name] else None
+                    ),
+                }
+                for name in workload
+            }
+            stats_advisor_block.update(
+                {
+                    "q9_routing_flip": "wcoj elim=" not in q9_exp,
+                    "triangle_stays_wcoj": "wcoj elim=" in tri_exp,
+                    # _qps suffix = gated upward by scripts/bench_gate.py
+                    "advisor_off_mixed_qps": round(
+                        1000 * len(workload) / off_total, 1
+                    ),
+                    "advisor_on_mixed_qps": round(
+                        1000 * len(workload) / on_total, 1
+                    ),
+                    "replans": stats_advisor.stats()["replans_total"],
+                }
+            )
+        finally:
+            for k, v in sa_env_before.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    except Exception as e:  # noqa: BLE001 — bench must survive its probes
+        stats_advisor_block = {"error": repr(e)}
+    note(f"stats advisor sweep done ({stats_advisor_block})")
+
     # LUBM-1000 Q2/Q9 per-query wall-clock (real work per dispatch — no
     # amortization caveat): embedded from the watcher-captured artifact
     # so the headline file carries them without re-running a 4M-triple
@@ -1661,6 +1786,7 @@ def main():
                     "sharded_serving": sharded_block,
                     "compile_tail": compile_tail,
                     "mqo": mqo_block,
+                    "stats_advisor": stats_advisor_block,
                     "replication": replication_block,
                     "lubm1000": lubm,
                     "note": "public-API query: SPARQL parse + Streamertail "

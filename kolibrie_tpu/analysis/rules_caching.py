@@ -17,6 +17,20 @@ KL901  a cache/memo container subscript, ``.get`` or ``.setdefault``
        bare db/store object inside the key.  Keys that are plain
        strings/texts (no identity) are out of scope — identity-free
        keys cannot pin a stale store.
+
+KL902  a learned-state ``*Advisor`` class keyed on the template
+       fingerprint whose module defines an env-read mode flag
+       (``*_mode()``) that participates in NO template fingerprint —
+       not called inside any ``template_key``/``env_sig`` function and
+       absent from every ``env_sig = (...)`` assignment.  A mode flag
+       that gates *which plan a template gets* but stays out of the
+       fingerprint lets an off-mode process replay a plan the advisor
+       tuned (or vice versa) from a shared cache/manifest; the plan and
+       the key disagree (docs/OPTIMIZER.md).  Advisors whose module has
+       no mode function escape — state that is always-on (CapAdvisor's
+       capacity high-water marks) cannot desync a fingerprint.
+       Participation is checked across the analyzed file set, so run
+       kolint over the package root, not a single file.
 """
 
 from __future__ import annotations
@@ -159,4 +173,117 @@ def unversioned_store_cache_key(project: Project) -> List[Finding]:
                         scope=info.qualname,
                     )
                 )
+    return out
+
+
+# --------------------------------------------------------------- KL902
+
+_FP_PARAMS = ("fp", "fingerprint", "template_fp")
+
+
+def _reads_env(fn_node: ast.AST) -> bool:
+    """Does this function read process environment (``os.environ`` /
+    ``getenv``)?  That is what makes a ``*_mode()`` a routing flag."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if terminal_name(node) == "environ":
+                return True
+        if isinstance(node, ast.Call):
+            if terminal_name(node.func) == "getenv":
+                return True
+    return False
+
+
+def _module_mode_functions(f) -> dict:
+    """Module-level env-reading ``*_mode`` defs: name → lineno."""
+    out = {}
+    for qual, info in f.functions.items():
+        if "." in qual or not qual.endswith("_mode"):
+            continue
+        if _reads_env(info.node):
+            out[qual] = info.node.lineno
+    return out
+
+
+def _participating_names(project: Project) -> set:
+    """Call names that ride a template fingerprint anywhere in the
+    analyzed set: calls inside a ``template_key``/``env_sig`` function,
+    or inside the value of an ``env_sig = (...)`` assignment."""
+    names = set()
+
+    def collect_calls(node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                t = terminal_name(n.func)
+                if t:
+                    names.add(t)
+
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for qual, info in f.functions.items():
+            if qual.rsplit(".", 1)[-1] in ("template_key", "env_sig"):
+                collect_calls(info.node)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign):
+                if any(
+                    "env_sig" in (terminal_name(t) or "")
+                    for t in node.targets
+                ):
+                    collect_calls(node.value)
+    return names
+
+
+def _fp_keyed_advisors(f) -> list:
+    """ClassDefs named ``*Advisor*`` with a method taking a
+    fingerprint-ish parameter: (name, lineno) pairs."""
+    out = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.ClassDef) or "Advisor" not in node.name:
+            continue
+        keyed = any(
+            info.class_name == node.name
+            and any(p in _FP_PARAMS for p in info.params)
+            for info in f.functions.values()
+        )
+        if keyed:
+            out.append((node.name, node.lineno))
+    return out
+
+
+@rule(
+    "KL902",
+    "learned-state advisor keyed on template fingerprint whose mode "
+    "flag is outside the fingerprint — an off-mode process replays "
+    "tuned plans (or tuned processes replay static ones) from shared "
+    "caches; call the *_mode() inside template_key / env_sig "
+    "(docs/OPTIMIZER.md)",
+)
+def advisor_mode_outside_fingerprint(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    participating = _participating_names(project)
+    for f in project.files:
+        if f.tree is None:
+            continue
+        advisors = _fp_keyed_advisors(f)
+        if not advisors:
+            continue
+        modes = _module_mode_functions(f)
+        if not modes or any(name in participating for name in modes):
+            continue
+        mode_names = ", ".join(sorted(modes))
+        for cls, lineno in advisors:
+            out.append(
+                Finding(
+                    "KL902",
+                    f.rel,
+                    lineno,
+                    f"{cls} keys learned state on the template "
+                    f"fingerprint but its mode flag ({mode_names}) "
+                    "participates in no fingerprint — fold the mode "
+                    "into template_key/env_sig so off-mode processes "
+                    "never replay tuned plans",
+                    scope=cls,
+                )
+            )
     return out

@@ -141,6 +141,7 @@ def build_stats(state) -> dict:
     # capacity-advisor block: per-template current caps / high-water mark /
     # retry counts (process-wide — the advisor spans stores and survives
     # base-version churn; "is steady state really zero-retry" dashboard)
+    from kolibrie_tpu.optimizer.stats_advisor import stats_advisor
     from kolibrie_tpu.query.template import cap_advisor
 
     out = {
@@ -149,6 +150,9 @@ def build_stats(state) -> dict:
         "resilience": resilience,
         "compile_tail": compile_tail,
         "cap_advisor": cap_advisor.stats(),
+        # feedback-optimizer block: per-template learned-key counts,
+        # plan generation, replans and drift state (docs/OPTIMIZER.md)
+        "stats_advisor": stats_advisor.stats(),
     }
     # replication block: ship/apply counters + watermark/lag on nodes
     # with a role in a fleet (primary ship server or follower); absent on

@@ -83,6 +83,7 @@ import time as _time
 from kolibrie_tpu.obs import analyze as _analyze
 from kolibrie_tpu.obs import metrics as _obs_metrics
 from kolibrie_tpu.obs.spans import get_baggage as _get_baggage
+from kolibrie_tpu.optimizer import stats_advisor as _sa
 from kolibrie_tpu.obs.spans import span as _obs_span
 from kolibrie_tpu.ops import round_cap as _round_cap
 from kolibrie_tpu.resilience.deadline import check_deadline
@@ -1077,6 +1078,13 @@ class LoweredPlan:
     def __init__(self, db, plan, anti_plans=(), union_groups=(), optional_plans=()):
         self.db = db
         self.scan_descs: List[tuple] = []  # (order_name, (cs, cp, co)) per scan
+        # stats-advisor bookkeeping: canonical pattern sig per scan_idx,
+        # and per-WCOJ-group (level keys, covered-sig multiset) — recorded
+        # at lowering so observed counts can be keyed plan-shape-
+        # independently (optimizer/stats_advisor.py)
+        self.scan_sigs: List[str] = []
+        self.wcoj_level_keys: List[tuple] = []  # (advisor_key, join_idx)
+        self.wcoj_sig_groups: List[tuple] = []  # (sig tuple, last join_idx)
         self.mask_arrays: List[np.ndarray] = []
         self.mask_exprs: List[tuple] = []  # (op, const) per mask
         self._mask_keys: Dict[tuple, int] = {}
@@ -1491,6 +1499,7 @@ class LoweredPlan:
         order_idx = self._order(order_name)
         scan_idx = len(self.scan_descs)
         self.scan_descs.append((order_name, tuple(consts)))
+        self.scan_sigs.append(_sa.pattern_sig(pattern))
         out_vars: List[tuple] = []
         eq_pairs: List[tuple] = []
         seen: Dict[str, int] = {}
@@ -1580,8 +1589,18 @@ class LoweredPlan:
             levels.append(
                 WcojLevel(var, self.join_count, 0, tuple(accessors))
             )
+            self.wcoj_level_keys.append((f"wcoj:?{var}", self.join_count))
             self.join_count += 1
             eliminated.add(var)
+        # the last level's live count IS the output of joining exactly
+        # this pattern group — the same quantity any Volcano tree over
+        # the group would produce, hence the shared subset key
+        self.wcoj_sig_groups.append(
+            (
+                tuple(_sa.pattern_sig(s.pattern) for s in op.scans),
+                levels[-1].join_idx,
+            )
+        )
         return WcojSpec(tuple(levels)), set(op.elim_order)
 
     def _wrap_quoted(self, node, qvar: str, inner, bound_vars: set):
@@ -2495,6 +2514,10 @@ class LoweredPlan:
         self._join_caps = list(
             self.db.__dict__["_device_cap_cache"][self.cap_key]
         )
+        # calibration counts are EXACT per-join match counts: feed the
+        # stats advisor before the first dispatch so a misrouted cold
+        # template can already replan on its second execution
+        self._advise(counts)
         return counts
 
     # ------------------------------------------------------------ execution
@@ -2557,6 +2580,7 @@ class LoweredPlan:
                 self._last_counts = counts_h
                 self._store_caps()
                 self._emit_wcoj_obs(counts_h)
+                self._advise(counts_h)
                 if fp != "unknown":
                     cap_advisor.observe(
                         "device",
@@ -2601,6 +2625,112 @@ class LoweredPlan:
 
         walk(self.root)
 
+    def _advisor_sites(self) -> List[tuple]:
+        """Observable operator sites for the stats advisor: a list of
+        ``(source, idx, advisor_key, describe_key)`` where ``source`` is
+        ``"scan"`` (rows read from :meth:`_host_scan_ranges` row ``idx``)
+        or ``"count"`` (rows read from the converged counts at ``idx``).
+        Advisor keys are plan-shape-independent (pattern-sig based); the
+        describe keys match :meth:`describe`/``fetch_stats`` naming so
+        EXPLAIN can annotate nodes with their learned est/actual pair."""
+        cached = getattr(self, "_advisor_sites_cache", None)
+        if cached is not None:
+            return cached
+        sites: List[tuple] = []
+
+        def sigs(node) -> Optional[List[str]]:
+            if isinstance(node, ScanSpec):
+                sig = self.scan_sigs[node.scan_idx]
+                sites.append(
+                    ("scan", node.scan_idx, "scan:" + sig,
+                     f"scan{node.scan_idx}")
+                )
+                return [sig]
+            if isinstance(node, JoinSpec):
+                left, right = sigs(node.left), sigs(node.right)
+                if left is None or right is None:
+                    return None
+                got = left + right
+                sites.append(
+                    ("count", node.join_idx, _sa.subset_key(got),
+                     f"join{node.join_idx}")
+                )
+                return got
+            if isinstance(node, (FilterSpec, QuotedExpandSpec)):
+                # template-fixed transforms: the covered pattern group is
+                # the child's (the subset key names the group, and any
+                # filters a template applies to it apply identically
+                # under every candidate join tree)
+                return sigs(node.child)
+            if isinstance(node, LeftOuterSpec):
+                left, right = sigs(node.left), sigs(node.right)
+                if left is not None and right is not None:
+                    # the MATCHED part of a left-outer join is exactly the
+                    # inner join of the covered groups
+                    sites.append(
+                        ("count", node.join_idx,
+                         _sa.subset_key(left + right),
+                         f"optional{node.join_idx}")
+                    )
+                return None  # outer output != inner join of the leaves
+            if isinstance(node, AntiJoinSpec):
+                sigs(node.left)
+                sigs(node.right)
+                return None
+            if isinstance(node, UnionSpec):
+                for ch in node.children:
+                    sigs(ch)
+                return None
+            return None  # VALUES / WCOJ (levels handled below)
+
+        if self.root is not None:
+            sigs(self.root)
+        for akey, join_idx in self.wcoj_level_keys:
+            sites.append(("count", join_idx, akey, f"wcoj{join_idx}:live"))
+        for group, join_idx in self.wcoj_sig_groups:
+            sites.append(
+                ("count", join_idx, _sa.subset_key(list(group)),
+                 f"wcoj{join_idx}:live")
+            )
+        self._advisor_sites_cache = sites
+        return sites
+
+    def advisor_actuals(self, counts_h: List[int]) -> Dict[str, float]:
+        """Per-operator actual rows from one converged execution, keyed
+        plan-shape-independently.  Every input is already host-resident
+        (``converge`` read the counts; scan ranges are host binary
+        searches) — feeding the advisor adds ZERO device I/O."""
+        actuals: Dict[str, float] = {}
+        scan_rows = self._host_scan_ranges()
+        for source, idx, akey, _dkey in self._advisor_sites():
+            if source == "scan":
+                if idx < len(scan_rows):
+                    actuals[akey] = float(scan_rows[idx][1])
+            elif idx < len(counts_h):
+                actuals[akey] = float(counts_h[idx])
+        return actuals
+
+    def _advise(
+        self, counts_h: Optional[List[int]], rows: Optional[int] = None
+    ) -> None:
+        """Feed the stats advisor (KOLIBRIE_STATS_ADVISOR=auto) from one
+        execution's host-resident numbers; no-op when the advisor is off
+        or no template fingerprint is in flight."""
+        if _sa.stats_advisor_mode() == "off":
+            return
+        fp = _sa.current_fp()
+        if fp is None:
+            fp = _get_baggage("template", "unknown")
+            if fp == "unknown":
+                return
+        actuals = self.advisor_actuals(counts_h) if counts_h else {}
+        if rows is not None:
+            actuals["result"] = float(rows)
+        if actuals:
+            _sa.stats_advisor.observe(
+                fp, actuals, version=self.db.store.version_key()
+            )
+
     def to_table(self, out_cols, valid) -> BindingTable:
         _note_fetch("to_table")
         valid_h = np.asarray(valid)
@@ -2621,7 +2751,8 @@ class LoweredPlan:
         return {k: int(v) for k, v in fetched.items()}
 
     def describe(self, counts: Optional[List[int]] = None,
-                 analyze: Optional[Dict] = None) -> str:
+                 analyze: Optional[Dict] = None,
+                 drift: Optional[Dict] = None) -> str:
         """Readable physical-plan tree for EXPLAIN surfaces: scans with
         their sorted order + bound constants + live range size, joins with
         key variables, capacities and (when provided) exact match counts,
@@ -2631,18 +2762,39 @@ class LoweredPlan:
         ``analyze`` is a capture record from an actual dispatch (see
         :mod:`kolibrie_tpu.obs.analyze`): its ``operators`` map annotates
         every node with ``actual=`` rows (estimated-vs-actual side by
-        side) and joins/WCOJ levels with cap ``occ=`` percentages."""
+        side) and joins/WCOJ levels with cap ``occ=`` percentages.
+
+        ``drift`` is a stats-advisor report's ``ops`` map (advisor
+        operator key -> (est, actual)); matching nodes gain an
+        ``est=/actual=/x-off=`` drift column."""
         scan_ranges = self._host_scan_ranges()
         lines: List[str] = []
         ops = (analyze or {}).get("operators", {}) or {}
         acounts = (analyze or {}).get("counts", []) or []
         dseq = {"filter": 0, "anti": 0, "union": 0, "quoted": 0}
+        dmap: Dict[str, tuple] = {}
+        if drift:
+            for _src, _idx, akey, dkey in self._advisor_sites():
+                pair = drift.get(akey)
+                if pair is not None:
+                    dmap[dkey] = pair
 
         def term(c):
             return "?" if c is None else str(c)
 
+        def drift_col(dkey):
+            pair = dmap.get(dkey)
+            if pair is None:
+                return ""
+            est, act = pair
+            if est is None or act is None:
+                return ""
+            xoff = max(est, act) / max(min(est, act), 1.0)
+            return f" est={est:.0f} actual={act:.0f} x-off={xoff:.1f}"
+
         def actual(key):
-            return f" actual={ops[key]}" if key in ops else ""
+            base = f" actual={ops[key]}" if key in ops else ""
+            return base + drift_col(key)
 
         def occ(join_idx, cap):
             from kolibrie_tpu.query.template import occupancy_pct
@@ -2751,6 +2903,7 @@ class LoweredPlan:
                         )
                     lines.append(
                         f"{pad}  level ?{lv.var} cap={cap}{cnt}{act}"
+                        f"{drift_col(f'wcoj{lv.join_idx}:live')}"
                         f"{occ(lv.join_idx, cap)} [{accs}]"
                     )
             elif isinstance(node, ValuesSpec):
@@ -2843,6 +2996,8 @@ class LoweredPlan:
         with _obs_span("device.collect"):
             table = self.to_table(*parts)
         _COLLECT_LAT.observe(_time.perf_counter() - t1)
+        nrows = len(next(iter(table.values()))) if table else 0
+        self._advise(None, rows=nrows)
         cap = _analyze.active()
         if cap is not None:
             cap.record(
@@ -2851,7 +3006,7 @@ class LoweredPlan:
                 operators=self.fetch_stats(),
                 counts=list(getattr(self, "_last_counts", [])),
                 caps=list(self._join_caps),
-                rows=len(next(iter(table.values()))) if table else 0,
+                rows=nrows,
             )
         check_deadline("device.execute.done")
         return table

@@ -603,6 +603,37 @@ def _decide(
     return rows * (benef - 1) >= _threshold()
 
 
+def _advisor_prefix_rows(lowered, pfx) -> Optional[float]:
+    """Measured prefix output rows from the stats advisor, when it has
+    observed the prefix's covered pattern group (under ANY join tree for
+    this template) — a far better worthiness signal than the static
+    pre-lowering estimate the decision otherwise falls back to."""
+    from kolibrie_tpu.optimizer import stats_advisor as _sa
+
+    if _sa.stats_advisor_mode() == "off":
+        return None
+    view = _sa.stats_advisor.view(_sa.current_fp())
+    if not view:
+        return None
+    from kolibrie_tpu.optimizer.device_engine import JoinSpec, ScanSpec
+
+    def sigs(node):
+        if isinstance(node, ScanSpec):
+            return [lowered.scan_sigs[node.scan_idx]]
+        if isinstance(node, JoinSpec):
+            left, right = sigs(node.left), sigs(node.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        return None
+
+    got = sigs(pfx.root)
+    if got is None:
+        return None
+    key = "scan:" + got[0] if len(got) == 1 else _sa.subset_key(got)
+    return view.get(key)
+
+
 def try_shared_execute(lowered, host: bool = False) -> Optional[dict]:
     """Serve ``lowered`` from a shared prefix.  Returns a host binding
     table, or None — the caller continues down its unchanged path.
@@ -626,6 +657,9 @@ def try_shared_execute(lowered, host: bool = False) -> Optional[dict]:
         if owner is not None:
             reg.bind_standing(owner, pfx.fp)
         est = getattr(lowered, "est_prefix_rows", None)
+        learned = _advisor_prefix_rows(lowered, pfx)
+        if learned is not None:
+            est = learned
         if not _decide(reg, pfx.fp, owner, mode, est):
             _DECLINED.labels("unworthy").inc()
             return None

@@ -627,6 +627,7 @@ def interp_execute(lowered, max_attempts: int = 12):
             lowered._store_caps()
             _note_fetch("interp.collect")
             valid_h = np.asarray(out_valid)
+            lowered._advise(counts_h, rows=int(valid_h.sum()))
             cols_h = np.asarray(out_cols)
             table = {
                 var: cols_h[valid_h, prog.var_slots[var]].astype(np.uint32)
@@ -688,7 +689,19 @@ def should_interp(lowered) -> bool:
         return False
     if mode == "force":
         return True
-    return lowered.cap_key not in _compiled_keys(lowered.db)
+    if lowered.cap_key in _compiled_keys(lowered.db):
+        return False
+    # measured admission: when the stats advisor has seen this template
+    # produce intermediates past the interpreter's economical cell
+    # budget (cap rides every op row in the dense register file), the
+    # interpreter would either decline after compiling or pay a
+    # pathological dispatch — go straight to the specialized path
+    from kolibrie_tpu.optimizer import stats_advisor as _sa
+
+    peak = _sa.stats_advisor.peak_rows(_sa.current_fp())
+    if peak is not None and peak > _MAX_CELLS // (_MAX_OPS * 4):
+        return False
+    return True
 
 
 def mark_compiled(lowered) -> None:

@@ -8,6 +8,7 @@ cardinality maps, and a join-selectivity cache.  Counting is vectorized
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict
 
 import numpy as np
@@ -26,10 +27,19 @@ class DatabaseStats:
         self.subject_counts: Dict[int, float] = {}
         self.object_counts: Dict[int, float] = {}
         self.join_selectivity_cache: Dict[int, float] = {}
+        self._db_ref = None  # weakref to the sampled database
+
+    def database(self):
+        """The database these stats were sampled from (None for
+        hand-built stats or after the database was collected) — the
+        stats-advisor's host-oracle exploration needs a store to count
+        against (docs/OPTIMIZER.md)."""
+        return self._db_ref() if self._db_ref is not None else None
 
     @staticmethod
     def gather_stats_fast(db) -> "DatabaseStats":
         st = DatabaseStats()
+        st._db_ref = weakref.ref(db)
         s, p, o = db.store.columns()
         n = len(s)
         st.total_triples = n

@@ -273,7 +273,18 @@ def save_manifest(root: Optional[str] = None) -> Optional[str]:
     ranked = sorted(
         merged.values(), key=lambda e: (-e.get("hits", 0), e["fp"])
     )[:_MANIFEST_MAX]
-    payload = json.dumps({"version": 1, "templates": ranked}).encode()
+    from kolibrie_tpu.optimizer.stats_advisor import stats_advisor
+
+    payload = json.dumps(
+        {
+            "version": 1,
+            "templates": ranked,
+            # learned per-template cardinalities ride the same manifest:
+            # a restarted replica (or a follower bootstrapping from
+            # snapshot) starts with tuned routing instead of re-learning
+            "stats_advisor": stats_advisor.export_state(),
+        }
+    ).encode()
     try:
         from kolibrie_tpu.durability.fsio import atomic_write_bytes
 
@@ -299,3 +310,23 @@ def load_manifest(root: Optional[str] = None) -> List[dict]:
         if isinstance(e, dict) and isinstance(e.get("query"), str):
             out.append(e)
     return out
+
+
+def load_advisor_state(root: Optional[str] = None) -> int:
+    """Import the manifest's ``stats_advisor`` section into the
+    process-wide advisor; returns templates imported.  Corruption at any
+    level (file, JSON, section, entry) degrades to the static AGM model
+    — the section is advisory, exactly like the template list."""
+    path = manifest_path(root)
+    if path is None or not os.path.isfile(path):
+        return 0
+    try:
+        with open(path, "rb") as f:
+            doc = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return 0
+    if not isinstance(doc, dict):
+        return 0
+    from kolibrie_tpu.optimizer.stats_advisor import stats_advisor
+
+    return stats_advisor.import_state(doc.get("stats_advisor"))

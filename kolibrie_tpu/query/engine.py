@@ -151,6 +151,22 @@ class QueryEngine:
         from kolibrie_tpu.query.parser import parse_sparql_query
 
         self.db.register_prefixes_from_query(sparql)
+        # plan under the SAME template fingerprint the executor would
+        # use, so the Streamertail pass consults (and the analyze
+        # execution feeds) the stats advisor's learned cardinalities for
+        # this template — EXPLAIN shows the plan clients actually get
+        from kolibrie_tpu.optimizer import stats_advisor as _sa
+        from kolibrie_tpu.query.parser import parse_combined_query
+        from kolibrie_tpu.query.template import fingerprint_query
+
+        try:
+            fp, _ = fingerprint_query(
+                parse_combined_query(sparql, self.db.prefixes)
+            )
+        # kolint: ignore[KL601] EXPLAIN renders even for unparseable fp
+        except Exception:
+            fp = None
+        _sa.set_current_fp(fp)
         q = parse_sparql_query(sparql, self.db.prefixes)
         from kolibrie_tpu.query.executor import _branch_plan
         from kolibrie_tpu.query.subquery_inline import inline_subqueries
@@ -213,9 +229,20 @@ class QueryEngine:
         with obs_analyze.capture() as cap, trace_scope() as tid:
             lowered.execute()
         rec = cap.last("device") or {}
-        lines = [lowered.describe(counts, analyze=rec)]
+        rep = _sa.stats_advisor.report(fp)
+        drift = rep["ops"] if rep else None
+        lines = [lowered.describe(counts, analyze=rec, drift=drift)]
         if mqo_line:
             lines.append(mqo_line)
+        if _sa.stats_advisor_mode() == "off":
+            lines.append("advisor: off")
+        elif rep is None:
+            lines.append("advisor: source=agm replans=0 drift=cold")
+        else:
+            lines.append(
+                f"advisor: source={rep['source']}"
+                f" replans={rep['replans']} drift={rep['drift']}"
+            )
         if rec:
             lines.append(f"source: {rec.get('source', '?')}")
             lines.append(f"rows: {rec.get('rows', '?')}")

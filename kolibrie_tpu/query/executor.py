@@ -46,6 +46,9 @@ from kolibrie_tpu.query.ast import (
 )
 from kolibrie_tpu.obs import metrics as obs_metrics
 from kolibrie_tpu.obs.spans import set_baggage, span
+from kolibrie_tpu.optimizer.stats_advisor import (
+    set_current_fp as _sa_set_current_fp,
+)
 from kolibrie_tpu.query.parser import parse_combined_query
 from kolibrie_tpu.resilience.breaker import breaker_board
 from kolibrie_tpu.resilience.deadline import check_deadline
@@ -1020,16 +1023,27 @@ def _plan_cache_entry(db, sparql: str):
     ``plan``/``lowered`` keys ``eval_select_to_table`` consumes."""
     from kolibrie_tpu.optimizer.mqo import mqo_mode
     from kolibrie_tpu.optimizer.planner import wcoj_mode
+    from kolibrie_tpu.optimizer.stats_advisor import (
+        stats_advisor,
+        stats_advisor_mode,
+    )
     from kolibrie_tpu.ops.pallas_kernels import pallas_mode
     from kolibrie_tpu.query.compile_cache import record_template
     from kolibrie_tpu.query.template import fingerprint_query
 
     parse, templates, stats = _plan_caches(db)
     prefix_sig = tuple(sorted(db.prefixes.items()))
-    # the join-strategy, interpreter-routing, Pallas kernel and MQO
-    # sharing modes are part of the template fingerprint; a mode flip
-    # after parse must refingerprint (not replay the old-mode plan)
-    env_sig = (wcoj_mode(), _interp_mode(), pallas_mode(), mqo_mode())
+    # the join-strategy, interpreter-routing, Pallas kernel, MQO sharing
+    # and stats-advisor modes are part of the template fingerprint; a
+    # mode flip after parse must refingerprint (not replay the old-mode
+    # plan)
+    env_sig = (
+        wcoj_mode(),
+        _interp_mode(),
+        pallas_mode(),
+        mqo_mode(),
+        stats_advisor_mode(),
+    )
     ent = parse.get(sparql)
     if ent is None or ent["prefix_sig"] != prefix_sig or ent["env_sig"] != env_sig:
         ent = {
@@ -1168,6 +1182,24 @@ def _plan_cache_entry(db, sparql: str):
             stats["hits"] += 1
             tent["hits"] += 1
             _PLAN_CACHE_HIT.inc()
+    # drift-triggered replan: the stats advisor bumps a template's plan
+    # generation when observed cardinalities drift past the estimates the
+    # cached plan was built from (mutation churn moving selectivities, or
+    # the cold→learned transition).  A stale stamp drops the plan AND the
+    # lowered program — the rebuild replans with the tuned stats; the jit
+    # executable for an unchanged plan shape replays from its spec-keyed
+    # cache without recompiling.  Same slot-expiry discipline as the
+    # breaker epoch above; the MODE itself already rode in via env_sig.
+    gen = stats_advisor.plan_gen(fp)
+    if slot.get("advisor_gen") is None:
+        slot["advisor_gen"] = gen
+    elif slot["advisor_gen"] != gen:
+        slot["plan"] = None
+        slot["lowered"] = None
+        slot["ordered_failed"] = False
+        slot["advisor_gen"] = gen
+        stats["advisor_replans"] = stats.get("advisor_replans", 0) + 1
+        stats_advisor.note_replan(fp)
     return ent, slot
 
 
@@ -1210,6 +1242,7 @@ def plan_cache_info(db) -> dict:
         "batch_groups": stats["batch_groups"],
         "sticky_failures": sticky,
         "sentinel_expiries": stats.get("sentinel_expiries", 0),
+        "advisor_replans": stats.get("advisor_replans", 0),
         "per_template": per,
         "limits": {
             "parse": _PLAN_CACHE_MAX,
@@ -1259,6 +1292,11 @@ def execute_query_volcano(sparql: str, db) -> Rows:
     # baggage lets device_engine label its lower/dispatch timings with
     # the template fingerprint without threading it through eval_where
     set_baggage("template", fp)
+    # the stats advisor's own channel: planning (Streamertail) and the
+    # observation hooks key learned cardinalities on the fingerprint —
+    # routing state must not ride the observability baggage, which dies
+    # with the obs kill switch
+    _sa_set_current_fp(fp)
     if not _device_routed(db):
         t0 = time.perf_counter()
         with span("query.execute", template=fp, path="host"):
@@ -1390,6 +1428,7 @@ def execute_queries_batched(db, queries: List[str]) -> List[Rows]:
             # single-device interpreter instead (docs/COMPILE_CACHE.md)
             continue
         set_baggage("template", fp)
+        _sa_set_current_fp(fp)
         if sharded is not None:
             # mesh-first: the whole template group rides one shard_map
             # dispatch (parallel/sharded_serving.py); on Unsupported or a

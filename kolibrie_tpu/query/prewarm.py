@@ -104,6 +104,10 @@ def replay_manifest(
     restart regression test calls this directly: after it returns, the
     first real query must trigger zero XLA compiles and zero disk
     misses."""
+    # tuned routing rides the same manifest: import the advisor section
+    # BEFORE replaying, so even the warm executions plan from the
+    # previous process's learned cardinalities
+    compile_cache.load_advisor_state(root)
     results: List[dict] = []
     for ent in compile_cache.load_manifest(root)[:top_n]:
         results.append(
@@ -206,6 +210,7 @@ class PrewarmManager:
         first) against every current target.  Serialized against the
         background thread's own sweep."""
         n = top_n or self.top_n
+        compile_cache.load_advisor_state(self.root)
         merged = {e["fp"]: e for e in compile_cache.load_manifest(self.root)}
         for e in compile_cache.manifest_snapshot():
             old = merged.get(e["fp"])

@@ -163,20 +163,34 @@ def template_key(cq: CombinedQuery) -> Tuple[Any, Tuple[Any, ...]]:
     re-learn in a fresh slot, never replay a stale one.  ``KOLIBRIE_MQO``
     is the fourth: shared-prefix routing changes which engine produces a
     template's rows, so a mode flip must land in a fresh fingerprint
-    (``off`` reproduces pre-MQO behavior bit-for-bit, docs/MQO.md)."""
+    (``off`` reproduces pre-MQO behavior bit-for-bit, docs/MQO.md).
+    ``KOLIBRIE_STATS_ADVISOR`` is the fifth: the feedback optimizer keys
+    its learned cardinalities (and its plan-generation counter) on the
+    fingerprint, so a mode flip must replan in a fresh slot where ``off``
+    is bitwise-inert and ``auto`` re-learns from scratch
+    (docs/OPTIMIZER.md)."""
     from kolibrie_tpu.optimizer.planner import wcoj_mode  # lazy: avoids cycle
     from kolibrie_tpu.optimizer.mqo import mqo_mode
     from kolibrie_tpu.optimizer.plan_interp import plan_interp_mode
+    from kolibrie_tpu.optimizer.stats_advisor import stats_advisor_mode
     from kolibrie_tpu.ops.pallas_kernels import pallas_mode
 
     params: List[Any] = []
     structure = (
-        "mqo",
-        mqo_mode(),
+        "stats",
+        stats_advisor_mode(),
         (
-            "interp",
-            plan_interp_mode(),
-            ("pallas", pallas_mode(), ("wcoj", wcoj_mode(), _ser(cq, params))),
+            "mqo",
+            mqo_mode(),
+            (
+                "interp",
+                plan_interp_mode(),
+                (
+                    "pallas",
+                    pallas_mode(),
+                    ("wcoj", wcoj_mode(), _ser(cq, params)),
+                ),
+            ),
         ),
     )
     return structure, tuple(params)

@@ -282,6 +282,29 @@ def smoke() -> None:
         "fleet_obs.fleet_metrics_scrape_p50_ms" in r for r in regs
     ), "missed 4x fleet scrape regression"
 
+    # the stats-advisor block gates the same way: mixed-workload qps on
+    # both sides gates upward, per-query _ms keys gate downward, the
+    # routing-flip booleans and replan counts stay informational
+    assert _direction("secondary.stats_advisor.advisor_on_mixed_qps") == "up"
+    assert _direction("secondary.stats_advisor.advisor_off_mixed_qps") == "up"
+    assert _direction(
+        "secondary.stats_advisor.lubm_q9.advisor_on_ms"
+    ) == "down"
+    assert _direction("secondary.stats_advisor.replans") is None
+    withsa = json.loads(json.dumps(trajectory[-1]))
+    withsa.setdefault("secondary", {})["stats_advisor"] = {
+        "advisor_on_mixed_qps": 50.0,
+        "q9_routing_flip": True,
+    }
+    base = [json.loads(json.dumps(withsa))]
+    slow = json.loads(json.dumps(withsa))
+    slow["secondary"]["stats_advisor"]["advisor_on_mixed_qps"] = 20.0
+    slow["secondary"]["stats_advisor"]["q9_routing_flip"] = False
+    regs, _ = compare(slow, base)
+    assert any(
+        "stats_advisor.advisor_on_mixed_qps" in r for r in regs
+    ), "missed 60% advisor-on qps regression"
+
     # timeline ring end to end, against an isolated registry
     sys.path.insert(0, REPO)
     from kolibrie_tpu.obs import metrics as m
@@ -314,6 +337,35 @@ def smoke() -> None:
                 "fleet_metrics_scrape_p50_ms"):
         assert fo.get(key, 0) > 0, (key, fo)
     assert fo.get("fleet_metrics_nodes", 0) >= 3, fo
+
+    # live stats-advisor smoke: the q9 routing flip end to end on a
+    # miniature campus KG, no device compile — EXPLAIN's host-oracle
+    # calibration both feeds the advisor and renders the replanned route
+    from kolibrie_tpu.optimizer import stats_advisor as sa_mod
+    from kolibrie_tpu.query.engine import QueryEngine
+    from kolibrie_tpu.query.sparql_database import SparqlDatabase
+
+    sys.path.insert(0, os.path.join(REPO, "benches"))
+    import lubm as _lubm
+
+    sa_mod.stats_advisor.reset()
+    adb = SparqlDatabase()
+    _s, _p, _o = _lubm.generate_fast(2, adb.dictionary)
+    adb.store.add_batch(_s, _p, _o)
+    adb.store.compact()
+    try:
+        with sa_mod.override_mode("off"):
+            cold = QueryEngine(adb).explain_device(_lubm.LUBM_Q9)
+            assert "wcoj elim=" in cold, "static router no longer AGM-routes q9"
+        with sa_mod.override_mode("auto"):
+            QueryEngine(adb).explain_device(_lubm.LUBM_Q9)  # learn
+            warm = QueryEngine(adb).explain_device(_lubm.LUBM_Q9)
+            assert "wcoj elim=" not in warm, "advisor failed to flip q9"
+        sa_stats = sa_mod.stats_advisor.stats()
+        assert sa_stats["observations"] > 0, sa_stats
+    finally:
+        sa_mod.stats_advisor.reset()
+
     print(
         f"bench gate smoke OK: {len(trajectory)} trajectory rounds, "
         f"{len(checked)} gated metrics, ring deltas verified, "
@@ -323,7 +375,9 @@ def smoke() -> None:
         f"failover={repl['failover_ms']}ms "
         f"fleet_obs: router={fo['router_instrumented_read_qps']}qps "
         f"overhead={fo['obs_overhead_pct']}% "
-        f"scrape_p50={fo['fleet_metrics_scrape_p50_ms']}ms"
+        f"scrape_p50={fo['fleet_metrics_scrape_p50_ms']}ms, "
+        f"stats-advisor q9 flip verified "
+        f"({sa_stats['observations']} observations)"
     )
 
 

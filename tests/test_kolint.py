@@ -1004,6 +1004,99 @@ def test_kl901_identity_free_key_is_out_of_scope(tmp_path):
     assert res.findings == []
 
 
+# ----------------------------- KL902: advisor mode-flag participation
+
+
+BAD_KL902 = """
+import os
+
+def tuning_mode():
+    return os.environ.get("X_TUNING", "off")
+
+class TuningAdvisor:
+    def __init__(self):
+        self._entries = {}
+
+    def observe(self, fp, rows):
+        if tuning_mode() == "off":
+            return
+        self._entries[fp] = rows
+"""
+
+GOOD_KL902_TEMPLATE_KEY = BAD_KL902 + """
+def template_key(cq):
+    return (tuning_mode(), cq)
+"""
+
+GOOD_KL902_ENV_SIG = BAD_KL902 + """
+def plan(sparql):
+    env_sig = (tuning_mode(),)
+    return env_sig
+"""
+
+GOOD_KL902_NO_MODE_FLAG = """
+class CapAdvisor:
+    def __init__(self):
+        self._entries = {}
+
+    def observe(self, fp, caps):
+        self._entries[fp] = caps
+"""
+
+GOOD_KL902_NOT_FP_KEYED = """
+import os
+
+def tuning_mode():
+    return os.environ.get("X_TUNING", "off")
+
+class RetryAdvisor:
+    def observe(self, engine, caps):
+        self.caps = caps
+"""
+
+
+def test_kl902_bad(tmp_path):
+    res = lint(tmp_path, BAD_KL902)
+    assert rules_fired(res) == ["KL902"]
+    assert "tuning_mode" in res.findings[0].message
+    assert res.findings[0].scope == "TuningAdvisor"
+
+
+def test_kl902_template_key_participation_is_clean(tmp_path):
+    res = lint(tmp_path, GOOD_KL902_TEMPLATE_KEY)
+    assert res.findings == []
+
+
+def test_kl902_env_sig_participation_is_clean(tmp_path):
+    res = lint(tmp_path, GOOD_KL902_ENV_SIG)
+    assert res.findings == []
+
+
+def test_kl902_always_on_advisor_escapes(tmp_path):
+    res = lint(tmp_path, GOOD_KL902_NO_MODE_FLAG)
+    assert res.findings == []
+
+
+def test_kl902_fingerprint_free_advisor_escapes(tmp_path):
+    res = lint(tmp_path, GOOD_KL902_NOT_FP_KEYED)
+    assert res.findings == []
+
+
+def test_kl902_cross_module_participation_is_clean(tmp_path):
+    # the mode flag lives in one module, template_key in another — the
+    # real repo's shape (stats_advisor.py vs template.py)
+    (tmp_path / "advisor.py").write_text(BAD_KL902)
+    (tmp_path / "keys.py").write_text(
+        "from advisor import tuning_mode\n"
+        "def template_key(cq):\n"
+        "    return (tuning_mode(), cq)\n"
+    )
+    res = core.run(
+        [str(tmp_path)], use_baseline=False, root=str(tmp_path)
+    )
+    assert res.findings == []
+
+
 # ------------------------------------------------ suppression mechanics
 
 
