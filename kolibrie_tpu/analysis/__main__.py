@@ -5,9 +5,18 @@
     python -m kolibrie_tpu.analysis --no-baseline     raw findings
     python -m kolibrie_tpu.analysis --write-baseline  regenerate baseline
     python -m kolibrie_tpu.analysis --list-rules      rule catalog
+    python -m kolibrie_tpu.analysis --explain KL311   rule doc + fix recipe
+    python -m kolibrie_tpu.analysis --changed-only    report only edited files
 
-Exit status: 0 when no non-baselined findings remain, 1 otherwise,
-2 on usage errors.
+Performance: results are cached per (project signature, rule) under
+.kolint_cache/ and cold rules fan out over a small process pool —
+``--no-cache`` / ``--jobs N`` (default ``KOLINT_JOBS`` or cpu-derived)
+control both.  Every run prints ``kolint_runtime_s=…``; ``--max-seconds``
+turns that number into a gate so lint stays fast enough to run on every
+commit.
+
+Exit status: 0 when no non-baselined findings remain, 1 otherwise (or
+when --max-seconds is exceeded), 2 on usage errors.
 """
 
 from __future__ import annotations
@@ -16,8 +25,20 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from kolibrie_tpu.analysis import core
+
+
+def _default_jobs() -> int:
+    env = os.environ.get("KOLINT_JOBS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    # rules bucket into ~10 families; more workers than that is churn
+    return min(4, os.cpu_count() or 1)
 
 
 def main(argv=None) -> int:
@@ -58,17 +79,63 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    ap.add_argument(
+        "--explain",
+        metavar="RULE",
+        default=None,
+        help="print one rule's documentation, example, and fix recipe",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="skip the .kolint_cache result cache (always re-analyze)",
+    )
+    ap.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        metavar="N",
+        help="process-pool workers for rule execution "
+        "(default: $KOLINT_JOBS or cpu-derived; 1 = in-process)",
+    )
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="report only findings in files changed since the last full "
+        "run (analysis still covers the whole project)",
+    )
+    ap.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help="fail (exit 1) if the lint run takes longer than S seconds",
+    )
     args = ap.parse_args(argv)
 
-    # import for registration before --list-rules
+    # import for registration before --list-rules / --explain
     from kolibrie_tpu.analysis import (  # noqa: F401
         rules_caching,
         rules_context,
+        rules_durability,
         rules_errors,
         rules_locks,
         rules_obs,
+        rules_pallas,
+        rules_races,
+        rules_taint,
         rules_tracing,
     )
+
+    if args.explain:
+        from kolibrie_tpu.analysis.explain import explain
+
+        text = explain(args.explain.strip().upper())
+        if text is None:
+            print(f"unknown rule id: {args.explain}", file=sys.stderr)
+            return 2
+        print(text)
+        return 0
 
     if args.list_rules:
         for rid in sorted(core.RULES):
@@ -91,11 +158,19 @@ def main(argv=None) -> int:
             return 2
 
     baseline_path = args.baseline or core.default_baseline_path()
+    t0 = time.perf_counter()
     result = core.run(
         paths,
         baseline_path=baseline_path,
         use_baseline=not (args.no_baseline or args.write_baseline),
         rules=rule_ids,
+        use_cache=not args.no_cache,
+        jobs=args.jobs if args.jobs is not None else _default_jobs(),
+        changed_only=args.changed_only,
+    )
+    runtime_s = time.perf_counter() - t0
+    too_slow = (
+        args.max_seconds is not None and runtime_s > args.max_seconds
     )
 
     if args.write_baseline:
@@ -112,7 +187,8 @@ def main(argv=None) -> int:
                     "findings": [f.to_dict() for f in result.findings],
                     "suppressed": len(result.suppressed),
                     "baselined": len(result.baselined),
-                    "ok": result.ok,
+                    "runtime_s": round(runtime_s, 2),
+                    "ok": result.ok and not too_slow,
                 },
                 indent=2,
             )
@@ -126,6 +202,14 @@ def main(argv=None) -> int:
             f"{len(result.baselined)} baselined"
         )
         print(tail)
+        print(f"kolint_runtime_s={runtime_s:.2f}")
+    if too_slow:
+        print(
+            f"kolint exceeded --max-seconds {args.max_seconds:g} "
+            f"(took {runtime_s:.2f}s)",
+            file=sys.stderr,
+        )
+        return 1
     return 0 if result.ok else 1
 
 

@@ -174,6 +174,9 @@ def run(
     use_baseline: bool = True,
     rules: Optional[Sequence[str]] = None,
     root: Optional[str] = None,
+    use_cache: bool = False,
+    jobs: int = 1,
+    changed_only: bool = False,
 ) -> RunResult:
     # rule modules self-register on import
     from kolibrie_tpu.analysis import (  # noqa: F401
@@ -184,8 +187,11 @@ def run(
         rules_locks,
         rules_obs,
         rules_pallas,
+        rules_races,
+        rules_taint,
         rules_tracing,
     )
+    from kolibrie_tpu.analysis import cache as _cache
 
     root = root or repo_root()
     files = load_files(list(paths), root)
@@ -198,14 +204,49 @@ def run(
                 Finding(META_PARSE, f.rel, 1, f"syntax error: {f.parse_error}")
             )
     active = rules if rules is not None else sorted(RULES)
+
+    # per-(project signature, rule) cache of RAW findings; suppressions
+    # and the baseline are applied after, so they can change without
+    # invalidating cached analysis (their inputs are in the signature
+    # anyway for suppressions, and the baseline is a post-filter)
+    per_rule: Dict[str, List[Finding]] = {}
+    sig: Optional[str] = None
+    missing = list(active)
+    if use_cache:
+        sig = _cache.project_signature(files)
+        missing = []
+        for rule_id in active:
+            got = _cache.get_rule(root, sig, rule_id)
+            if got is None:
+                missing.append(rule_id)
+            else:
+                per_rule[rule_id] = [Finding(**d) for d in got]
+    if missing:
+        for rule_id, dicts in _cache.run_rules(
+            project, missing, jobs=jobs
+        ).items():
+            per_rule[rule_id] = [Finding(**d) for d in dicts]
+            if sig is not None:
+                _cache.put_rule(root, sig, rule_id, dicts)
+    if sig is not None:
+        _cache.gc(root, sig)
     for rule_id in active:
-        _, fn = RULES[rule_id]
-        findings.extend(fn(project))
+        findings.extend(per_rule.get(rule_id, []))
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
 
     kept, suppressed, meta = _apply_suppressions(files, findings)
     kept.extend(meta)
     kept.sort(key=lambda x: (x.path, x.line, x.rule))
+
+    if changed_only:
+        # the ANALYSIS covered the whole project (interprocedural rules
+        # need it); only the REPORT narrows to files that changed since
+        # the last full run's manifest
+        changed = _cache.changed_files(root, files)
+        kept = [f for f in kept if f.path in changed]
+    elif use_cache:
+        # full runs advance the --changed-only reference point
+        _cache.write_manifest(root, _cache.file_digests(files))
 
     baselined: List[Finding] = []
     if use_baseline:

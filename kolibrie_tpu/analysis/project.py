@@ -26,7 +26,9 @@ from typing import Dict, List, Optional, Set, Tuple
 
 _SUPPRESS_RE = re.compile(r"#\s*kolint:\s*ignore\[([^\]]*)\]\s*(.*)")
 _HOLDS_RE = re.compile(r"#\s*kolint:\s*holds\[([^\]]+)\]")
-_GUARDED_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z_][\w.]*)")
+_GUARDED_RE = re.compile(
+    r"#\s*guarded by:\s*([A-Za-z_][\w.]*)(?:\s*\((writes|rw)\))?"
+)
 
 # Decorator / callee names that create a jit compilation boundary.
 JIT_WRAPPER_NAMES = {"jit", "pjit", "shard_map", "_shard_map", "pmap"}
@@ -43,12 +45,23 @@ class Suppression:
 
 @dataclass
 class GuardedState:
-    """One ``# guarded by: <lock>`` annotation on mutable state."""
+    """One ``# guarded by: <lock>`` annotation on mutable state.
+
+    ``mode`` tunes the RUNTIME sanitizer (:mod:`analysis.lockcheck`)
+    only — the static rules treat every mode identically:
+
+    - ``"writes"`` (default): rebinding writes must hold the lock;
+      reads may be lock-free (the snapshot-read idiom).
+    - ``"rw"``: reads must hold it too — use for state mutated in
+      place (``list.append``/dict writes), which a descriptor can only
+      see as a read of the container.
+    """
 
     attr: str  # attribute or module-global name
     lock: str  # annotation text, e.g. "self.lock" / "_ring_lock"
     class_name: Optional[str]  # None → module-level global
     line: int
+    mode: str = "writes"
 
 
 @dataclass
@@ -116,7 +129,7 @@ class SourceFile:
             if m:
                 # attached to guarded state by _index_functions below
                 self._pending_guard = getattr(self, "_pending_guard", {})
-                self._pending_guard[lineno] = m.group(1)
+                self._pending_guard[lineno] = (m.group(1), m.group(2) or "writes")
 
     def holds_for_line(self, lineno: int) -> Tuple[str, ...]:
         """``# kolint: holds[lock]`` directives on a def's line."""
@@ -224,7 +237,9 @@ class Project:
     # ------------------------------------------------------------ indexing
 
     def _index_functions(self, f: SourceFile) -> None:
-        pending_guard: Dict[int, str] = getattr(f, "_pending_guard", {})
+        pending_guard: Dict[int, Tuple[str, str]] = getattr(
+            f, "_pending_guard", {}
+        )
 
         def visit(node: ast.AST, class_name: Optional[str], prefix: str):
             for child in ast.iter_child_nodes(node):
@@ -254,8 +269,9 @@ class Project:
                 else:
                     # guarded-state annotations live on assignments
                     if isinstance(child, (ast.Assign, ast.AnnAssign)):
-                        lock = pending_guard.get(child.lineno)
-                        if lock:
+                        guard = pending_guard.get(child.lineno)
+                        if guard:
+                            lock, mode = guard
                             targets = (
                                 child.targets
                                 if isinstance(child, ast.Assign)
@@ -267,7 +283,7 @@ class Project:
                                     f.guarded.append(
                                         GuardedState(
                                             attr, lock, class_name,
-                                            child.lineno,
+                                            child.lineno, mode=mode,
                                         )
                                     )
                     visit(child, class_name, prefix)
@@ -442,7 +458,15 @@ class Project:
 def iter_own_nodes(func_node: ast.AST):
     """Every AST node lexically inside ``func_node``'s body, excluding
     nested function/class bodies (indexed as their own FuncInfos) and
-    the function's own signature/decorators."""
+    the function's own signature/decorators.
+
+    The walk is memoized on the node (every rule family re-walks every
+    function; one shared list per function is the difference between a
+    seconds-scale and a minutes-scale repo run)."""
+    cached = getattr(func_node, "_kolint_own_nodes", None)
+    if cached is not None:
+        return cached
+    out: List[ast.AST] = []
     work = list(getattr(func_node, "body", []))
     while work:
         node = work.pop()
@@ -450,11 +474,16 @@ def iter_own_nodes(func_node: ast.AST):
             node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
         ):
             continue
-        yield node
+        out.append(node)
         if isinstance(node, ast.Lambda):
             work.append(node.body)
             continue
         work.extend(ast.iter_child_nodes(node))
+    try:
+        func_node._kolint_own_nodes = out
+    except (AttributeError, TypeError):
+        pass
+    return out
 
 
 def _modpath_of(rel: str) -> str:

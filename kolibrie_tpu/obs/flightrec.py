@@ -225,7 +225,8 @@ class FlightRecorder:
         self.interval_s = interval_s
         self.stats_fn = stats_fn
         self.ring = ring
-        self.checkpoints = 0
+        self._stats_lock = threading.Lock()
+        self.checkpoints = 0  # guarded by: _stats_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -249,7 +250,8 @@ class FlightRecorder:
             _manifest("checkpoint", list(files)),
             fsync=False,
         )
-        self.checkpoints += 1
+        with self._stats_lock:
+            self.checkpoints += 1
         _BUNDLES.labels("checkpoint").inc()
         return box
 
@@ -276,8 +278,17 @@ class FlightRecorder:
                 _log.error("blackbox checkpoint failed", error=repr(exc))
 
     def stats(self) -> dict:
+        with self._stats_lock:
+            done = self.checkpoints
         return {
             "interval_s": self.interval_s,
-            "checkpoints": self.checkpoints,
+            "checkpoints": done,
             "blackbox": self.blackbox_path,
         }
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

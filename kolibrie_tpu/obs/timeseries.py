@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from kolibrie_tpu.obs import metrics
+from kolibrie_tpu.resilience.faultinject import InjectedFault, fault_point
 
 DEFAULT_CAPACITY = 256
 DEFAULT_INTERVAL_S = 5.0
@@ -64,17 +65,28 @@ class TimeSeriesRing:
         self._samples: List[dict] = []  # guarded by: _lock
         self._seq = 0  # guarded by: _lock — monotonic, survives eviction
 
+    def _append_sample(self, snap: dict, ts: float) -> int:  # kolint: holds[_lock]
+        seq = self._seq
+        self._seq += 1
+        self._samples.append({"seq": seq, "ts": ts, "snap": snap})
+        if len(self._samples) > self.capacity:
+            del self._samples[: len(self._samples) - self.capacity]
+        return seq
+
     def record(self, now: Optional[float] = None) -> int:
         """Take one snapshot.  Returns the sample's sequence number."""
         snap = self.registry.snapshot()
         ts = time.time() if now is None else now
+        try:
+            fault_point("lockcheck.bypass")
+        except InjectedFault:
+            # seeded guard violation: on injection the holds[_lock] claim
+            # above is FALSE — the chaos suite asserts the
+            # KOLIBRIE_DEBUG_LOCKS sanitizer reports this very access,
+            # proving the checker checks (tests/test_chaos.py)
+            return self._append_sample(snap, ts)
         with self._lock:
-            seq = self._seq
-            self._seq += 1
-            self._samples.append({"seq": seq, "ts": ts, "snap": snap})
-            if len(self._samples) > self.capacity:
-                del self._samples[: len(self._samples) - self.capacity]
-            return seq
+            return self._append_sample(snap, ts)
 
     def __len__(self) -> int:
         with self._lock:
@@ -208,3 +220,10 @@ def default_ring() -> TimeSeriesRing:
         if _DEFAULT_RING is None:
             _DEFAULT_RING = TimeSeriesRing()
         return _DEFAULT_RING
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

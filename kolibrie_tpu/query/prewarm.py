@@ -168,8 +168,8 @@ class PrewarmManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()  # serializes run_once vs the thread
-        self.startup_replayed = 0
-        self.last_results: List[dict] = []
+        self.startup_replayed = 0  # guarded by: _lock
+        self.last_results: List[dict] = []  # guarded by: _lock
 
     # ------------------------------------------------------------- lifecycle
 
@@ -199,7 +199,9 @@ class PrewarmManager:
             while not self._stop.is_set() and not self.is_ready():
                 time.sleep(_IDLE_WAIT_S)
             if not self._stop.is_set():
-                self.startup_replayed = len(self.run_once())
+                replayed = len(self.run_once())
+                with self._lock:
+                    self.startup_replayed = replayed
             while not self._stop.wait(self.save_interval_s):
                 compile_cache.save_manifest(self.root)
 
@@ -226,12 +228,20 @@ class PrewarmManager:
                 if self._stop.is_set():
                     break
                 results.append(_warm_entry(ent, targets, self.is_idle))
-        self.last_results = results
+            self.last_results = results
         return results
 
     def stats(self) -> dict:
-        return {
-            "startup_replayed": self.startup_replayed,
-            "top_n": self.top_n,
-            "last_sweep": len(self.last_results),
-        }
+        with self._lock:
+            return {
+                "startup_replayed": self.startup_replayed,
+                "top_n": self.top_n,
+                "last_sweep": len(self.last_results),
+            }
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

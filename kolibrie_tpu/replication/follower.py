@@ -134,22 +134,23 @@ class ReplicationFollower:
         self.manager = DurabilityManager(data_dir)
         self.client = ShipClient(source_host, source_port, timeout_s=timeout_s)
         self.res = RecoveryResult()
-        self.applied_segment = 0
-        self.applied_records = 0
-        self.primary_pos = (0, 0)  # last seen (active_segment, offset)
+        self.applied_segment = 0  # guarded by: _lock
+        self.applied_records = 0  # guarded by: _lock
+        # last seen (active_segment, offset)
+        self.primary_pos = (0, 0)  # guarded by: _lock
         # primary's process-lifetime append count, and its value at our
         # last bootstrap: the difference minus our own applies is the
         # lag-in-records SLO estimate (clamped — the counters live in
         # different processes and reset on different events)
-        self.primary_records = 0
-        self.records_baseline = 0
-        self.last_applied_unix = 0.0
+        self.primary_records = 0  # guarded by: _lock
+        self.records_baseline = 0  # guarded by: _lock
+        self.last_applied_unix = 0.0  # guarded by: _lock
         self.bootstrapped = False
         self.promoted = False
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats_counters = {
+        self.stats_counters = {  # guarded by: _lock (rw)
             "polls": 0,
             "poll_errors": 0,
             "segments_applied": 0,
@@ -233,16 +234,19 @@ class ReplicationFollower:
                 if db is not None:
                     self.on_store_update(sid, db, created=not known)
             i = j
-        self.applied_records += len(records)
+        with self._lock:
+            self.applied_records += len(records)
+            total = self.applied_records
         _RECORDS_APPLIED.inc(len(records))
-        _APPLIED_RECORDS.set(self.applied_records)
+        _APPLIED_RECORDS.set(total)
 
     def _advance_from_local(self) -> None:
         """Replay locally-present segments that directly continue the
         applied watermark.  Valid-but-non-contiguous files stay on disk
         and apply once the gap fills."""
         while True:
-            nxt = self.applied_segment + 1
+            with self._lock:
+                nxt = self.applied_segment + 1
             path = segment_path(self.manager.wal_dir, nxt)
             if not os.path.exists(path):
                 return
@@ -261,7 +265,7 @@ class ReplicationFollower:
             with self._lock:
                 self.applied_segment = nxt
                 self.last_applied_unix = time.time()
-            self.stats_counters["segments_applied"] += 1
+                self.stats_counters["segments_applied"] += 1
             _SEGS_APPLIED.inc()
             _APPLIED_SEGMENT.set(nxt)
 
@@ -286,6 +290,7 @@ class ReplicationFollower:
         else:
             res = RecoveryResult()
         old = set(self.res.stores)
+        # kolint: ignore[KL312] bootstrap publishes a fully-built RecoveryResult by one atomic rebind; replay is idempotent and concurrent readers tolerate either generation
         self.res = res
         self.manager.generation = max(self.manager.generation, gen)
         # segments below the generation's replay horizon are dead weight
@@ -302,8 +307,9 @@ class ReplicationFollower:
         for sid, db in res.stores.items():
             self.on_store_update(sid, db, created=sid not in old)
         self._advance_from_local()
-        self.bootstrapped = True
-        self.stats_counters["bootstraps"] += 1
+        with self._lock:
+            self.bootstrapped = True
+            self.stats_counters["bootstraps"] += 1
         _BOOTSTRAPS.inc()
         _log.info(
             "bootstrap complete",
@@ -338,14 +344,17 @@ class ReplicationFollower:
         with self._lock:
             self.primary_pos = (int(pos[0]), int(pos[1]))
             self.primary_records = int(meta.get("records", 0))
-        self.stats_counters["polls"] += 1
+            self.stats_counters["polls"] += 1
         for idx in sorted(int(i) for i in meta.get("sealed") or ()):
-            if idx <= self.applied_segment:
+            with self._lock:
+                applied = self.applied_segment
+            if idx <= applied:
                 # duplicated delivery (injected or raced): watermark says
                 # it is already applied — skip, don't re-replay
-                self.stats_counters["duplicate_segments_skipped"] += 1
+                with self._lock:
+                    self.stats_counters["duplicate_segments_skipped"] += 1
                 continue
-            if idx != self.applied_segment + 1 or not self._fetch_segment(idx):
+            if idx != applied + 1 or not self._fetch_segment(idx):
                 # gap (pruned by a snapshot) — start over from the
                 # primary's current generation
                 self.bootstrap()
@@ -360,12 +369,15 @@ class ReplicationFollower:
                 # each poll round is a root activity on this node: mint a
                 # fresh trace so apply spans group per-round in the ring
                 with obs_spans.trace_scope(None):
-                    if not self.bootstrapped:
+                    with self._lock:
+                        booted = self.bootstrapped
+                    if not booted:
                         self.bootstrap()
                     self.poll_once()
                 backoff = self.poll_interval_s
             except (ProtocolError, OSError):
-                self.stats_counters["poll_errors"] += 1
+                with self._lock:
+                    self.stats_counters["poll_errors"] += 1
                 _POLL_ERRORS.inc()
                 self.client.close()
                 backoff = min(backoff * 2.0, 2.0)
@@ -392,12 +404,14 @@ class ReplicationFollower:
         applied), open a fresh WAL segment, attach the stores so new
         writes journal.  Returns the promotion watermark."""
         self.stop()
+        with self._lock:
+            applied = self.applied_segment
         for idx in list_segments(self.manager.wal_dir):
-            if idx > self.applied_segment:
+            if idx > applied:
                 os.unlink(segment_path(self.manager.wal_dir, idx))
         self.manager.wal = WalWriter(
             self.manager.wal_dir,
-            start_segment=self.applied_segment + 1,
+            start_segment=applied + 1,
             fsync_policy=self.manager.fsync_policy,
             segment_bytes=self.manager.segment_bytes,
             group_interval_s=self.manager.group_interval_s,
@@ -459,13 +473,23 @@ class ReplicationFollower:
         return wm
 
     def stats(self) -> dict:
-        out = {
-            "role": "primary" if self.promoted else "follower",
-            "source": f"{self.source_host}:{self.source_port}",
-            "bootstrapped": self.bootstrapped,
-            "lag_segments": self.lag_segments(),
-            "lag_records": self.lag_records(),
-            **self.stats_counters,
-        }
+        lag_seg = self.lag_segments()
+        lag_rec = self.lag_records()
+        with self._lock:
+            out = {
+                "role": "primary" if self.promoted else "follower",
+                "source": f"{self.source_host}:{self.source_port}",
+                "bootstrapped": self.bootstrapped,
+                "lag_segments": lag_seg,
+                "lag_records": lag_rec,
+                **self.stats_counters,
+            }
         out["watermark"] = self.watermark()
         return out
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

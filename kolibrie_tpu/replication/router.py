@@ -435,15 +435,18 @@ class RouterCore:
         if started is not None:
             elapsed = time.monotonic() - started
             _ROUTER_FAILOVER_SECONDS.observe(elapsed)
-            self.last_failover_ms = elapsed * 1000.0
+            with self.lock:
+                self.last_failover_ms = elapsed * 1000.0
         _ROUTER_PROMOTIONS.inc()
         wm = winner.watermark or {}
+        with self.lock:
+            failover_ms = self.last_failover_ms
         _log.info(
             "follower promoted",
             replica=winner.name,
             applied_segment=wm.get("applied_segment"),
             applied_records=wm.get("applied_records"),
-            failover_ms=round(self.last_failover_ms, 1),
+            failover_ms=round(failover_ms, 1),
         )
         return winner
 
@@ -796,3 +799,10 @@ def make_router(
     core.node_id = f"router:{httpd.server_address[1]}"
     core.start()
     return httpd, core
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

@@ -145,7 +145,9 @@ class WindowSupervisor:
             due = self.processed % n == 0
         if due:
             try:
-                self.last_checkpoint = self.checkpoint_fn()
+                blob = self.checkpoint_fn()
+                with self._lock:
+                    self.last_checkpoint = blob
             except Exception:  # a failed snapshot must not fail the
                 # firing; the previous checkpoint stands — but count it,
                 # or a permanently broken checkpoint_fn is invisible
@@ -204,7 +206,8 @@ class WindowSupervisor:
             self.config.backoff_max_s,
         )
         self.config.sleep(backoff)
-        blob = self.last_checkpoint
+        with self._lock:
+            blob = self.last_checkpoint
         if blob is not None and self.restore_fn is not None:
             try:
                 self.restore_fn(blob)

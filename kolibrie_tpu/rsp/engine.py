@@ -295,7 +295,7 @@ class RSPEngine:
         # results are still queued (drives close-to-emit latency); races
         # on these only skew a metric, never a result
         self._max_event_ts = 0
-        self._fire_t0: Dict[str, float] = {}
+        self._fire_t0: Dict[str, float] = {}  # guarded by: _cw_lock
 
         # cross-window state (rules may arrive pre-parsed or as N3 text,
         # which is parsed against THIS engine's dictionary so IDs align)
@@ -313,6 +313,9 @@ class RSPEngine:
         self._sds_plus_state: SdsWithExpiry = {}  # guarded by: _cw_lock
         self._latest_contents: Dict[str, List[Tuple[Triple, int]]] = {}  # guarded by: _cw_lock
         self._cw_lock = threading.Lock()
+        # AUTO-mode churn baseline: written by the coordinator each
+        # cross-window cycle and reset by restore_state
+        self._auto_prev_alive: Optional[frozenset] = None  # guarded by: _cw_lock
 
         # single-thread coordination state
         self._st_last_materialized: Dict[str, List[Dict[str, str]]] = {}
@@ -417,7 +420,10 @@ class RSPEngine:
             if self.cross_window_enabled or self._has_joins:
                 # result rides _result_queue: emission happens later, in
                 # _emit — remember the EARLIEST pending fire start
-                self._fire_t0.setdefault(cfg.window_iri, time.perf_counter())
+                with self._cw_lock:
+                    self._fire_t0.setdefault(
+                        cfg.window_iri, time.perf_counter()
+                    )
             t0 = time.perf_counter()
             with _obs_span("rsp.window.fire", window=cfg.window_iri):
                 fire(content, ts)
@@ -642,9 +648,10 @@ class RSPEngine:
         ]
         for row in self.r2s.eval(outputs, ts):
             self.consumer(row)
-        if self._fire_t0:
+        with self._cw_lock:
             pending = list(self._fire_t0.values())
             self._fire_t0.clear()
+        if pending:
             _CLOSE_TO_EMIT.observe(time.perf_counter() - min(pending))
 
     # ---------------------------------------------------------- cross-window
@@ -662,6 +669,7 @@ class RSPEngine:
         old_cache = getattr(self, "_wt_cache", {})
         new_cache = {}
         annot = getattr(self, "_annot_pred_cache", {})
+        # kolint: ignore[KL311] per-cycle memo confined to the emission path: _build_sds runs only on the coordinator (or the sole pusher in callback mode), never both in one engine
         self._annot_pred_cache = annot
         for cfg in self.window_configs:
             triples: List[WindowedTriple] = []
@@ -695,6 +703,7 @@ class RSPEngine:
                 new_cache[key] = wt
                 triples.append(wt)
             sds.windows[cfg.window_iri] = WindowData(cfg.width, triples)
+        # kolint: ignore[KL311] same emission-path confinement as _annot_pred_cache above
         self._wt_cache = new_cache
         if self.cross_window_context is not None:
             for iri in self.cross_window_context.output_iris:
@@ -728,8 +737,9 @@ class RSPEngine:
             for iri, wd in sds.windows.items()
             for wt in wd.triples
         )
-        prev = getattr(self, "_auto_prev_alive", None)
-        self._auto_prev_alive = cur
+        with self._cw_lock:
+            prev = self._auto_prev_alive
+            self._auto_prev_alive = cur
         if prev is None or not cur:
             return CrossWindowReasoningMode.INCREMENTAL
         churn = len(cur - prev) / len(cur)
@@ -921,3 +931,10 @@ class RSPEngine:
         for recv in getattr(self, "_window_receivers", []):
             recv.put(None)
         self._result_queue.put(None)  # type: ignore[arg-type]
+
+
+# Debug-build runtime check of the # guarded by: annotations above
+# (no-op unless KOLIBRIE_DEBUG_LOCKS=1 — see analysis/lockcheck.py)
+from kolibrie_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.auto_instrument(globals())

@@ -13,7 +13,9 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== kolint =="
-python -m kolibrie_tpu.analysis "$@" kolibrie_tpu/ || rc=1
+# --max-seconds keeps lint commit-loop fast; the .kolint_cache result
+# cache this first pass warms makes the standalone passes below near-free
+python -m kolibrie_tpu.analysis --max-seconds 60 "$@" kolibrie_tpu/ || rc=1
 
 echo "== kolint cache-key versioning (KL901) =="
 # the rule is in the default set above; this explicit pass keeps the
@@ -27,6 +29,26 @@ echo "== kolint print hygiene (KL504) =="
 # discipline visible — library diagnostics go through obs/log.py, user
 # output names its stream (docs/OBSERVABILITY.md)
 python -m kolibrie_tpu.analysis --rules KL504 kolibrie_tpu/ || rc=1
+
+echo "== kolint static races (KL311/KL312) =="
+# the interprocedural race detector on its own: shared state written
+# from >=2 thread roots must hold a lock at every access (docs/ANALYSIS.md)
+python -m kolibrie_tpu.analysis --rules KL311,KL312 kolibrie_tpu/ || rc=1
+
+echo "== kolint dataflow taint (KL111/KL112) =="
+# def-use taint from traced params into host sinks and static/shape
+# positions — the recompile-hazard class (docs/ANALYSIS.md)
+python -m kolibrie_tpu.analysis --rules KL111,KL112 kolibrie_tpu/ || rc=1
+
+echo "== lock sanitizer self-check =="
+# the runtime cross-check of the static race rules: prove the
+# KOLIBRIE_DEBUG_LOCKS instrumentation still catches a planted
+# unguarded access before trusting its silence elsewhere
+KOLIBRIE_DEBUG_LOCKS=1 python -c "
+from kolibrie_tpu.analysis import lockcheck
+assert lockcheck.selftest(), 'lockcheck.selftest() failed'
+print('lockcheck selftest ok')
+" || rc=1
 
 echo "== compileall =="
 # -q: names only on failure; PYTHONDONTWRITEBYTECODE keeps the tree clean

@@ -417,3 +417,52 @@ def test_dead_letter_keeps_stream_flowing():
     # the stream kept flowing: rows from firings after the poisoned one
     # (literal quotes are stripped in emitted bindings)
     assert any(dict(r).get("o") == "3" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Lock-discipline sanitizer: a SEEDED guard violation must be caught.
+# The static race rules trust `# kolint: holds[_lock]` claims; the
+# KOLIBRIE_DEBUG_LOCKS sanitizer is what keeps those claims honest at
+# runtime.  TimeSeriesRing.record() carries a `lockcheck.bypass` fault
+# point that, when injected, calls the holds[]-claimed helper WITHOUT
+# the lock — exactly the false claim the sanitizer exists to expose.
+# ---------------------------------------------------------------------------
+
+
+def test_lock_sanitizer_catches_seeded_guard_bypass():
+    from kolibrie_tpu.analysis import lockcheck
+    from kolibrie_tpu.obs.timeseries import TimeSeriesRing
+    from kolibrie_tpu.resilience.faultinject import InjectedFault
+
+    # force=True instruments without flipping the env for the whole
+    # process (auto_instrument already ran, as a no-op, at import time)
+    lockcheck.instrument_class(TimeSeriesRing, force=True)
+    try:
+        lockcheck.reset()
+        ring = TimeSeriesRing(capacity=4)
+
+        ring.record()  # disciplined path: lock held, sanitizer silent
+        assert lockcheck.reports() == []
+
+        plan = FaultPlan(seed=3)
+        plan.add("lockcheck.bypass", error=InjectedFault, at_calls=[1])
+        with plan.installed():
+            ring.record()  # bypasses the lock → holds[_lock] is a lie
+
+        reps = [
+            r for r in lockcheck.reports() if r["class"] == "TimeSeriesRing"
+        ]
+        assert reps, "sanitizer missed the seeded unguarded access"
+        assert {r["attr"] for r in reps} & {"_seq", "_samples"}
+        assert all(r["lock"] == "_lock" for r in reps)
+        assert any(r["func"] == "_append_sample" for r in reps)
+
+        # and the ring still works: recording was observed, not altered
+        assert len(ring) == 2
+    finally:
+        lockcheck.reset()
+        for attr in ("_samples", "_seq"):
+            if isinstance(
+                TimeSeriesRing.__dict__.get(attr), lockcheck.GuardedAttribute
+            ):
+                delattr(TimeSeriesRing, attr)

@@ -1,0 +1,663 @@
+"""kolint v2 tests: thread-root inference, the KL311/KL312 static race
+detector, the KL111/KL112 dataflow taint rules, the result cache +
+process-pool execution, and the --explain CLI surface — ISSUE 20.
+
+The runtime half of the race checker (the KOLIBRIE_DEBUG_LOCKS
+sanitizer) is covered by its selftest here and by the seeded
+guard-violation chaos scenario in tests/test_chaos.py.
+"""
+
+import json
+
+from kolibrie_tpu.analysis import core
+from kolibrie_tpu.analysis.__main__ import main as kolint_main
+
+# ------------------------------------------------------------------ helpers
+
+
+def lint(tmp_path, source: str, name: str = "mod.py", **kw):
+    p = tmp_path / name
+    p.write_text(source)
+    return core.run([str(p)], use_baseline=False, root=str(tmp_path), **kw)
+
+
+def rules_fired(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ------------------------------------------- KL311: unguarded shared write
+
+
+RACE_DAEMON_VS_CALLER = """
+import threading
+
+class Sampler:
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.count += 1
+
+    def stats(self):
+        return self.count
+"""
+
+
+def test_kl311_thread_target_vs_caller(tmp_path):
+    res = lint(tmp_path, RACE_DAEMON_VS_CALLER)
+    assert rules_fired(res) == ["KL311"]
+    (f,) = res.findings
+    assert "self.count" in f.message
+    assert f.scope == "Sampler._run"  # anchored at the unlocked write
+
+
+def test_kl311_submit_root(tmp_path):
+    res = lint(tmp_path, """
+from concurrent.futures import ThreadPoolExecutor
+
+class Batcher:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(2)
+        self.done = 0
+
+    def kick(self):
+        self.pool.submit(self._task)
+
+    def _task(self):
+        self.done += 1
+
+    def progress(self):
+        return self.done
+""")
+    assert rules_fired(res) == ["KL311"]
+    assert "self.done" in res.findings[0].message
+    # self.pool is a sync object — meant to be shared, never flagged
+    assert all("pool" not in f.message for f in res.findings)
+
+
+def test_kl311_timer_root(tmp_path):
+    res = lint(tmp_path, """
+import threading
+
+class Beeper:
+    def __init__(self):
+        self.beeps = 0
+
+    def arm(self):
+        threading.Timer(0.1, self._fire).start()
+
+    def _fire(self):
+        self.beeps += 1
+
+    def count(self):
+        return self.beeps
+""")
+    assert rules_fired(res) == ["KL311"]
+
+
+def test_kl311_thread_subclass_run_root(tmp_path):
+    res = lint(tmp_path, """
+import threading
+
+class Worker(threading.Thread):
+    def __init__(self):
+        super().__init__()
+        self.ticks = 0
+
+    def run(self):
+        self.ticks += 1
+
+    def peek(self):
+        return self.ticks
+""")
+    assert rules_fired(res) == ["KL311"]
+
+
+def test_kl311_module_global(tmp_path):
+    res = lint(tmp_path, """
+import threading
+
+_counter = 0
+
+def start():
+    threading.Thread(target=_work, daemon=True).start()
+
+def _work():
+    global _counter
+    _counter += 1
+
+def read_counter():
+    return _counter
+""")
+    assert rules_fired(res) == ["KL311"]
+    assert "module global '_counter'" in res.findings[0].message
+
+
+def test_kl311_locked_everywhere_is_clean(tmp_path):
+    res = lint(tmp_path, """
+import threading
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def stats(self):
+        with self._lock:
+            return self.count
+""")
+    assert rules_fired(res) == []
+
+
+def test_kl311_no_threads_no_findings(tmp_path):
+    # unguarded mutable state in a class that never spawns: no thread
+    # roots exist, so nothing can race
+    res = lint(tmp_path, """
+class Acc:
+    def __init__(self):
+        self.n = 0
+
+    def add(self):
+        self.n += 1
+
+    def total(self):
+        return self.n
+""")
+    assert rules_fired(res) == []
+
+
+def test_kl311_init_only_writes_are_clean(tmp_path):
+    # immutable-after-construction: no write outside __init__
+    res = lint(tmp_path, """
+import threading
+
+class Config:
+    def __init__(self):
+        self.limit = 8
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        return self.limit
+
+    def show(self):
+        return self.limit
+""")
+    assert rules_fired(res) == []
+
+
+def test_kl311_annotated_field_is_handed_to_kl301(tmp_path):
+    # `# guarded by:` hands the field to KL301 + the runtime sanitizer;
+    # KL31x must not double-report it
+    res = lint(tmp_path, """
+import threading
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guarded by: _lock
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.count += 1
+
+    def stats(self):
+        return self.count
+""")
+    fired = rules_fired(res)
+    assert "KL311" not in fired and "KL312" not in fired
+    assert "KL301" in fired  # the lexical rule owns the field now
+
+
+def test_kl311_per_request_handler_is_exempt(tmp_path):
+    # handler instances are constructed per request: self.* is
+    # thread-confined even though do_* methods run on pool threads
+    res = lint(tmp_path, """
+from http.server import BaseHTTPRequestHandler
+
+class ApiHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.hits = 1
+        self._reply()
+
+    def do_POST(self):
+        self.hits = 2
+
+    def _reply(self):
+        return self.hits
+""")
+    assert rules_fired(res) == []
+
+
+# --------------------------------------------- KL312: inconsistent guards
+
+
+RACE_MIXED = """
+import threading
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def stats(self):
+        return self.count
+"""
+
+
+def test_kl312_mixed_guard(tmp_path):
+    res = lint(tmp_path, RACE_MIXED)
+    assert rules_fired(res) == ["KL312"]
+    (f,) = res.findings
+    assert "_lock" in f.message
+    assert f.scope == "Sampler.stats"  # anchored at the lock-free site
+
+
+def test_kl312_catches_access_outside_with_block(tmp_path):
+    # the "lock released too early" shape: write slipped below the with
+    res = lint(tmp_path, """
+import threading
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.last = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.total += 1
+        self.last = self.total
+
+    def read(self):
+        with self._lock:
+            return (self.total, self.last)
+""")
+    fired = rules_fired(res)
+    assert fired == ["KL312"]
+    assert all(f.scope == "Gauge._run" for f in res.findings)
+
+
+def test_holds_claim_escapes_kl312(tmp_path):
+    # `kolint: holds[...]` on a helper's def line is a caller-holds
+    # contract: the lock-set engine treats the claim as held
+    src = """
+import threading
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._push(1)
+
+    def _push(self, x):{holds}
+        self.items.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.items)
+"""
+    clean = lint(tmp_path, src.format(holds="  # kolint: holds[_lock]"))
+    assert rules_fired(clean) == []
+    # without the claim, the helper's write is lock-free → KL312
+    bare = lint(tmp_path, src.format(holds=""), name="bare.py")
+    assert "KL312" in rules_fired(bare)
+
+
+# -------------------------------------------------- KL111: dataflow taint
+
+
+def test_kl111_derived_value_in_host_branch(tmp_path):
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x) * 2.0
+    if y > 0:
+        return y
+    return -y
+""")
+    assert "KL111" in rules_fired(res)
+    assert any("'y'" in f.message for f in res.findings)
+
+
+def test_kl111_interprocedural_taint(tmp_path):
+    # the traced param reaches helper() via the call summary; the sink
+    # is three lines into a function with no jit decorator of its own
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def root(x):
+    return helper(x)
+
+def helper(v):
+    s = v.sum()
+    if s > 0:
+        return s
+    return -s
+""")
+    kl111 = [f for f in res.findings if f.rule == "KL111"]
+    assert kl111 and kl111[0].scope == "helper"
+
+
+def test_kl111_converter_sink(tmp_path):
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.max(x) + 1.0
+    n = int(y)
+    return n
+""")
+    assert any(
+        f.rule == "KL111" and "int()" in f.message for f in res.findings
+    )
+
+
+def test_kl111_host_side_code_is_clean(tmp_path):
+    res = lint(tmp_path, """
+def host(rows):
+    n = len(rows) * 2
+    if n > 0:
+        return rows[:n]
+    return rows
+""")
+    assert "KL111" not in rules_fired(res)
+
+
+# ---------------------------------------- KL112: the recompile-hazard class
+
+
+def test_kl112_traced_value_as_shape_dim(tmp_path):
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def mask(x):
+    k = jnp.sum(x)
+    return jnp.zeros(k)
+""")
+    assert any(
+        f.rule == "KL112" and "zeros" in f.message for f in res.findings
+    )
+
+
+def test_kl112_reshape_dim(tmp_path):
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def fold(x):
+    k = jnp.sum(x)
+    return x.reshape(k, 2)
+""")
+    assert any(
+        f.rule == "KL112" and "reshape" in f.message for f in res.findings
+    )
+
+
+def test_kl112_constant_shape_is_clean(tmp_path):
+    res = lint(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def pad(x):
+    return jnp.zeros(8) + x
+""")
+    assert "KL112" not in rules_fired(res)
+
+
+KERNEL_WITH_STATIC = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("cap",))
+def kernel(x, cap):
+    return x
+"""
+
+
+def test_kl112_defuse_into_static_arg(tmp_path):
+    # KL202 catches kernel(x, cap=len(rows)); the def-use form needs
+    # reaching definitions
+    res = lint(tmp_path, KERNEL_WITH_STATIC + """
+def serve(rows, x):
+    n = len(rows)
+    return kernel(x, cap=n)
+""")
+    kl112 = [f for f in res.findings if f.rule == "KL112"]
+    assert kl112 and "len() of a per-call argument" in kl112[0].message
+    assert kl112[0].scope == "serve"
+
+
+def test_kl112_capacity_class_launders(tmp_path):
+    # the template-cap protocol: rounding through a capacity helper is
+    # exactly what the static arg wants
+    res = lint(tmp_path, KERNEL_WITH_STATIC + """
+def round_cap(v):
+    return max(8, v)
+
+def serve(rows, x):
+    n = round_cap(len(rows))
+    return kernel(x, cap=n)
+""")
+    assert "KL112" not in rules_fired(res)
+
+
+# --------------------------------------------------- cache + parallelism
+
+
+def test_cache_cold_warm_same_findings(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(RACE_DAEMON_VS_CALLER)
+    cold = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    cache = tmp_path / ".kolint_cache"
+    assert cache.is_dir()
+    sig_dirs = [d for d in cache.iterdir() if d.is_dir()]
+    assert len(sig_dirs) == 1
+    assert (sig_dirs[0] / "KL311.json").exists()
+    warm = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    assert [f.to_dict() for f in warm.findings] == [
+        f.to_dict() for f in cold.findings
+    ]
+
+
+def test_cache_invalidates_on_edit(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(RACE_DAEMON_VS_CALLER)
+    first = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    assert rules_fired(first) == ["KL311"]
+    # fix the race: the signature moves, stale entries must not serve
+    p.write_text(RACE_DAEMON_VS_CALLER.replace(
+        "    def _run(self):\n        self.count += 1",
+        "    def _run(self):\n        pass",
+    ))
+    second = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    assert rules_fired(second) == []
+
+
+def test_cached_findings_survive_suppression_edits(tmp_path):
+    # raw findings are cached pre-suppression: adding an ignore changes
+    # the signature (file content) but conceptually the suppression is
+    # applied AFTER the cache — both layers must agree
+    p = tmp_path / "mod.py"
+    p.write_text(RACE_DAEMON_VS_CALLER)
+    core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    p.write_text(RACE_DAEMON_VS_CALLER.replace(
+        "        self.count += 1",
+        "        # kolint: ignore[KL311] single-writer probe, reader tolerates stale\n"
+        "        self.count += 1",
+    ))
+    res = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), use_cache=True
+    )
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_changed_only_filters_report_not_analysis(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(RACE_DAEMON_VS_CALLER)
+    b.write_text(RACE_DAEMON_VS_CALLER)
+    full = core.run(
+        [str(tmp_path)], use_baseline=False, root=str(tmp_path),
+        use_cache=True,
+    )
+    assert {f.path for f in full.findings} == {"a.py", "b.py"}
+    # touch only b: the report narrows to b, a's finding still exists
+    b.write_text(RACE_DAEMON_VS_CALLER.replace("count", "tally"))
+    focused = core.run(
+        [str(tmp_path)], use_baseline=False, root=str(tmp_path),
+        use_cache=True, changed_only=True,
+    )
+    assert {f.path for f in focused.findings} == {"b.py"}
+    refull = core.run(
+        [str(tmp_path)], use_baseline=False, root=str(tmp_path),
+        use_cache=True,
+    )
+    assert {f.path for f in refull.findings} == {"a.py", "b.py"}
+
+
+def test_parallel_jobs_match_sequential(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(RACE_DAEMON_VS_CALLER + """
+
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x) * 2.0
+    if y > 0:
+        return y
+    return -y
+""")
+    seq = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), jobs=1
+    )
+    par = core.run(
+        [str(p)], use_baseline=False, root=str(tmp_path), jobs=4
+    )
+    assert [f.to_dict() for f in par.findings] == [
+        f.to_dict() for f in seq.findings
+    ]
+    assert {"KL111", "KL311"} <= set(rules_fired(par))
+
+
+def test_bucket_rules_groups_families():
+    from kolibrie_tpu.analysis.cache import bucket_rules
+
+    assert bucket_rules(["KL312", "KL111", "KL101", "KL311"]) == [
+        ["KL101"], ["KL111"], ["KL311", "KL312"],
+    ]
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def test_cli_explain_curated_rule(capsys):
+    rc = kolint_main(["--explain", "KL311"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "KL311" in out and "guarded by" in out and "Fix:" in out
+
+
+def test_cli_explain_falls_back_to_family_notes(capsys):
+    rc = kolint_main(["--explain", "KL301"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "KL301" in out
+
+
+def test_cli_explain_unknown_rule(capsys):
+    rc = kolint_main(["--explain", "KL999"])
+    assert rc == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_cli_runtime_line_and_max_seconds(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text("x = 1\n")
+    rc = kolint_main([str(p), "--no-baseline", "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kolint_runtime_s=" in out
+    # an impossible budget flips the exit code even with zero findings
+    rc = kolint_main(
+        [str(p), "--no-baseline", "--no-cache", "--max-seconds", "0"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "exceeded --max-seconds" in captured.err
+
+
+def test_cli_json_reports_runtime(tmp_path, capsys):
+    p = tmp_path / "ok.py"
+    p.write_text("x = 1\n")
+    rc = kolint_main([str(p), "--no-baseline", "--no-cache", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["ok"] is True
+    assert isinstance(payload["runtime_s"], float)
+
+
+# ------------------------------------------------------ runtime sanitizer
+
+
+def test_lockcheck_selftest():
+    from kolibrie_tpu.analysis import lockcheck
+
+    before = lockcheck.reports()
+    assert lockcheck.selftest() is True
+    # probe reports are scrubbed — a selftest never pollutes a session
+    assert lockcheck.reports() == before
